@@ -1,0 +1,34 @@
+"""spatialflink_tpu — a TPU-native spatial stream-processing framework.
+
+A ground-up JAX/XLA re-design of the capabilities of GeoFlink/SpatialFlink
+(reference: marianaGarcez/SpatialFlink, Java/Flink): continuous spatial
+queries (range, kNN, join) over point/polygon/linestring streams, trajectory
+operators (tRange/tKnn/tJoin/tAggregate/tStats/tFilter), a uniform-grid
+spatial index with guaranteed/candidate cell pruning, GeoJSON/WKT/CSV/TSV
+serde, the SNCB railway query suite, and an NES-compatible metrics layer.
+
+Architecture (TPU-first, not a port):
+  - ``ops/``       batched JAX kernels (distance, cell assignment, pruning,
+                   range/kNN/join, segment ops) — everything the reference
+                   computes per-record in JVM inner loops becomes one fused
+                   XLA program over a padded window batch.
+  - ``models/``    spatial object model (Point/Polygon/LineString/...) plus
+                   structure-of-arrays batch containers that cross the
+                   host→device boundary.
+  - ``grid.py``    the UniformGrid index: host-side neighbor-layer math
+                   producing per-cell flag arrays the kernels gather from.
+  - ``streams/``   host control plane: event-time windows, watermarks,
+                   sources/sinks, serde. Windowing stays on host; window
+                   payloads are shipped to the TPU kernels as batches.
+  - ``operators/`` the user-facing operator API mirroring the reference's
+                   surface (RangeQuery/KNNQuery/JoinQuery per type pair,
+                   QueryConfiguration, trajectory query classes).
+  - ``parallel/``  jax.sharding Mesh + shard_map data-parallel kernels for
+                   multi-chip scale-out (ICI collectives, not keyBy shuffle).
+  - ``sncb/``      the Belgian-railway domain layer (Q1..Q5, MN_Q1..Q5).
+  - ``mn/``        NES-compatible instrumentation/benchmark layer.
+"""
+
+__version__ = "0.1.0"
+
+from spatialflink_tpu.grid import UniformGrid  # noqa: F401

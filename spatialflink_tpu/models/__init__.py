@@ -1,0 +1,11 @@
+from spatialflink_tpu.models.objects import (  # noqa: F401
+    SpatialObject,
+    Point,
+    Polygon,
+    LineString,
+    MultiPoint,
+    MultiPolygon,
+    MultiLineString,
+    GeometryCollection,
+)
+from spatialflink_tpu.models.batch import PointBatch, GeometryBatch  # noqa: F401

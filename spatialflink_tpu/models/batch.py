@@ -1,0 +1,175 @@
+"""Structure-of-arrays batches — the host↔device currency.
+
+Window payloads cross the host→TPU boundary as fixed-shape SoA batches
+(padded to bucket sizes, utils/padding.py) instead of the reference's
+per-record POJOs. ``PointBatch`` carries point streams; ``GeometryBatch``
+carries polygon/linestring streams as per-object packed boundary arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import LineString, Point, Polygon
+from spatialflink_tpu.utils.interning import Interner
+from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
+
+
+@dataclass
+class PointBatch:
+    """Padded point batch: xy (N,2), ts (N,), oid (N,), valid (N,), cell (N,)."""
+
+    xy: np.ndarray
+    ts: np.ndarray
+    oid: np.ndarray
+    valid: np.ndarray
+    cell: Optional[np.ndarray] = None
+
+    @property
+    def capacity(self) -> int:
+        return self.xy.shape[0]
+
+    @property
+    def count(self) -> int:
+        return int(self.valid.sum())
+
+    @classmethod
+    def from_arrays(
+        cls,
+        xy: np.ndarray,
+        ts: Optional[np.ndarray] = None,
+        oid: Optional[np.ndarray] = None,
+        bucket: Optional[int] = None,
+        dtype=np.float64,
+    ) -> "PointBatch":
+        xy = np.asarray(xy, dtype).reshape(-1, 2)
+        n = len(xy)
+        ts = np.zeros(n, np.int64) if ts is None else np.asarray(ts, np.int64)
+        oid = np.zeros(n, np.int32) if oid is None else np.asarray(oid, np.int32)
+        b = bucket if bucket is not None else next_bucket(n)
+        return cls(
+            xy=pad_to_bucket(xy, b),
+            ts=pad_to_bucket(ts, b),
+            oid=pad_to_bucket(oid, b, fill=0),
+            valid=pad_to_bucket(np.ones(n, bool), b, fill=False),
+        )
+
+    @classmethod
+    def from_points(
+        cls,
+        points: Sequence[Point],
+        interner: Optional[Interner] = None,
+        bucket: Optional[int] = None,
+        dtype=np.float64,
+    ) -> "PointBatch":
+        n = len(points)
+        xy = np.array([[p.x, p.y] for p in points], dtype).reshape(n, 2)
+        ts = np.array([p.timestamp for p in points], np.int64)
+        if interner is not None:
+            oid = interner.intern_many(p.obj_id for p in points)
+        else:
+            oid = np.zeros(n, np.int32)
+        return cls.from_arrays(xy, ts, oid, bucket=bucket, dtype=dtype)
+
+    def with_cells(self, grid: UniformGrid) -> "PointBatch":
+        cell = grid.assign_cells_np(self.xy)
+        # Padding lanes → out-of-grid so no flag table ever selects them.
+        cell = np.where(self.valid, cell, grid.num_cells).astype(np.int32)
+        return replace(self, cell=cell)
+
+    def compact(self, mask: np.ndarray) -> "PointBatch":
+        """Host-side compaction by a boolean mask (egress only)."""
+        keep = mask & self.valid
+        return PointBatch(
+            xy=self.xy[keep],
+            ts=self.ts[keep],
+            oid=self.oid[keep],
+            valid=np.ones(int(keep.sum()), bool),
+            cell=None if self.cell is None else self.cell[keep],
+        )
+
+
+@dataclass
+class GeometryBatch:
+    """Padded geometry batch: per-object packed boundary arrays.
+
+    ``verts``: (N, V, 2); ``edge_valid``: (N, V-1); plus ts/oid/valid and a
+    representative bbox per object (for cell assignment & bbox pruning).
+    """
+
+    verts: np.ndarray
+    edge_valid: np.ndarray
+    bbox: np.ndarray  # (N, 4) minx,miny,maxx,maxy
+    ts: np.ndarray
+    oid: np.ndarray
+    valid: np.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.verts.shape[0]
+
+    @classmethod
+    def from_objects(
+        cls,
+        objs: Sequence[Polygon | LineString],
+        interner: Optional[Interner] = None,
+        bucket: Optional[int] = None,
+        vert_bucket: Optional[int] = None,
+        dtype=np.float64,
+    ) -> "GeometryBatch":
+        n = len(objs)
+        vmax = max((o.num_vertices_packed() for o in objs), default=2)
+        v = vert_bucket if vert_bucket is not None else next_bucket(vmax, minimum=8)
+        verts = np.zeros((n, v, 2), dtype)
+        ev = np.zeros((n, v - 1), bool)
+        boxes = np.zeros((n, 4), dtype)
+        for i, o in enumerate(objs):
+            pv, pe = o.packed(pad_to=v)
+            verts[i] = pv
+            ev[i] = pe
+            boxes[i] = o.bbox()
+        ts = np.array([o.timestamp for o in objs], np.int64)
+        if interner is not None:
+            oid = interner.intern_many(o.obj_id for o in objs)
+        else:
+            oid = np.zeros(n, np.int32)
+        b = bucket if bucket is not None else next_bucket(n, minimum=8)
+        return cls(
+            verts=pad_to_bucket(verts, b),
+            edge_valid=pad_to_bucket(ev, b, fill=False),
+            bbox=pad_to_bucket(boxes, b),
+            ts=pad_to_bucket(ts, b),
+            oid=pad_to_bucket(oid, b),
+            valid=pad_to_bucket(np.ones(n, bool), b, fill=False),
+        )
+
+    def centroid_cells(self, grid: UniformGrid) -> np.ndarray:
+        """Flat cell of each object's bbox center (its keyBy cell).
+
+        The reference keys replicated polygons by each overlapped cell; for
+        batched pruning we flag *all* cells of each object via
+        ``grid.bbox_cells`` host-side instead (operator layer).
+        """
+        cx = (self.bbox[:, 0] + self.bbox[:, 2]) / 2
+        cy = (self.bbox[:, 1] + self.bbox[:, 3]) / 2
+        cell = grid.assign_cells_np(np.stack([cx, cy], axis=1))
+        return np.where(self.valid, cell, grid.num_cells).astype(np.int32)
+
+    def any_cell_flagged(self, grid: UniformGrid, flags: np.ndarray) -> np.ndarray:
+        """Per-object max flag over all cells its bbox overlaps (host-side).
+
+        Mirrors the reference's per-object gridIDsSet ∩ neighbor-set test
+        for polygon/linestring streams (e.g. PolygonPointRangeQuery filter).
+        """
+        out = np.zeros(self.capacity, np.uint8)
+        for i in range(self.capacity):
+            if not self.valid[i]:
+                continue
+            cells = grid.bbox_cells(*self.bbox[i])
+            if len(cells):
+                out[i] = flags[cells].max()
+        return out

@@ -1,0 +1,28 @@
+"""Batched JAX kernels — the TPU compute path of the framework.
+
+Every kernel here operates on fixed-shape, padded, structure-of-arrays
+batches and is safe under ``jax.jit`` / ``jax.vmap`` / ``shard_map``:
+no Python control flow on traced values, masking instead of compaction,
+``lax.top_k`` / segment reductions instead of priority queues.
+"""
+
+from spatialflink_tpu.ops.distances import (  # noqa: F401
+    point_point_distance,
+    pairwise_distance,
+    point_segment_distance,
+    point_polyline_distance,
+    haversine_distance,
+    bbox_point_min_distance,
+    bbox_bbox_min_distance,
+)
+from spatialflink_tpu.ops.cells import (  # noqa: F401
+    assign_cells,
+    gather_cell_flags,
+)
+from spatialflink_tpu.ops.polygon import (  # noqa: F401
+    points_in_polygon,
+    point_polygon_distance,
+)
+from spatialflink_tpu.ops.range import range_query_kernel  # noqa: F401
+from spatialflink_tpu.ops.knn import knn_kernel  # noqa: F401
+from spatialflink_tpu.ops.join import join_kernel, cross_join_kernel  # noqa: F401

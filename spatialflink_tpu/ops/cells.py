@@ -1,0 +1,45 @@
+"""Grid-cell assignment and cell-flag gathering kernels.
+
+Replaces the reference's per-record string-keyed cell assignment
+(``HelperClass.assignGridCellID``, HelperClass.java:104-116, which builds a
+zero-padded ``"xxxxxyyyyy"`` string key per point) with integer cell ids
+computed in one vectorized op: ``flat = xi * n + yi``. String keys exist
+only at the serde boundary (see grid.UniformGrid.cell_name).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def assign_cells(
+    xy: jnp.ndarray,
+    min_x: float,
+    min_y: float,
+    cell_length: float,
+    n: int,
+) -> jnp.ndarray:
+    """Assign each point a flat int32 cell id in [0, n*n]; n*n = out-of-grid.
+
+    ``xy``: (..., 2). Mirrors the floor arithmetic of
+    HelperClass.assignGridCellID (HelperClass.java:104-116): points outside
+    the grid bbox get index n*n (one past the last real cell), which every
+    flag table maps to "pruned" — the same net effect as the reference,
+    where out-of-range keys never appear in any neighbor set.
+    """
+    xi = jnp.floor((xy[..., 0] - min_x) / cell_length).astype(jnp.int32)
+    yi = jnp.floor((xy[..., 1] - min_y) / cell_length).astype(jnp.int32)
+    inside = (xi >= 0) & (xi < n) & (yi >= 0) & (yi < n)
+    flat = xi * jnp.int32(n) + yi
+    return jnp.where(inside, flat, jnp.int32(n * n))
+
+
+def gather_cell_flags(cell_ids: jnp.ndarray, flags: jnp.ndarray) -> jnp.ndarray:
+    """Gather per-point pruning flags from a (n*n+1,) table.
+
+    ``flags`` encodes the neighbor-set classification the reference computes
+    driver-side as HashSets (UniformGrid.java:165-222, 368-426):
+    0 = not a neighbor cell (prune), 1 = candidate (needs exact distance),
+    2 = guaranteed (emit without distance). Entry n*n (out-of-grid) is 0.
+    """
+    return flags[cell_ids]

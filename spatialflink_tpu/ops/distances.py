@@ -1,0 +1,128 @@
+"""Vectorized distance kernels.
+
+Re-designs the scalar JVM loops of the reference's
+``GeoFlink/utils/DistanceFunctions.java`` (getDistance overloads at :15-54,
+point–segment at :96-131, bbox min-distances at :150-421) and
+``HelperClass.computeHaverSine`` (HelperClass.java:379-385) as batched JAX
+ops. Coordinates are planar (degrees or meters — the framework is unit
+agnostic, exactly like the reference, which calls JTS ``.distance()`` on raw
+coordinates). All kernels preserve the input dtype (float32 on TPU,
+float64 in CPU parity tests).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def point_point_distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Euclidean distance between points, broadcasting over leading dims.
+
+    ``a``, ``b``: (..., 2) arrays. Mirrors
+    DistanceFunctions.getPointPointEuclideanDistance (DistanceFunctions.java:60-63).
+    """
+    d = a - b
+    return jnp.sqrt(jnp.sum(d * d, axis=-1))
+
+
+def pairwise_distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """All-pairs Euclidean distance matrix.
+
+    ``a``: (N, 2), ``b``: (M, 2) → (N, M). The batched replacement for the
+    reference's per-record ``getDistance(p, q)`` hot loops (e.g.
+    range/PointPointRangeQuery.java:152-186). Computed via explicit
+    differences (not the |a|²+|b|²-2ab trick) for numerical parity with the
+    reference's float64 JTS results.
+    """
+    d = a[:, None, :] - b[None, :, :]
+    return jnp.sqrt(jnp.sum(d * d, axis=-1))
+
+
+def point_segment_distance(
+    p: jnp.ndarray, s1: jnp.ndarray, s2: jnp.ndarray
+) -> jnp.ndarray:
+    """Min distance from point(s) to line segment(s), broadcasting.
+
+    ``p``, ``s1``, ``s2``: (..., 2). Vectorized form of
+    DistanceFunctions.getPointLineSegmentMinEuclideanDistance
+    (DistanceFunctions.java:96-131): project onto the segment, clamp the
+    parameter to [0, 1], except degenerate zero-length segments which use
+    the first endpoint (the reference leaves param = -1 there).
+    """
+    ap = p - s1
+    ab = s2 - s1
+    len_sq = jnp.sum(ab * ab, axis=-1)
+    dot = jnp.sum(ap * ab, axis=-1)
+    # Degenerate segment → param -1 → clamps to endpoint s1 (reference behavior).
+    param = jnp.where(len_sq > 0, dot / jnp.where(len_sq > 0, len_sq, 1), -1.0)
+    t = jnp.clip(param, 0.0, 1.0)
+    closest = s1 + t[..., None] * ab
+    return point_point_distance(p, closest)
+
+
+def point_polyline_distance(
+    p: jnp.ndarray, verts: jnp.ndarray, edge_valid: jnp.ndarray
+) -> jnp.ndarray:
+    """Min distance from points to a padded polyline's edges.
+
+    ``p``: (N, 2) points; ``verts``: (V, 2) padded vertex array whose
+    consecutive pairs form edges; ``edge_valid``: (V-1,) bool mask of real
+    edges (padding and ring breaks are False). Vectorized form of
+    DistanceFunctions.getPointCoordinatesArrayMinEuclideanDistance
+    (DistanceFunctions.java:71-85): the min over per-edge point–segment
+    distances. Works for both LineStrings and Polygon boundaries (JTS
+    point.distance(polygon) for an exterior point is exactly the min edge
+    distance; interior points are handled by ops.polygon).
+    """
+    s1 = verts[:-1]  # (E, 2)
+    s2 = verts[1:]
+    d = point_segment_distance(p[:, None, :], s1[None, :, :], s2[None, :, :])
+    big = jnp.asarray(jnp.finfo(d.dtype).max, d.dtype)
+    d = jnp.where(edge_valid[None, :], d, big)
+    return jnp.min(d, axis=-1)
+
+
+_EARTH_RADIUS_M = 6371008.7714  # mean Earth radius, matches mEarthRadius intent
+
+
+def haversine_distance(
+    lonlat_a: jnp.ndarray, lonlat_b: jnp.ndarray, radius: float = _EARTH_RADIUS_M
+) -> jnp.ndarray:
+    """Great-circle distance in meters, broadcasting over leading dims.
+
+    The reference's ``computeHaverSine`` (HelperClass.java:379-385) uses the
+    spherical-law-of-cosines form; we use the numerically stable haversine
+    formula (identical result in float64, far better conditioned in
+    float32 for nearby points — which is the common case on TPU).
+    """
+    lon1, lat1 = jnp.deg2rad(lonlat_a[..., 0]), jnp.deg2rad(lonlat_a[..., 1])
+    lon2, lat2 = jnp.deg2rad(lonlat_b[..., 0]), jnp.deg2rad(lonlat_b[..., 1])
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    h = jnp.sin(dlat / 2) ** 2 + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
+    return 2 * radius * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+
+
+def bbox_point_min_distance(p: jnp.ndarray, bbox: jnp.ndarray) -> jnp.ndarray:
+    """Min distance from point(s) to axis-aligned box(es); 0 inside.
+
+    ``p``: (..., 2); ``bbox``: (..., 4) as (minx, miny, maxx, maxy).
+    The closed form of the reference's case analysis in
+    DistanceFunctions.getPointPolygonBBoxMinEuclideanDistance
+    (DistanceFunctions.java:150-200), used by approximate query mode.
+    """
+    dx = jnp.maximum(jnp.maximum(bbox[..., 0] - p[..., 0], 0), p[..., 0] - bbox[..., 2])
+    dy = jnp.maximum(jnp.maximum(bbox[..., 1] - p[..., 1], 0), p[..., 1] - bbox[..., 3])
+    return jnp.sqrt(dx * dx + dy * dy)
+
+
+def bbox_bbox_min_distance(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Min distance between two axis-aligned boxes; 0 if overlapping.
+
+    ``a``, ``b``: (..., 4) as (minx, miny, maxx, maxy). Closed form of
+    DistanceFunctions.getBBoxBBoxMinEuclideanDistance
+    (DistanceFunctions.java:298-421).
+    """
+    dx = jnp.maximum(jnp.maximum(b[..., 0] - a[..., 2], 0), a[..., 0] - b[..., 2])
+    dy = jnp.maximum(jnp.maximum(b[..., 1] - a[..., 3], 0), a[..., 1] - b[..., 3])
+    return jnp.sqrt(dx * dx + dy * dy)

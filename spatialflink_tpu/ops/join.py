@@ -1,0 +1,127 @@
+"""Batched spatial-join kernels.
+
+The reference joins two streams by replicating every query object to all of
+its neighbor cells (a flatMap that multiplies the query stream by the
+neighbor-cell count, JoinQuery.java:73-137), equi-joining on gridID over a
+window, then distance-filtering (join/PointPointJoinQuery.java:124-183).
+
+The TPU design inverts this: no replication. The query side is sorted by
+cell once per window (a device sort); for each ordinary-side point we gather
+the query points of its (2L+1)² neighbor cells through a CSR-style
+searchsorted index and evaluate distances in one block — a grid-hash join
+that rides the MXU instead of exploding the shuffle.
+
+``cross_join_kernel`` is the RealTimeNaive path (constant-key cross join,
+join/PointPointJoinQuery.java:186-243).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.distances import point_point_distance
+
+
+class JoinResult(NamedTuple):
+    """For each left point: matching right-side indices within radius.
+
+    ``pair_mask``: (N, K*cap) bool; ``right_index``: (N, K*cap) int32 index
+    into the *original* right batch (-1 where masked); ``dist``: (N, K*cap);
+    ``overflow``: () int32 — number of right points dropped because a cell
+    exceeded ``cap`` (0 means the join is exact).
+    """
+
+    pair_mask: jnp.ndarray
+    right_index: jnp.ndarray
+    dist: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def sort_by_cell(cells: jnp.ndarray, n_total_cells: int):
+    """Sort a batch by cell id; returns (sorted_cells, order).
+
+    Invalid/out-of-grid entries must already carry cell id n_total_cells so
+    they sort to the end.
+    """
+    order = jnp.argsort(cells)
+    return cells[order], order.astype(jnp.int32)
+
+
+def join_kernel(
+    left_xy: jnp.ndarray,
+    left_valid: jnp.ndarray,
+    left_cell_xy_idx: jnp.ndarray,
+    right_xy_sorted: jnp.ndarray,
+    right_valid_sorted: jnp.ndarray,
+    right_cells_sorted: jnp.ndarray,
+    right_order: jnp.ndarray,
+    neighbor_offsets: jnp.ndarray,
+    grid_n: int,
+    radius,
+    cap: int,
+) -> JoinResult:
+    """Grid-hash join: left points vs cell-sorted right points.
+
+    ``left_cell_xy_idx``: (N, 2) int32 (xi, yi) cell indices of left points;
+    ``right_*_sorted``: right batch pre-sorted by flat cell id (see
+    ``sort_by_cell``), ``right_order`` maps sorted position → original index;
+    ``neighbor_offsets``: (K, 2) static (dx, dy) covering the candidate
+    square (grid.neighbor_offsets — the same cells the reference's
+    replication flatMap targets, JoinQuery.java:73-90); ``cap``: static max
+    right points gathered per cell.
+    """
+    n = left_xy.shape[0]
+    k = neighbor_offsets.shape[0]
+    num_cells = grid_n * grid_n
+
+    # Neighbor flat cell ids per left point: (N, K); invalid → num_cells+1
+    # (past every real right cell, so searchsorted yields an empty span).
+    nx = left_cell_xy_idx[:, 0:1] + neighbor_offsets[None, :, 0]
+    ny = left_cell_xy_idx[:, 1:2] + neighbor_offsets[None, :, 1]
+    in_grid = (nx >= 0) & (nx < grid_n) & (ny >= 0) & (ny < grid_n)
+    ncell = jnp.where(in_grid, nx * grid_n + ny, num_cells + 1)
+
+    start = jnp.searchsorted(right_cells_sorted, ncell.reshape(-1), side="left")
+    end = jnp.searchsorted(right_cells_sorted, ncell.reshape(-1), side="right")
+    start = start.reshape(n, k).astype(jnp.int32)
+    end = end.reshape(n, k).astype(jnp.int32)
+    span = end - start
+
+    m = right_xy_sorted.shape[0]
+    lane = jnp.arange(cap, dtype=jnp.int32)  # (cap,)
+    pos = start[:, :, None] + lane[None, None, :]  # (N, K, cap)
+    lane_ok = lane[None, None, :] < span[:, :, None]
+    pos_c = jnp.clip(pos, 0, m - 1)
+
+    cand_xy = right_xy_sorted[pos_c]  # (N, K, cap, 2)
+    cand_valid = right_valid_sorted[pos_c] & lane_ok
+    d = point_point_distance(left_xy[:, None, None, :], cand_xy)
+    pair = cand_valid & left_valid[:, None, None] & (d <= radius)
+
+    right_idx = jnp.where(cand_valid, right_order[pos_c], -1)
+    overflow = jnp.sum(jnp.maximum(span - cap, 0))
+    return JoinResult(
+        pair.reshape(n, k * cap),
+        right_idx.reshape(n, k * cap),
+        d.reshape(n, k * cap),
+        overflow,
+    )
+
+
+def cross_join_kernel(
+    left_xy: jnp.ndarray,
+    left_valid: jnp.ndarray,
+    right_xy: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    radius,
+) -> JoinResult:
+    """Naive all-pairs join (the reference's RealTimeNaive mode,
+    join/PointPointJoinQuery.java:186-243). (N, M) distance matrix, masked."""
+    d = point_point_distance(left_xy[:, None, :], right_xy[None, :, :])
+    pair = left_valid[:, None] & right_valid[None, :] & (d <= radius)
+    m = right_xy.shape[0]
+    right_idx = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], d.shape)
+    return JoinResult(pair, right_idx, d, jnp.zeros((), jnp.int32))

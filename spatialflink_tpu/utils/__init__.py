@@ -1,0 +1,2 @@
+from spatialflink_tpu.utils.interning import Interner  # noqa: F401
+from spatialflink_tpu.utils.padding import pad_to_bucket, next_bucket  # noqa: F401

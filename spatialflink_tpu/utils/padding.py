@@ -1,0 +1,32 @@
+"""Bucketed padding — static shapes for XLA.
+
+Window point-counts vary wildly between firings; recompiling the query
+program per window size would dominate runtime. All batches are padded to
+the next bucket size (powers of two above a floor), so the whole stream
+reuses a handful of compiled programs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MIN_BUCKET = 256
+
+
+def next_bucket(n: int, minimum: int = _MIN_BUCKET) -> int:
+    """Smallest power-of-two bucket >= max(n, 1), floored at ``minimum``."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to_bucket(arr: np.ndarray, bucket: int, fill=0) -> np.ndarray:
+    """Pad axis 0 of ``arr`` to ``bucket`` with ``fill``."""
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    if n > bucket:
+        raise ValueError(f"array length {n} exceeds bucket {bucket}")
+    pad_shape = (bucket - n,) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, dtype=arr.dtype)], axis=0)

@@ -1,0 +1,31 @@
+"""Test config: force CPU with 8 virtual devices (multi-chip sharding tests)
+and float64 (parity with the reference's JTS double math).
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+# XLA compiles are ~1s each on this host; the persistent cache makes repeat
+# test runs cheap (first run still pays compilation).
+jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_sft"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

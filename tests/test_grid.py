@@ -1,0 +1,161 @@
+"""UniformGrid parity tests.
+
+The expected values re-derive the reference's algorithms independently
+(set-based, python) and check the flag-table construction against them:
+guaranteed layers floor(r/(cell*sqrt2) - 1) (UniformGrid.java:428-439),
+candidate layers ceil(r/cell) (UniformGrid.java:441-445), square neighbor
+sets clipped to the grid (UniformGrid.java:165-222, 368-426).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import (
+    FLAG_CANDIDATE,
+    FLAG_GUARANTEED,
+    FLAG_NONE,
+    UniformGrid,
+)
+
+
+def brute_force_sets(grid, radius, qx, qy):
+    """Independent re-derivation of guaranteed/candidate cell sets."""
+    lg = math.floor(radius / (grid.cell_length * math.sqrt(2)) - 1)
+    lc = math.ceil(radius / grid.cell_length)
+    qi, qj = grid.cell_indices(qx, qy)
+    guaranteed = set()
+    if lg >= 0:
+        for i in range(qi - lg, qi + lg + 1):
+            for j in range(qj - lg, qj + lg + 1):
+                if 0 <= i < grid.n and 0 <= j < grid.n:
+                    guaranteed.add(i * grid.n + j)
+    candidate = set()
+    if lc > 0:
+        for i in range(qi - lc, qi + lc + 1):
+            for j in range(qj - lc, qj + lc + 1):
+                if 0 <= i < grid.n and 0 <= j < grid.n:
+                    c = i * grid.n + j
+                    if c not in guaranteed:
+                        candidate.add(c)
+    return guaranteed, candidate
+
+
+BEIJING = dict(min_x=115.50, max_x=117.60, min_y=39.60, max_y=41.10)
+
+
+def test_constructor_by_partitions():
+    g = UniformGrid(100, **BEIJING)
+    assert g.n == 100
+    assert g.cell_length == pytest.approx((117.60 - 115.50) / 100)
+    assert g.num_cells == 10000
+
+
+def test_constructor_by_cell_length_square_adjustment():
+    # x span 2.1 > y span 1.5 → y padded symmetrically to 2.1.
+    g = UniformGrid.from_cell_length(0.021, **BEIJING)
+    assert g.max_x - g.min_x == pytest.approx(g.max_y - g.min_y)
+    assert g.min_y == pytest.approx(39.60 - 0.3)
+    assert g.max_y == pytest.approx(41.10 + 0.3)
+    assert g.n == 100
+    assert g.cell_length == pytest.approx(2.1 / 100)
+
+
+def test_cell_assignment_and_naming():
+    g = UniformGrid(100, **BEIJING)
+    flat = g.flat_cell(116.5, 40.0)
+    xi = math.floor((116.5 - g.min_x) / g.cell_length)
+    yi = math.floor((40.0 - g.min_y) / g.cell_length)
+    assert flat == xi * 100 + yi
+    name = g.cell_name(flat)
+    assert len(name) == 10 and name == f"{xi:05d}{yi:05d}"
+    assert g.cell_from_name(name) == flat
+
+
+def test_out_of_grid_assignment():
+    g = UniformGrid(100, **BEIJING)
+    assert g.flat_cell(0.0, 0.0) == g.num_cells
+    xy = np.array([[116.5, 40.0], [0.0, 0.0], [115.50, 39.60]])
+    cells = g.assign_cells_np(xy)
+    assert cells[1] == g.num_cells
+    assert cells[2] == 0  # min corner → cell (0,0)
+
+
+def test_assign_cells_jax_matches_numpy(rng):
+    import jax.numpy as jnp
+    from spatialflink_tpu.ops.cells import assign_cells
+
+    g = UniformGrid(100, **BEIJING)
+    xy = np.stack(
+        [rng.uniform(115.0, 118.0, 1000), rng.uniform(39.0, 41.5, 1000)], axis=1
+    )
+    dev = np.asarray(assign_cells(jnp.asarray(xy), g.min_x, g.min_y, g.cell_length, g.n))
+    np.testing.assert_array_equal(dev, g.assign_cells_np(xy))
+
+
+@pytest.mark.parametrize("radius", [0.001, 0.02, 0.05, 0.5])
+def test_neighbor_flags_match_brute_force(radius):
+    g = UniformGrid(100, **BEIJING)
+    qx, qy = 116.5, 40.2
+    guaranteed, candidate = brute_force_sets(g, radius, qx, qy)
+    flags = g.neighbor_flags(radius, [g.flat_cell(qx, qy)])
+    assert set(np.nonzero(flags == FLAG_GUARANTEED)[0]) == guaranteed
+    assert set(np.nonzero(flags == FLAG_CANDIDATE)[0]) == candidate
+    assert flags[g.num_cells] == FLAG_NONE
+
+
+def test_layer_math_reference_values():
+    g = UniformGrid(100, **BEIJING)  # cell = 0.021
+    # r smaller than cell diagonal → no guaranteed layer at all
+    assert g.guaranteed_layers(0.001) == -1
+    assert g.candidate_layers(0.001) == 1
+    # r = exactly one cell → guaranteed -1 or 0 per the floor(x-1) formula
+    assert g.guaranteed_layers(g.cell_length * math.sqrt(2)) == 0
+    assert g.candidate_layers(0.05) == math.ceil(0.05 / g.cell_length)
+
+
+def test_grid_boundary_clipping():
+    g = UniformGrid(10, 0, 10, 0, 10)
+    flags = g.neighbor_flags(2.5, [0])  # query at corner cell (0,0)
+    lc = g.candidate_layers(2.5)
+    assert lc == 3
+    nz = np.nonzero(flags[: g.num_cells])[0]
+    for c in nz:
+        xi, yi = divmod(int(c), g.n)
+        assert 0 <= xi <= 3 and 0 <= yi <= 3
+
+
+def test_polygon_query_cells_union():
+    g = UniformGrid(10, 0, 10, 0, 10)
+    cells = g.bbox_cells(1.5, 1.5, 3.5, 2.5)
+    # x cells 1..3, y cells 1..2 → 6 cells
+    assert len(cells) == 6
+    flags = g.neighbor_flags(1.0, cells)
+    # Union of per-cell candidate squares
+    g2, c2 = set(), set()
+    for c in cells:
+        xi, yi = divmod(int(c), g.n)
+        gg, cc = brute_force_sets(g, 1.0, g.min_x + (xi + 0.5) * g.cell_length,
+                                  g.min_y + (yi + 0.5) * g.cell_length)
+        g2 |= gg
+        c2 |= cc
+    c2 -= g2
+    assert set(np.nonzero(flags == FLAG_GUARANTEED)[0]) == g2
+    assert set(np.nonzero(flags == FLAG_CANDIDATE)[0]) == c2
+
+
+def test_cell_layer_chebyshev():
+    g = UniformGrid(100, **BEIJING)
+    a = 50 * 100 + 50
+    assert g.cell_layer(a, a) == 0
+    assert g.cell_layer(a, 52 * 100 + 50) == 2
+    assert g.cell_layer(a, 51 * 100 + 53) == 3
+
+
+def test_neighbor_offsets_cover_candidate_square():
+    g = UniformGrid(100, **BEIJING)
+    off = g.neighbor_offsets(0.05)
+    lc = g.candidate_layers(0.05)
+    assert off.shape == ((2 * lc + 1) ** 2, 2)
+    assert off.min() == -lc and off.max() == lc

@@ -1,0 +1,232 @@
+"""Range/kNN/join kernel parity vs brute-force numpy re-derivations of the
+reference's window-loop semantics (guaranteed emit, candidate distance check,
+per-objID min-dist dedup, grid-hash join)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.batch import PointBatch
+from spatialflink_tpu.ops.cells import gather_cell_flags
+from spatialflink_tpu.ops.join import cross_join_kernel, join_kernel, sort_by_cell
+from spatialflink_tpu.ops.knn import knn_kernel
+from spatialflink_tpu.ops.polygon import pack_rings
+from spatialflink_tpu.ops.range import (
+    range_query_kernel,
+    range_query_polygons_kernel,
+)
+
+GRID = dict(min_x=0.0, max_x=10.0, min_y=0.0, max_y=10.0)
+
+
+def make_batch(rng, n=777, bucket=1024):
+    xy = rng.uniform(0, 10, size=(n, 2))
+    ts = rng.integers(0, 10_000, n)
+    oid = rng.integers(0, 60, n).astype(np.int32)
+    return PointBatch.from_arrays(xy, ts, oid, bucket=bucket)
+
+
+def brute_range(grid, flags, batch, q, r):
+    """Reference semantics: guaranteed → emit; candidate → min dist ≤ r."""
+    keep = np.zeros(batch.capacity, bool)
+    for i in range(batch.capacity):
+        if not batch.valid[i]:
+            continue
+        c = int(batch.cell[i])
+        f = int(flags[c])
+        if f == 2:
+            keep[i] = True
+        elif f == 1:
+            d = np.min(np.linalg.norm(q - batch.xy[i], axis=1))
+            keep[i] = d <= r
+    return keep
+
+
+@pytest.mark.parametrize("radius", [0.3, 1.5, 4.0])
+def test_range_kernel_matches_brute(rng, radius):
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng).with_cells(grid)
+    q = np.array([[5.0, 5.0], [2.0, 8.0]])
+    flags = grid.neighbor_flags(radius, [grid.flat_cell(*p) for p in q])
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    keep, dist = range_query_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+        jnp.asarray(q), radius,
+    )
+    np.testing.assert_array_equal(np.asarray(keep), brute_range(grid, flags, batch, q, radius))
+
+
+def test_range_approximate_emits_candidates_unchecked(rng):
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng).with_cells(grid)
+    q = np.array([[5.0, 5.0]])
+    r = 1.0
+    flags = grid.neighbor_flags(r, [grid.flat_cell(5.0, 5.0)])
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    keep, _ = range_query_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+        jnp.asarray(q), r, approximate=True,
+    )
+    expect = batch.valid & (pflags > 0)
+    np.testing.assert_array_equal(np.asarray(keep), expect)
+
+
+def test_range_polygon_query(rng):
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng).with_cells(grid)
+    ring = np.array([[4.0, 4.0], [6.0, 4.0], [6.0, 6.0], [4.0, 6.0]])
+    verts, ev = pack_rings([ring], pad_to=8)
+    r = 0.5
+    cells = grid.bbox_cells(4.0, 4.0, 6.0, 6.0)
+    flags = grid.neighbor_flags(r, cells)
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    keep, dist = range_query_polygons_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+        jnp.asarray(verts)[None], jnp.asarray(ev)[None], r,
+    )
+    keep = np.asarray(keep)
+    # Brute force: inside or within r of boundary, for candidate cells;
+    # guaranteed cells emitted regardless.
+    for i in range(batch.capacity):
+        if not batch.valid[i]:
+            assert not keep[i]
+            continue
+        f = int(flags[int(batch.cell[i])])
+        x, y = batch.xy[i]
+        inside = 4 <= x <= 6 and 4 <= y <= 6
+        edge_d = min(
+            max(4 - x, 0, x - 6) if 4 <= y <= 6 else np.inf,
+            max(4 - y, 0, y - 6) if 4 <= x <= 6 else np.inf,
+            min(np.hypot(x - cx, y - cy) for cx in (4, 6) for cy in (4, 6)),
+        )
+        d = 0.0 if inside else edge_d
+        expect = f == 2 or (f == 1 and d <= r)
+        assert keep[i] == expect, (i, f, x, y, d)
+
+
+def brute_knn(batch, flags_per_point, q, r, k):
+    best = {}
+    for i in range(batch.capacity):
+        if not batch.valid[i] or flags_per_point[i] == 0:
+            continue
+        d = np.linalg.norm(batch.xy[i] - q)
+        if d <= r:
+            o = int(batch.oid[i])
+            if o not in best or d < best[o]:
+                best[o] = d
+    return sorted(best.items(), key=lambda kv: kv[1])[:k]
+
+
+@pytest.mark.parametrize("k", [1, 5, 50])
+def test_knn_kernel_matches_brute(rng, k):
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng).with_cells(grid)
+    q = np.array([5.0, 5.0])
+    r = 3.0
+    flags = grid.neighbor_flags(r, [grid.flat_cell(*q)])
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    res = knn_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+        jnp.asarray(batch.oid), jnp.asarray(q), r, k, num_segments=64,
+    )
+    expect = brute_knn(batch, pflags, q, r, k)
+    nv = int(res.num_valid)
+    assert nv == len(expect)
+    got = [(int(res.segment[i]), float(res.dist[i])) for i in range(nv)]
+    for (go, gd), (eo, ed) in zip(got, expect):
+        assert gd == pytest.approx(ed, rel=1e-12)
+        assert go == eo
+    # Padding slots marked -1
+    assert all(int(res.segment[i]) == -1 for i in range(nv, k))
+    # Representative index points at a point of that object achieving min dist
+    for i in range(nv):
+        idx, seg = int(res.index[i]), int(res.segment[i])
+        assert int(batch.oid[idx]) == seg
+        assert np.linalg.norm(batch.xy[idx] - q) == pytest.approx(res.dist[i], rel=1e-12)
+
+
+def test_knn_empty_result(rng):
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng, n=10).with_cells(grid)
+    q = np.array([500.0, 500.0])  # far outside; no cells flagged
+    flags = grid.neighbor_flags(0.5, [grid.flat_cell(*q)])
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    res = knn_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+        jnp.asarray(batch.oid), jnp.asarray(q), 0.5, 5, num_segments=64,
+    )
+    assert int(res.num_valid) == 0
+    assert all(int(s) == -1 for s in np.asarray(res.segment))
+
+
+def brute_join(a, b, r):
+    pairs = set()
+    for i in range(len(a.xy)):
+        if not a.valid[i]:
+            continue
+        for j in range(len(b.xy)):
+            if not b.valid[j]:
+                continue
+            if np.linalg.norm(a.xy[i] - b.xy[j]) <= r:
+                pairs.add((i, j))
+    return pairs
+
+
+def test_grid_hash_join_matches_brute(rng):
+    grid = UniformGrid(20, **GRID)
+    r = 0.8
+    a = make_batch(rng, n=300, bucket=512).with_cells(grid)
+    b = make_batch(rng, n=200, bucket=256).with_cells(grid)
+    cells_sorted, order = sort_by_cell(jnp.asarray(b.cell), grid.num_cells)
+    bxy_sorted = jnp.asarray(b.xy)[order]
+    bvalid_sorted = jnp.asarray(b.valid)[order]
+    # Left cell (xi, yi) indices
+    xi = np.floor((a.xy[:, 0] - grid.min_x) / grid.cell_length).astype(np.int32)
+    yi = np.floor((a.xy[:, 1] - grid.min_y) / grid.cell_length).astype(np.int32)
+    res = join_kernel(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(np.stack([xi, yi], 1)),
+        bxy_sorted, bvalid_sorted, cells_sorted, order,
+        jnp.asarray(grid.neighbor_offsets(r)), grid.n, r, cap=32,
+    )
+    assert int(res.overflow) == 0
+    got = set()
+    pm = np.asarray(res.pair_mask)
+    ri = np.asarray(res.right_index)
+    for i in range(a.capacity):
+        for slot in np.nonzero(pm[i])[0]:
+            got.add((i, int(ri[i, slot])))
+    assert got == brute_join(a, b, r)
+
+
+def test_join_overflow_counted(rng):
+    grid = UniformGrid(20, **GRID)
+    r = 0.5
+    # 100 points in the same tiny spot → one cell with >cap points
+    xy = np.full((100, 2), 5.05) + rng.normal(0, 0.001, (100, 2))
+    b = PointBatch.from_arrays(xy, bucket=128).with_cells(grid)
+    a = PointBatch.from_arrays(np.array([[5.05, 5.05]]), bucket=256).with_cells(grid)
+    cells_sorted, order = sort_by_cell(jnp.asarray(b.cell), grid.num_cells)
+    xi = np.floor((a.xy[:, 0] - grid.min_x) / grid.cell_length).astype(np.int32)
+    yi = np.floor((a.xy[:, 1] - grid.min_y) / grid.cell_length).astype(np.int32)
+    res = join_kernel(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(np.stack([xi, yi], 1)),
+        jnp.asarray(b.xy)[order], jnp.asarray(b.valid)[order], cells_sorted, order,
+        jnp.asarray(grid.neighbor_offsets(r)), grid.n, r, cap=16,
+    )
+    assert int(res.overflow) > 0
+
+
+def test_cross_join_matches_brute(rng):
+    r = 1.2
+    a = make_batch(rng, n=50, bucket=64)
+    b = make_batch(rng, n=40, bucket=64)
+    res = cross_join_kernel(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(b.xy), jnp.asarray(b.valid), r
+    )
+    got = set()
+    pm = np.asarray(res.pair_mask)
+    for i in range(a.capacity):
+        for j in np.nonzero(pm[i])[0]:
+            got.add((i, int(j)))
+    assert got == brute_join(a, b, r)

@@ -1,0 +1,69 @@
+"""Point-in-polygon and polygon-distance kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spatialflink_tpu.ops.polygon import (
+    pack_rings,
+    point_polygon_distance,
+    points_in_polygon,
+    signed_area,
+)
+
+SQUARE = np.array([[0.0, 0.0], [4.0, 0.0], [4.0, 4.0], [0.0, 4.0]])
+HOLE = np.array([[1.0, 1.0], [3.0, 1.0], [3.0, 3.0], [1.0, 3.0]])
+
+
+def test_pack_rings_closes_and_seams():
+    verts, ev = pack_rings([SQUARE, HOLE])
+    assert len(verts) == 10  # 5 + 5 after closing
+    assert ev.sum() == 8  # 4 real edges per ring, 1 seam invalid
+    assert not ev[4]  # seam between ring 0 end and ring 1 start
+
+
+def test_containment_with_hole():
+    verts, ev = pack_rings([SQUARE, HOLE], pad_to=32)
+    pts = jnp.asarray(
+        [[0.5, 0.5], [2.0, 2.0], [2.0, 0.5], [5.0, 5.0], [-1.0, 2.0], [3.5, 3.5]]
+    )
+    inside = np.asarray(points_in_polygon(pts, jnp.asarray(verts), jnp.asarray(ev)))
+    np.testing.assert_array_equal(inside, [True, False, True, False, False, True])
+
+
+def test_containment_random_vs_matplotlibfree_brute(rng):
+    # Convex polygon → containment check against half-plane test.
+    ring = np.array([[0, 0], [6, 0], [8, 4], [3, 7], [-1, 3]], float)
+    verts, ev = pack_rings([ring], pad_to=16)
+    pts = rng.uniform(-2, 9, size=(500, 2))
+    got = np.asarray(points_in_polygon(jnp.asarray(pts), jnp.asarray(verts), jnp.asarray(ev)))
+    closed = np.vstack([ring, ring[:1]])
+    edges = closed[1:] - closed[:-1]
+    rel = pts[:, None, :] - closed[None, :-1, :]
+    cross = edges[None, :, 0] * rel[:, :, 1] - edges[None, :, 1] * rel[:, :, 0]
+    expect = np.all(cross > 0, axis=1) | np.all(cross < 0, axis=1)
+    # Skip points within 1e-9 of an edge (boundary ambiguity)
+    mismatch = got != expect
+    assert mismatch.mean() < 0.01
+
+
+def test_polygon_distance_zero_inside_min_edge_outside():
+    verts, ev = pack_rings([SQUARE])
+    pts = jnp.asarray([[2.0, 0.5], [6.0, 2.0], [2.0, -3.0], [2.0, 2.0]])
+    d = np.asarray(point_polygon_distance(pts, jnp.asarray(verts), jnp.asarray(ev)))
+    assert d[0] == 0.0  # inside (between hole-free square edges)
+    assert d[1] == pytest.approx(2.0)
+    assert d[2] == pytest.approx(3.0)
+    assert d[3] == 0.0
+
+
+def test_distance_inside_hole_is_to_hole_boundary():
+    verts, ev = pack_rings([SQUARE, HOLE])
+    # Point in the hole: outside the polygon → distance to hole boundary.
+    d = float(point_polygon_distance(jnp.asarray([[2.0, 2.0]]), jnp.asarray(verts), jnp.asarray(ev))[0])
+    assert d == pytest.approx(1.0)
+
+
+def test_signed_area_orientation():
+    assert signed_area(SQUARE) == pytest.approx(16.0)
+    assert signed_area(SQUARE[::-1]) == pytest.approx(-16.0)
