@@ -1,0 +1,40 @@
+from spatialflink_tpu.operators.query_config import (  # noqa: F401
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.operators.range_query import (  # noqa: F401
+    PointPointRangeQuery,
+    PointPolygonRangeQuery,
+    PointLineStringRangeQuery,
+    PolygonPointRangeQuery,
+    PolygonPolygonRangeQuery,
+    PolygonLineStringRangeQuery,
+    LineStringPointRangeQuery,
+    LineStringPolygonRangeQuery,
+    LineStringLineStringRangeQuery,
+    RangeResult,
+)
+from spatialflink_tpu.operators.knn_query import (  # noqa: F401
+    PointPointKNNQuery,
+    PointPolygonKNNQuery,
+    PointLineStringKNNQuery,
+    PolygonPointKNNQuery,
+    PolygonPolygonKNNQuery,
+    PolygonLineStringKNNQuery,
+    LineStringPointKNNQuery,
+    LineStringPolygonKNNQuery,
+    LineStringLineStringKNNQuery,
+    KnnWindowResult,
+)
+from spatialflink_tpu.operators.join_query import (  # noqa: F401
+    PointPointJoinQuery,
+    PointPolygonJoinQuery,
+    PointLineStringJoinQuery,
+    PolygonPointJoinQuery,
+    PolygonPolygonJoinQuery,
+    PolygonLineStringJoinQuery,
+    LineStringPointJoinQuery,
+    LineStringPolygonJoinQuery,
+    LineStringLineStringJoinQuery,
+    JoinWindowResult,
+)

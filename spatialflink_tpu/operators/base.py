@@ -1,0 +1,140 @@
+"""Shared operator machinery: window planning, batching, jitted programs.
+
+Every spatial operator follows the same shape:
+  1. driver side (host, once per run): build the query's neighbor-cell flag
+     table from the grid (the reference does this per query object too —
+     e.g. PointPointRangeQuery.java:119-125);
+  2. per window: assemble the event buffer into a padded SoA batch, ship to
+     a jitted XLA program (compiled once per bucket size), decode results.
+
+RealTime query types are executed as tumbling micro-batches
+(``realtime_batch_ms``) — the batched analog of per-record evaluation.
+CountBased uses count windows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.batch import GeometryBatch, PointBatch
+from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
+from spatialflink_tpu.operators.query_config import QueryConfiguration, QueryType
+from spatialflink_tpu.streams.windows import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+    WindowAssembler,
+    WindowBatch,
+)
+from spatialflink_tpu.utils.interning import Interner
+
+
+def window_assigner_for(conf: QueryConfiguration) -> SlidingEventTimeWindows:
+    if conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive):
+        return TumblingEventTimeWindows(conf.realtime_batch_ms)
+    return SlidingEventTimeWindows(conf.window_size_ms, conf.slide_step_ms)
+
+
+def count_window_batches(
+    events: Iterable, size: int, slide: int
+) -> Iterator[WindowBatch]:
+    """CountBased mode: fixed-count windows over arrival order (the
+    reference's QueryType.CountBased uses Flink countWindow). Window spans
+    are the event-time extents of each slice."""
+    from spatialflink_tpu.streams.windows import CountWindows
+
+    cw = CountWindows(size, slide)
+    buf: list = []
+    for ev in events:
+        for slice_ in cw.feed(buf, ev):
+            yield WindowBatch(slice_[0].timestamp, slice_[-1].timestamp + 1, list(slice_))
+    if buf:
+        yield WindowBatch(buf[0].timestamp, buf[-1].timestamp + 1, list(buf))
+
+
+class SpatialOperator:
+    """Base: holds grid + config (SpatialOperator.java is an empty abstract
+    base; here the base carries the real shared machinery)."""
+
+    def __init__(self, conf: QueryConfiguration, grid: UniformGrid):
+        self.conf = conf
+        self.grid = grid
+        self.interner = Interner()
+
+    # -- window plumbing ------------------------------------------------------
+
+    def _assembler(self) -> WindowAssembler:
+        return WindowAssembler(
+            window_assigner_for(self.conf),
+            timestamp_fn=lambda e: e.timestamp,
+            max_out_of_orderness_ms=self.conf.allowed_lateness_ms,
+            allowed_lateness_ms=self.conf.allowed_lateness_ms,
+        )
+
+    def windows(self, stream: Iterable[SpatialObject]) -> Iterator[WindowBatch]:
+        if self.conf.query_type == QueryType.CountBased:
+            yield from count_window_batches(
+                stream, self.conf.count_window_size, self.conf.count_window_size
+            )
+        else:
+            yield from self._assembler().stream(stream)
+
+    # -- batch building -------------------------------------------------------
+
+    def point_batch(self, events: Sequence[Point], dtype=np.float64) -> PointBatch:
+        batch = PointBatch.from_points(events, interner=self.interner, dtype=dtype)
+        return batch.with_cells(self.grid)
+
+    def geometry_batch(
+        self, events: Sequence[Polygon | LineString], dtype=np.float64
+    ) -> GeometryBatch:
+        return GeometryBatch.from_objects(events, interner=self.interner, dtype=dtype)
+
+
+def query_cells_of(grid: UniformGrid, query_obj) -> List[int]:
+    """Flat cells a query object overlaps (point → 1 cell; polygon/
+    linestring → bbox cells, like gridIDsSet)."""
+    if hasattr(query_obj, "grid_cells"):
+        return list(query_obj.grid_cells(grid))
+    raise TypeError(type(query_obj).__name__)
+
+
+def flags_for_queries(
+    grid: UniformGrid, radius: float, query_objs: Sequence
+) -> np.ndarray:
+    """Union flag table over all query objects (guaranteed wins)."""
+    cells: List[int] = []
+    for q in query_objs:
+        cells.extend(query_cells_of(grid, q))
+    return grid.neighbor_flags(radius, cells)
+
+
+def pack_query_points(query_objs: Sequence[Point], dtype=np.float64) -> np.ndarray:
+    return np.array([[q.x, q.y] for q in query_objs], dtype)
+
+
+def pack_query_geometries(
+    query_objs: Sequence[Polygon | LineString], dtype=np.float64
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(Q, V, 2) verts + (Q, V-1) edge_valid, padded to a shared V."""
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    vmax = max(q.num_vertices_packed() for q in query_objs)
+    v = next_bucket(vmax, minimum=8)
+    verts = np.zeros((len(query_objs), v, 2), dtype)
+    ev = np.zeros((len(query_objs), v - 1), bool)
+    for i, q in enumerate(query_objs):
+        pv, pe = q.packed(pad_to=v)
+        verts[i] = pv
+        ev[i] = pe
+    return verts, ev
+
+
+@functools.lru_cache(maxsize=None)
+def jitted(fn: Callable, *static: str):
+    """Module-level jit cache so every operator instance reuses programs."""
+    return jax.jit(fn, static_argnames=static) if static else jax.jit(fn)

@@ -1,0 +1,188 @@
+"""Continuous kNN operators — the ``spatialOperators/knn/`` matrix.
+
+The reference's two-stage per-cell-PQ → windowAll-merge pipeline
+(knn/PointPointKNNQuery.java:132-201 + KNNQuery.java:204-308) becomes a
+single fused program per window: masked distance → segment-min per objID →
+lax.top_k (ops/knn.py). Output mirrors the reference's
+``Tuple3<winStart, winEnd, PQ<(obj, dist)>>``: a KnnWindowResult carrying
+the ordered (objID, dist, representative object) list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
+from spatialflink_tpu.operators.base import (
+    SpatialOperator,
+    flags_for_queries,
+    jitted,
+    pack_query_geometries,
+)
+from spatialflink_tpu.ops.cells import gather_cell_flags
+from spatialflink_tpu.ops.knn import (
+    knn_geometry_stream_kernel,
+    knn_kernel,
+    knn_polygon_query_kernel,
+)
+from spatialflink_tpu.utils.padding import next_bucket
+
+
+@dataclass
+class KnnWindowResult:
+    """Ordered top-k per window (ascending distance, objID-deduped)."""
+
+    start: int
+    end: int
+    neighbors: List[Tuple[str, float, SpatialObject]]  # (objID, dist, object)
+    window_count: int
+
+
+class _PointStreamKNNQuery(SpatialOperator):
+    """Point stream; query = point / polygon / linestring."""
+
+    query_kind = "point"
+
+    def run(
+        self,
+        stream: Iterable[Point],
+        query_obj: SpatialObject,
+        radius: float,
+        k: int,
+        dtype=np.float64,
+    ) -> Iterator[KnnWindowResult]:
+        flags = flags_for_queries(self.grid, radius, [query_obj])
+        flags_d = jnp.asarray(flags)
+        kp = jitted(knn_kernel, "k", "num_segments")
+        kpoly = jitted(knn_polygon_query_kernel, "k", "num_segments")
+        if self.query_kind == "point":
+            q = jnp.asarray(np.array([query_obj.x, query_obj.y], dtype))
+        else:
+            verts, ev = pack_query_geometries([query_obj], dtype)
+            qv, qe = jnp.asarray(verts[0]), jnp.asarray(ev[0])
+
+        for win in self.windows(stream):
+            batch = self.point_batch(win.events, dtype=dtype)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
+            args = (
+                jnp.asarray(batch.xy),
+                jnp.asarray(batch.valid),
+                pflags,
+                jnp.asarray(batch.oid),
+            )
+            if self.query_kind == "point":
+                res = kp(*args, q, radius, k=k, num_segments=nseg)
+            else:
+                res = kpoly(*args, qv, qe, radius, k=k, num_segments=nseg)
+            yield self._decode(win, res, k)
+
+    def _decode(self, win, res, k) -> KnnWindowResult:
+        nv = int(res.num_valid)
+        segs = np.asarray(res.segment[:nv])
+        dists = np.asarray(res.dist[:nv])
+        idxs = np.asarray(res.index[:nv])
+        neighbors = [
+            (self.interner.lookup(int(s)), float(d), win.events[int(i)])
+            for s, d, i in zip(segs, dists, idxs)
+        ]
+        return KnnWindowResult(win.start, win.end, neighbors, len(win.events))
+
+
+class PointPointKNNQuery(_PointStreamKNNQuery):
+    """knn/PointPointKNNQuery.java:132-201 (+ KNNQuery.java merge)."""
+
+    query_kind = "point"
+
+
+class PointPolygonKNNQuery(_PointStreamKNNQuery):
+    """knn/PointPolygonKNNQuery.java:67-88 (incl. runLatency variants —
+    latency accounting lives in the metrics layer here)."""
+
+    query_kind = "polygon"
+
+
+class PointLineStringKNNQuery(_PointStreamKNNQuery):
+    """knn/PointLineStringKNNQuery.java."""
+
+    query_kind = "linestring"
+
+
+class _GeometryStreamKNNQuery(SpatialOperator):
+    """Polygon/LineString stream; query point (or geometry centroid).
+
+    Distance per object = min distance from the query to the object's
+    boundary edges, as the reference's Polygon/LineString KNN loops do.
+    """
+
+    def run(
+        self,
+        stream: Iterable[Polygon | LineString],
+        query_obj: SpatialObject,
+        radius: float,
+        k: int,
+        dtype=np.float64,
+    ) -> Iterator[KnnWindowResult]:
+        flags = flags_for_queries(self.grid, radius, [query_obj])
+        kg = jitted(knn_geometry_stream_kernel, "k", "num_segments")
+        if isinstance(query_obj, Point):
+            q = np.array([query_obj.x, query_obj.y], dtype)
+        else:
+            b = query_obj.bbox()
+            q = np.array([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2], dtype)
+        q = jnp.asarray(q)
+
+        for win in self.windows(stream):
+            batch = self.geometry_batch(win.events, dtype=dtype)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            oflags = batch.any_cell_flagged(self.grid, flags)
+            res = kg(
+                jnp.asarray(batch.verts),
+                jnp.asarray(batch.edge_valid),
+                jnp.asarray(batch.valid),
+                jnp.asarray(oflags),
+                jnp.asarray(batch.oid),
+                q,
+                radius,
+                k=k,
+                num_segments=nseg,
+            )
+            nv = int(res.num_valid)
+            neighbors = [
+                (
+                    self.interner.lookup(int(res.segment[i])),
+                    float(res.dist[i]),
+                    win.events[int(res.index[i])],
+                )
+                for i in range(nv)
+            ]
+            yield KnnWindowResult(win.start, win.end, neighbors, len(win.events))
+
+
+class PolygonPointKNNQuery(_GeometryStreamKNNQuery):
+    """knn/PolygonPointKNNQuery.java."""
+
+
+class PolygonPolygonKNNQuery(_GeometryStreamKNNQuery):
+    """knn/PolygonPolygonKNNQuery.java."""
+
+
+class PolygonLineStringKNNQuery(_GeometryStreamKNNQuery):
+    """knn/PolygonLineStringKNNQuery.java."""
+
+
+class LineStringPointKNNQuery(_GeometryStreamKNNQuery):
+    """knn/LineStringPointKNNQuery.java."""
+
+
+class LineStringPolygonKNNQuery(_GeometryStreamKNNQuery):
+    """knn/LineStringPolygonKNNQuery.java."""
+
+
+class LineStringLineStringKNNQuery(_GeometryStreamKNNQuery):
+    """knn/LineStringLineStringKNNQuery.java."""

@@ -111,6 +111,65 @@ def join_kernel(
     )
 
 
+def point_geometry_join_kernel(
+    pxy: jnp.ndarray,
+    pvalid: jnp.ndarray,
+    gverts: jnp.ndarray,
+    gev: jnp.ndarray,
+    gvalid: jnp.ndarray,
+    radius,
+    polygonal: bool = True,
+):
+    """Point batch ⋈ geometry batch: (M, N) mask + distances.
+
+    JTS semantics: distance 0 for points inside polygonal geometries. The
+    batched form of join/PointPolygonJoinQuery's window loop. Note the grid
+    prune of the reference is purely a shuffle optimization — the distance
+    filter decides membership, so the dense masked evaluation returns the
+    identical pair set.
+    """
+    from spatialflink_tpu.ops.polygon import points_in_polygon
+    from spatialflink_tpu.ops.distances import point_polyline_distance
+
+    def one_geom(verts, ev):
+        d = point_polyline_distance(pxy, verts, ev)
+        if polygonal:
+            inside = points_in_polygon(pxy, verts, ev)
+            d = jnp.where(inside, jnp.zeros((), d.dtype), d)
+        return d
+
+    d = jax.vmap(one_geom)(gverts, gev)  # (M, N)
+    mask = (d <= radius) & pvalid[None, :] & gvalid[:, None]
+    return mask, d
+
+
+def geometry_geometry_join_kernel(
+    averts: jnp.ndarray,
+    aev: jnp.ndarray,
+    avalid: jnp.ndarray,
+    bverts: jnp.ndarray,
+    bev: jnp.ndarray,
+    bvalid: jnp.ndarray,
+    radius,
+    a_polygonal: bool = True,
+    b_polygonal: bool = True,
+):
+    """Geometry ⋈ geometry: (L, R) mask + JTS-compatible distances
+    (overlap/containment → 0 via geometry_pair_distance)."""
+    from spatialflink_tpu.ops.range import geometry_pair_distance
+
+    def pair(av, ae):
+        return jax.vmap(
+            lambda bv, be: geometry_pair_distance(
+                av, ae, bv, be, a_polygonal, b_polygonal
+            )
+        )(bverts, bev)
+
+    d = jax.vmap(pair)(averts, aev)  # (L, R)
+    mask = (d <= radius) & avalid[:, None] & bvalid[None, :]
+    return mask, d
+
+
 def cross_join_kernel(
     left_xy: jnp.ndarray,
     left_valid: jnp.ndarray,

@@ -26,6 +26,14 @@ import jax.numpy as jnp
 from spatialflink_tpu.ops.distances import pairwise_distance, point_polyline_distance
 from spatialflink_tpu.ops.polygon import points_in_polygon
 
+__all__ = [
+    "range_query_kernel",
+    "range_query_polygons_kernel",
+    "range_query_polylines_kernel",
+    "geometry_range_query_kernel",
+    "geometry_pair_distance",
+]
+
 
 def _emit_mask(valid, flags, min_dist, radius, approximate: bool):
     guaranteed = flags == 2
@@ -103,6 +111,46 @@ def range_query_polylines_kernel(
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist
 
 
+def _vert_valid(edge_valid: jnp.ndarray) -> jnp.ndarray:
+    """(V-1,) edge mask → (V,) vertex mask (a vertex is real if it bounds a
+    real edge)."""
+    z = jnp.zeros((1,), bool)
+    return jnp.concatenate([edge_valid, z]) | jnp.concatenate([z, edge_valid])
+
+
+def geometry_pair_distance(
+    averts: jnp.ndarray,
+    aev: jnp.ndarray,
+    bverts: jnp.ndarray,
+    bev: jnp.ndarray,
+    a_polygonal: bool = False,
+    b_polygonal: bool = False,
+) -> jnp.ndarray:
+    """JTS-compatible distance between two packed boundaries (scalars).
+
+    Non-overlapping: min over vertex→other-boundary distances both ways
+    (exact for polyline pairs, since the closest approach involves a vertex
+    of one of them). Overlap/containment: JTS returns 0 when geometries
+    intersect — detected here as any valid vertex of one polygonal geometry
+    containing a vertex of the other (and vice versa). Edge-crossing overlap
+    with no contained vertex yields a near-zero edge distance already.
+    """
+    big = jnp.asarray(jnp.finfo(averts.dtype).max, averts.dtype)
+    a_ok = _vert_valid(aev)
+    b_ok = _vert_valid(bev)
+    d_ab = jnp.where(a_ok, point_polyline_distance(averts, bverts, bev), big)
+    d_ba = jnp.where(b_ok, point_polyline_distance(bverts, averts, aev), big)
+    d = jnp.minimum(jnp.min(d_ab), jnp.min(d_ba))
+    zero = jnp.zeros((), averts.dtype)
+    if b_polygonal:
+        a_in_b = jnp.any(points_in_polygon(averts, bverts, bev) & a_ok)
+        d = jnp.where(a_in_b, zero, d)
+    if a_polygonal:
+        b_in_a = jnp.any(points_in_polygon(bverts, averts, aev) & b_ok)
+        d = jnp.where(b_in_a, zero, d)
+    return d
+
+
 def geometry_range_query_kernel(
     obj_verts: jnp.ndarray,
     obj_edge_valid: jnp.ndarray,
@@ -112,34 +160,22 @@ def geometry_range_query_kernel(
     query_edge_valid: jnp.ndarray,
     radius,
     approximate: bool = False,
+    obj_polygonal: bool = False,
+    query_polygonal: bool = False,
 ):
     """Geometry stream (polygons/linestrings) vs geometry query set.
 
-    ``obj_verts``: (N, V, 2) per-object packed boundaries. Distance between
-    two boundaries = min over vertex→other-boundary distances both ways —
-    the exact JTS ``geometry.distance`` result for non-overlapping
-    geometries, which is what the reference computes per pair in e.g.
-    PolygonPolygonRangeQuery's window loop. Overlap (distance 0 in JTS) is
-    approximated by near-zero edge distance; containment-without-touching is
-    handled by the operator layer's host check when exactness is required.
+    ``obj_verts``: (N, V, 2) per-object packed boundaries; distances via
+    ``geometry_pair_distance`` (JTS semantics incl. overlap→0) — the batched
+    form of e.g. PolygonPolygonRangeQuery's window loop.
     """
-    def pair_dist(averts, aev):
-        def to_query(qverts, qev):
-            d_ab = point_polyline_distance(averts, qverts, qev)
-            big = jnp.asarray(jnp.finfo(d_ab.dtype).max, d_ab.dtype)
-            a_vert_valid = jnp.concatenate(
-                [aev, jnp.zeros((1,), bool)]
-            ) | jnp.concatenate([jnp.zeros((1,), bool), aev])
-            d_ab = jnp.where(a_vert_valid, d_ab, big)
-            d_ba = point_polyline_distance(qverts, averts, aev)
-            q_vert_valid = jnp.concatenate(
-                [qev, jnp.zeros((1,), bool)]
-            ) | jnp.concatenate([jnp.zeros((1,), bool), qev])
-            d_ba = jnp.where(q_vert_valid, d_ba, big)
-            return jnp.minimum(jnp.min(d_ab), jnp.min(d_ba))
+    def pair(averts, aev):
+        return jax.vmap(
+            lambda qverts, qev: geometry_pair_distance(
+                averts, aev, qverts, qev, obj_polygonal, query_polygonal
+            )
+        )(query_verts, query_edge_valid)  # (Q,)
 
-        return jax.vmap(to_query)(query_verts, query_edge_valid)  # (Q,)
-
-    d = jax.vmap(pair_dist)(obj_verts, obj_edge_valid)  # (N, Q)
+    d = jax.vmap(pair)(obj_verts, obj_edge_valid)  # (N, Q)
     min_dist = jnp.min(d, axis=1)
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist

@@ -1,0 +1,18 @@
+from spatialflink_tpu.streams.windows import (  # noqa: F401
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+    CountWindows,
+    WindowAssembler,
+    WindowBatch,
+)
+from spatialflink_tpu.streams.sources import (  # noqa: F401
+    collection_source,
+    csv_source,
+    socket_source,
+    SyntheticGpsSource,
+)
+from spatialflink_tpu.streams.sinks import (  # noqa: F401
+    CollectSink,
+    CsvFileSink,
+    PrintSink,
+)

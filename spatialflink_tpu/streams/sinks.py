@@ -1,0 +1,98 @@
+"""Stream sinks: collection, CSV file, print, and latency-measuring sinks.
+
+Counterparts of the reference's result sinks: StringResultCollectorSink
+(sncb/tests/MobilityQueryRunner.java), per-query CSV file sinks
+(MobilityRunner.java:40-66), and the Kafka latency sinks
+(HelperClass.LatencySink*, HelperClass.java:455-529).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+
+class CollectSink:
+    """Collect results in memory (tests and runners)."""
+
+    def __init__(self):
+        self.items: List[Any] = []
+
+    def __call__(self, item: Any):
+        self.items.append(item)
+
+    def __len__(self):
+        return len(self.items)
+
+
+class PrintSink:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.count = 0
+
+    def __call__(self, item: Any):
+        print(f"{self.prefix}{item}")
+        self.count += 1
+
+
+class CsvFileSink:
+    """Write one formatted line per record, flushing each write (the
+    reference's file sinks flush per record for benchmark fidelity,
+    com/mn/sinks/CountingLatencyFileSink.java:23-70)."""
+
+    def __init__(
+        self,
+        path: str,
+        formatter: Callable[[Any], str] = str,
+        header: Optional[str] = None,
+        flush_every: int = 1,
+    ):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.formatter = formatter
+        self.flush_every = max(1, flush_every)
+        self._f = open(path, "w")
+        if header:
+            self._f.write(header.rstrip("\n") + "\n")
+        self.count = 0
+
+    def __call__(self, item: Any):
+        self._f.write(self.formatter(item) + "\n")
+        self.count += 1
+        if self.count % self.flush_every == 0:
+            self._f.flush()
+
+    def close(self):
+        self._f.flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LatencySink:
+    """Record per-item latency = now − event/ingestion time.
+
+    ``time_fn(item)`` extracts the reference instant in seconds.
+    The reference's Kafka latency sinks compute now − ingestionTime
+    (HelperClass.java:455-529)."""
+
+    def __init__(self, time_fn: Callable[[Any], float]):
+        self.time_fn = time_fn
+        self.latencies_ms: List[float] = []
+
+    def __call__(self, item: Any):
+        t = self.time_fn(item)
+        if t is not None:
+            self.latencies_ms.append((time.time() - t) * 1000.0)
+
+    def percentile(self, q: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        import numpy as np
+
+        return float(np.percentile(self.latencies_ms, q))

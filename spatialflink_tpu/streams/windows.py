@@ -1,0 +1,183 @@
+"""Event-time windowing — the host control plane.
+
+The reference's windowing is Flink's: sliding/tumbling windows with
+bounded-out-of-orderness watermarks and allowed lateness (e.g.
+PointPointRangeQuery.java:127-133 assigns
+``BoundedOutOfOrdernessTimestampExtractor(allowedLateness)`` then windows by
+``SlidingProcessingTimeWindows.of(size, slide)``). Here windowing is an
+explicit host-side assembler that buffers events per window and fires
+batches when the watermark passes the window end — the batch then ships to
+one TPU kernel call, replacing the per-record window ``apply`` loop.
+
+Semantics notes (documented deviation, SURVEY.md §7 "hard parts"): the
+reference mixes event-time watermark assignment with *processing-time*
+window triggers in most window-based paths. This assembler implements true
+event-time windows (the principled behavior) and a processing-time mode for
+faithful benchmark comparison.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Generic, Iterable, Iterator, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    start: int  # ms, inclusive
+    end: int  # ms, exclusive
+
+
+@dataclass
+class WindowBatch(Generic[T]):
+    """A fired window: its span and the buffered events."""
+
+    start: int
+    end: int
+    events: List[T]
+    # Wall-clock time when the window fired (for latency accounting).
+    fire_time: float = field(default_factory=time.time)
+
+
+class SlidingEventTimeWindows:
+    """Flink-compatible sliding window assignment.
+
+    ``size``/``slide`` in ms. Window starts are the multiples of ``slide``
+    (offset 0) with start > ts - size, start <= ts — the same assignment as
+    Flink's SlidingEventTimeWindows (used via
+    SlidingProcessingTimeWindows.of(Time.seconds(w), Time.seconds(s)) in
+    e.g. PointPointRangeQuery.java:149).
+    """
+
+    def __init__(self, size_ms: int, slide_ms: int):
+        if size_ms <= 0 or slide_ms <= 0:
+            raise ValueError("size and slide must be positive")
+        self.size = int(size_ms)
+        self.slide = int(slide_ms)
+
+    def assign(self, ts: int) -> List[WindowSpec]:
+        last_start = ts - ((ts % self.slide) + self.slide) % self.slide
+        out = []
+        start = last_start
+        while start > ts - self.size:
+            out.append(WindowSpec(start, start + self.size))
+            start -= self.slide
+        return out
+
+
+class TumblingEventTimeWindows(SlidingEventTimeWindows):
+    """size == slide (StreamingJob wires window.type TIME, interval==step)."""
+
+    def __init__(self, size_ms: int):
+        super().__init__(size_ms, size_ms)
+
+
+class CountWindows:
+    """Per-key count windows (size, slide) — the CheckIn app uses
+    countWindow(2, 1) and countWindow(1) (apps/CheckIn.java:26-60)."""
+
+    def __init__(self, size: int, slide: Optional[int] = None):
+        self.size = int(size)
+        self.slide = int(slide) if slide is not None else self.size
+
+    def feed(self, buf: List[T], event: T) -> List[List[T]]:
+        """Append to a per-key buffer; return fired windows (lists)."""
+        buf.append(event)
+        fired = []
+        while len(buf) >= self.size:
+            fired.append(buf[: self.size])
+            del buf[: self.slide]
+            if self.slide == 0:
+                break
+        return fired
+
+
+class WindowAssembler(Generic[T]):
+    """Buffers timestamped events into sliding windows; fires on watermark.
+
+    Watermark = max event time − max_out_of_orderness (Flink's
+    BoundedOutOfOrdernessTimestampExtractor). A window fires when the
+    watermark passes its end; events arriving after the fire but within
+    ``allowed_lateness`` of the watermark re-fire the window with the late
+    events included (Flink's allowed-lateness refire). Events later than
+    that are dropped and counted.
+    """
+
+    def __init__(
+        self,
+        windows: SlidingEventTimeWindows,
+        timestamp_fn: Callable[[T], int],
+        max_out_of_orderness_ms: int = 0,
+        allowed_lateness_ms: int = 0,
+    ):
+        self.windows = windows
+        self.timestamp_fn = timestamp_fn
+        self.ooo = int(max_out_of_orderness_ms)
+        self.lateness = int(allowed_lateness_ms)
+        self._buffers: Dict[WindowSpec, List[T]] = {}
+        self._fired: Dict[WindowSpec, bool] = {}
+        self._max_ts: Optional[int] = None
+        self.dropped_late = 0
+
+    @property
+    def watermark(self) -> int:
+        if self._max_ts is None:
+            return -(2**62)
+        return self._max_ts - self.ooo
+
+    def feed(self, event: T) -> List[WindowBatch[T]]:
+        """Add one event; return any windows that fire as a result."""
+        ts = int(self.timestamp_fn(event))
+        if self._max_ts is None or ts > self._max_ts:
+            self._max_ts = ts
+        wm = self.watermark
+
+        fired: List[WindowBatch[T]] = []
+        for spec in self.windows.assign(ts):
+            if spec.end + self.lateness <= wm:
+                self.dropped_late += 1
+                continue
+            buf = self._buffers.setdefault(spec, [])
+            buf.append(event)
+            if self._fired.get(spec):
+                # Late-but-allowed: refire immediately with the late event.
+                fired.append(WindowBatch(spec.start, spec.end, list(buf)))
+
+        fired.extend(self._advance(wm))
+        return fired
+
+    def _advance(self, wm: int) -> List[WindowBatch[T]]:
+        fired = []
+        for spec in sorted(self._buffers, key=lambda s: s.end):
+            if spec.end <= wm and not self._fired.get(spec):
+                fired.append(WindowBatch(spec.start, spec.end, list(self._buffers[spec])))
+                self._fired[spec] = True
+        # Garbage-collect windows past the lateness horizon. The fired-flag
+        # entry goes too: re-entry of a GC'd window is already blocked by the
+        # spec.end + lateness <= wm check in feed(), and keeping the flags
+        # would leak one entry per window forever on unbounded streams.
+        for spec in [s for s in self._buffers if s.end + self.lateness <= wm]:
+            if not self._fired.get(spec):
+                fired.append(WindowBatch(spec.start, spec.end, list(self._buffers[spec])))
+            del self._buffers[spec]
+            self._fired.pop(spec, None)
+        return fired
+
+    def flush(self) -> List[WindowBatch[T]]:
+        """End of stream: fire every remaining un-fired window."""
+        out = []
+        for spec in sorted(self._buffers, key=lambda s: s.end):
+            if not self._fired.get(spec):
+                out.append(WindowBatch(spec.start, spec.end, list(self._buffers[spec])))
+                self._fired[spec] = True
+        self._buffers.clear()
+        return out
+
+    def stream(self, source: Iterable[T]) -> Iterator[WindowBatch[T]]:
+        """Convenience: drive a whole source through the assembler."""
+        for ev in source:
+            yield from self.feed(ev)
+        yield from self.flush()

@@ -1,0 +1,103 @@
+"""Config-system tests: the reference's geoflink-conf.yml schema loads
+unchanged (modulo the Java type tag) and validation is strict."""
+
+import pytest
+
+from spatialflink_tpu.config import ConfigError, Params
+
+REFERENCE_YML = """\
+!!GeoFlink.utils.ConfigType
+clusterMode: False
+kafkaBootStrapServers: "localhost:9092"
+inputStream1:
+  topicName: "TaxiDrive17MillionGeoJSON"
+  format: "GeoJSON"
+  dateFormat: "yyyy-MM-dd HH:mm:ss"
+  geoJSONSchemaAttr: ["oID", "timestamp"]
+  csvTsvSchemaAttr: [1, 4, 5, 6]
+  gridBBox: [115.5, 39.6, 117.6, 41.1]
+  numGridCells: 100
+  cellLength: 0
+  delimiter: ","
+  charset: "UTF-8"
+outputStream:
+  topicName: "outputTopic"
+  delimiter: ","
+query:
+  option: 2
+  parallelism: 15
+  approximate: False
+  radius: 10.5
+  aggregateFunction: "SUM"
+  k: 100
+  omegaDuration: 1
+  trajIDs: [123, 231]
+  queryPoints:
+    - [116.14319, 40.07271]
+    - [117.6, 40.5]
+  queryPolygons:
+    - [[116.5, 40.5], [117.6, 40.5], [117.6, 41.4], [116.5, 41.4], [116.5, 40.5]]
+  queryLineStrings:
+    - [[116.5, 40.5], [117.6, 40.5], [117.6, 41.4], [116.5, 41.4]]
+  thresholds:
+    trajDeletion: 1000
+    outOfOrderTuples: 1
+window:
+  type: "TIME"
+  interval: 5
+  step: 5
+"""
+
+
+def test_reference_yml_loads():
+    p = Params.loads(REFERENCE_YML)
+    assert p.cluster_mode is False
+    assert p.input_stream1.topic_name == "TaxiDrive17MillionGeoJSON"
+    assert p.input_stream1.grid_bbox == [115.5, 39.6, 117.6, 41.1]
+    assert p.query.k == 100
+    assert p.query.parallelism == 15
+    assert p.query.query_points[0] == [116.14319, 40.07271]
+    assert len(p.query.query_polygons[0]) == 5
+    assert p.query.traj_deletion_threshold == 1000
+    assert p.window.interval_ms == 5000 and p.window.step_ms == 5000
+    assert p.backend == "tpu"  # default extension
+
+
+def test_grid_from_config():
+    p = Params.loads(REFERENCE_YML)
+    g = p.input_stream1.make_grid()
+    assert g.n == 100
+    assert g.min_x == 115.5
+
+
+def test_missing_input_stream_fails():
+    with pytest.raises(ConfigError, match="inputStream1"):
+        Params.loads("clusterMode: False")
+
+
+def test_bad_format_fails():
+    bad = REFERENCE_YML.replace('format: "GeoJSON"', 'format: "XML"')
+    with pytest.raises(ConfigError, match="format"):
+        Params.loads(bad)
+
+
+def test_degenerate_bbox_fails():
+    bad = REFERENCE_YML.replace(
+        "gridBBox: [115.5, 39.6, 117.6, 41.1]", "gridBBox: [115.5, 39.6, 115.5, 41.1]"
+    )
+    with pytest.raises(ConfigError, match="degenerate"):
+        Params.loads(bad)
+
+
+def test_bad_aggregate_fails():
+    bad = REFERENCE_YML.replace('aggregateFunction: "SUM"', 'aggregateFunction: "MEDIAN"')
+    with pytest.raises(ConfigError, match="aggregateFunction"):
+        Params.loads(bad)
+
+
+def test_backend_extension():
+    p = Params.loads(REFERENCE_YML + "\nbackend: cpu\ndeviceMesh: [2, 4]\n")
+    assert p.backend == "cpu"
+    assert p.device_mesh == [2, 4]
+    with pytest.raises(ConfigError, match="backend"):
+        Params.loads(REFERENCE_YML + "\nbackend: cuda\n")

@@ -1,0 +1,246 @@
+"""End-to-end operator tests: source → windows → TPU kernel → results.
+
+This is the reference's StreamingJob case-1 slice (SURVEY.md §7 "minimum
+end-to-end slice") plus kNN and join pipelines, checked against brute-force
+window recomputation.
+"""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import LineString, Point, Polygon
+from spatialflink_tpu.operators import (
+    PointPointJoinQuery,
+    PointPointKNNQuery,
+    PointPointRangeQuery,
+    PointPolygonRangeQuery,
+    PolygonPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.streams.sources import SyntheticGpsSource
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+
+
+def synth_points(rng, n=400, t_span=30_000):
+    pts = []
+    for i in range(n):
+        pts.append(
+            Point(
+                obj_id=f"dev{i % 7}",
+                timestamp=int(i * t_span / n),
+                x=float(rng.uniform(0, 10)),
+                y=float(rng.uniform(0, 10)),
+            )
+        )
+    return pts
+
+
+def windows_brute(points, size, slide, t_max):
+    out = {}
+    start = 0
+    while start < t_max:
+        out[(start, start + size)] = [
+            p for p in points if start <= p.timestamp < start + size
+        ]
+        start += slide
+    return out
+
+
+def test_range_query_end_to_end(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng)
+    q = Point(x=5.0, y=5.0)
+    r = 2.0
+    results = list(PointPointRangeQuery(conf, GRID).run(iter(pts), [q], r))
+    assert results
+    seen_spans = set()
+    for res in results:
+        seen_spans.add((res.start, res.end))
+        expect = {
+            id(p)
+            for p in pts
+            if res.start <= p.timestamp < res.end
+            and np.hypot(p.x - 5.0, p.y - 5.0) <= r
+        }
+        got = {id(p) for p in res.objects}
+        assert got == expect, (res.start, res.end)
+    # Sliding 10s/5s over 30s of data: spans at 0,5,...
+    assert (0, 10_000) in seen_spans and (5_000, 15_000) in seen_spans
+
+
+def test_range_query_realtime_microbatches(rng):
+    conf = QueryConfiguration(QueryType.RealTime, realtime_batch_ms=1_000)
+    pts = synth_points(rng, n=100, t_span=5_000)
+    q = Point(x=5.0, y=5.0)
+    results = list(PointPointRangeQuery(conf, GRID).run(iter(pts), [q], 3.0))
+    # ~5 micro-batches of 1s each
+    assert 4 <= len(results) <= 6
+    for res in results:
+        assert res.end - res.start == 1_000
+
+
+def test_point_polygon_range(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    pts = synth_points(rng)
+    poly = Polygon(rings=[np.array([[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]], float)])
+    results = list(PointPolygonRangeQuery(conf, GRID).run(iter(pts), [poly], 0.5))
+    total = sum(len(r.objects) for r in results)
+    # brute force over all points (single 30s window covers everything)
+    def d(p):
+        if 4 <= p.x <= 6 and 4 <= p.y <= 6:
+            return 0.0
+        dx = max(4 - p.x, 0, p.x - 6)
+        dy = max(4 - p.y, 0, p.y - 6)
+        return np.hypot(dx, dy)
+
+    expect = sum(1 for p in pts if d(p) <= 0.5)
+    assert total == expect
+
+
+def test_polygon_stream_point_query(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    polys = []
+    for i in range(40):
+        cx, cy = rng.uniform(1, 9), rng.uniform(1, 9)
+        polys.append(
+            Polygon(
+                obj_id=f"poly{i}",
+                timestamp=i * 100,
+                rings=[np.array([[cx - .3, cy - .3], [cx + .3, cy - .3],
+                                 [cx + .3, cy + .3], [cx - .3, cy + .3],
+                                 [cx - .3, cy - .3]])],
+            )
+        )
+    q = Point(x=5.0, y=5.0)
+    results = list(PolygonPointRangeQuery(conf, GRID).run(iter(polys), [q], 1.0))
+    got = {p.obj_id for r in results for p in r.objects}
+    expect = set()
+    for p in polys:
+        b = p.bbox()
+        dx = max(b[0] - 5.0, 0, 5.0 - b[2])
+        dy = max(b[1] - 5.0, 0, 5.0 - b[3])
+        # square polygons: bbox distance == boundary distance outside;
+        # inside → 0
+        if np.hypot(dx, dy) <= 1.0:
+            expect.add(p.obj_id)
+    assert got == expect
+
+
+def test_knn_query_end_to_end(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    pts = synth_points(rng)
+    q = Point(x=5.0, y=5.0)
+    r, k = 4.0, 5
+    results = list(PointPointKNNQuery(conf, GRID).run(iter(pts), q, r, k))
+    assert results
+    for res in results:
+        window_pts = [p for p in pts if res.start <= p.timestamp < res.end]
+        best = {}
+        for p in window_pts:
+            d = float(np.hypot(p.x - 5.0, p.y - 5.0))
+            if d <= r and (p.obj_id not in best or d < best[p.obj_id]):
+                best[p.obj_id] = d
+        expect = sorted(best.items(), key=lambda kv: kv[1])[:k]
+        got = [(oid, d) for oid, d, _ in res.neighbors]
+        assert [o for o, _ in got] == [o for o, _ in expect]
+        for (_, gd), (_, ed) in zip(got, expect):
+            assert gd == pytest.approx(ed, rel=1e-12)
+
+
+def test_join_query_end_to_end(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    left = synth_points(rng, n=150)
+    right = [
+        Point(obj_id=f"q{i}", timestamp=int(i * 200), x=float(rng.uniform(0, 10)),
+              y=float(rng.uniform(0, 10)))
+        for i in range(100)
+    ]
+    r = 0.7
+    results = list(PointPointJoinQuery(conf, GRID).run(iter(left), iter(right), r))
+    got = {
+        (a.obj_id, a.timestamp, b.obj_id)
+        for res in results
+        for a, b, _ in res.pairs
+    }
+    expect = set()
+    for res_start in (0, 10_000, 20_000):
+        res_end = res_start + 10_000
+        for a in left:
+            if not (res_start <= a.timestamp < res_end):
+                continue
+            for b in right:
+                if not (res_start <= b.timestamp < res_end):
+                    continue
+                if np.hypot(a.x - b.x, a.y - b.y) <= r:
+                    expect.add((a.obj_id, a.timestamp, b.obj_id))
+    assert got == expect
+    assert all(res.overflow == 0 for res in results)
+
+
+def test_join_naive_matches_grid(rng):
+    left = synth_points(rng, n=80)
+    right = synth_points(rng, n=60)
+    for p in right:
+        p.obj_id = "q" + p.obj_id
+    r = 1.1
+    conf_g = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    conf_n = QueryConfiguration(QueryType.RealTimeNaive, realtime_batch_ms=30_000)
+    grid_pairs = {
+        (id(a), id(b))
+        for res in PointPointJoinQuery(conf_g, GRID).run(iter(left), iter(right), r)
+        for a, b, _ in res.pairs
+    }
+    naive_pairs = {
+        (id(a), id(b))
+        for res in PointPointJoinQuery(conf_n, GRID).run(iter(left), iter(right), r)
+        for a, b, _ in res.pairs
+    }
+    assert grid_pairs == naive_pairs
+
+
+def test_synthetic_source_deterministic():
+    src = SyntheticGpsSource(0, 10, 0, 10, target_eps=1000, duration_ms=2000,
+                             num_devices=5, seed=42)
+    a = list(src)
+    b = list(src)
+    assert len(a) == 2000
+    assert [(p.x, p.y, p.timestamp, p.obj_id) for p in a[:50]] == [
+        (p.x, p.y, p.timestamp, p.obj_id) for p in b[:50]
+    ]
+    # Event times advance at target rate: last event ~2s in.
+    assert a[-1].timestamp == pytest.approx(1999, abs=2)
+    assert {p.obj_id for p in a} == {f"dev{i}" for i in range(5)}
+
+
+def test_polygon_join_nested_overlap_is_zero_distance(rng):
+    """JTS returns distance 0 for overlapping/nested geometries — a nested
+    polygon pair must join even though its boundary gap exceeds the radius."""
+    from spatialflink_tpu.operators import PolygonPolygonJoinQuery
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    inner = Polygon(obj_id="inner", timestamp=100,
+                    rings=[np.array([[4.5, 4.5], [5.5, 4.5], [5.5, 5.5], [4.5, 5.5], [4.5, 4.5]])])
+    outer = Polygon(obj_id="outer", timestamp=200,
+                    rings=[np.array([[1, 1], [9, 1], [9, 9], [1, 9], [1, 1]])])
+    far = Polygon(obj_id="far", timestamp=300,
+                  rings=[np.array([[-3, -3], [-2.5, -3], [-2.5, -2.5], [-3, -2.5], [-3, -3]])])
+    results = list(
+        PolygonPolygonJoinQuery(conf, GRID).run(iter([inner, far]), iter([outer]), 1.0)
+    )
+    pairs = {(a.obj_id, b.obj_id) for r in results for a, b, _ in r.pairs}
+    assert ("inner", "outer") in pairs  # nested → dist 0
+    assert ("far", "outer") not in pairs  # corner gap ~4.9 > radius 1.0
+    dists = {(a.obj_id, b.obj_id): d for r in results for a, b, d in r.pairs}
+    assert dists[("inner", "outer")] == 0.0
+
+
+def test_count_based_windows(rng):
+    conf = QueryConfiguration(QueryType.CountBased, count_window_size=50)
+    pts = synth_points(rng, n=120)
+    q = Point(x=5.0, y=5.0)
+    results = list(PointPointRangeQuery(conf, GRID).run(iter(pts), [q], 3.0))
+    # 120 events -> windows of 50, 50, 20
+    assert [r.window_count for r in results] == [50, 50, 20]

@@ -1,0 +1,159 @@
+"""Serde format-contract tests: GeoJSON (Kafka envelope + bare), WKT round
+trips for all 7 geometry types, CSV/TSV schema positions, date formats."""
+
+import json
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.models.objects import (
+    GeometryCollection,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from spatialflink_tpu.streams.serde import (
+    parse_csv_point,
+    parse_geojson,
+    parse_timestamp,
+    parse_wkt,
+    to_csv_point,
+    to_geojson,
+    to_wkt,
+)
+
+# The exact sample from Deserialization.java:121 comment.
+KAFKA_ENVELOPE = (
+    '{"key":136138,"value":{"geometry":{"coordinates":[116.44412,39.93984],'
+    '"type":"Point"},"properties":{"oID":"2560","timestamp":"2008-02-02 20:12:32"},'
+    '"type":"Feature"}}'
+)
+
+
+def test_parse_kafka_envelope_point():
+    p = parse_geojson(KAFKA_ENVELOPE, date_format="yyyy-MM-dd HH:mm:ss")
+    assert isinstance(p, Point)
+    assert p.x == pytest.approx(116.44412)
+    assert p.y == pytest.approx(39.93984)
+    assert p.obj_id == "2560"
+    # 2008-02-02 20:12:32 UTC
+    assert p.timestamp == 1201983152000
+
+
+def test_parse_bare_feature_epoch_ts():
+    rec = {
+        "type": "Feature",
+        "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+        "properties": {"oID": 77, "timestamp": 1234567},
+    }
+    p = parse_geojson(rec)
+    assert p.obj_id == "77" and p.timestamp == 1234567
+
+
+def test_parse_bare_geometry():
+    p = parse_geojson('{"type": "Point", "coordinates": [3.5, 4.5]}')
+    assert (p.x, p.y) == (3.5, 4.5)
+    assert p.obj_id is None
+
+
+def test_geojson_polygon_with_hole_roundtrip():
+    poly = Polygon(
+        obj_id="p1",
+        timestamp=42,
+        rings=[
+            np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], float),
+            np.array([[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]], float),
+        ],
+    )
+    s = to_geojson(poly)
+    back = parse_geojson(s)
+    assert isinstance(back, Polygon)
+    assert len(back.rings) == 2
+    np.testing.assert_allclose(back.rings[1], poly.rings[1])
+
+
+def test_geojson_all_types_roundtrip():
+    objs = [
+        MultiPoint(obj_id="mp", coords=np.array([[1, 2], [3, 4]], float)),
+        MultiLineString(obj_id="ml", parts=[np.array([[0, 0], [1, 1]], float),
+                                            np.array([[2, 2], [3, 3]], float)]),
+        MultiPolygon.from_polygons(
+            [[np.array([[0, 0], [1, 0], [1, 1], [0, 0]], float)],
+             [np.array([[5, 5], [6, 5], [6, 6], [5, 5]], float)]],
+            obj_id="mpoly",
+        ),
+    ]
+    for o in objs:
+        back = parse_geojson(to_geojson(o))
+        assert type(back).__name__ == type(o).__name__
+
+
+def test_wkt_roundtrip_all_types():
+    cases = [
+        Point(x=116.5, y=40.25),
+        LineString(coords=np.array([[0, 0], [1, 1], [2, 0]], float)),
+        Polygon(rings=[np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], float),
+                       np.array([[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]], float)]),
+        MultiPoint(coords=np.array([[1, 2], [3, 4]], float)),
+        MultiLineString(parts=[np.array([[0, 0], [1, 1]], float),
+                               np.array([[2, 2], [3, 3]], float)]),
+        MultiPolygon.from_polygons(
+            [[np.array([[0, 0], [1, 0], [1, 1], [0, 0]], float)],
+             [np.array([[5, 5], [6, 5], [6, 6], [5, 5]], float)]]),
+    ]
+    for obj in cases:
+        wkt = to_wkt(obj)
+        back = parse_wkt(wkt)
+        assert type(back).__name__ == type(obj).__name__, wkt
+        assert to_wkt(back) == wkt
+
+
+def test_wkt_geometry_collection():
+    gc = GeometryCollection(
+        geometries=[Point(x=1, y=2), LineString(coords=np.array([[0, 0], [1, 1]], float))]
+    )
+    wkt = to_wkt(gc)
+    back = parse_wkt(wkt)
+    assert isinstance(back, GeometryCollection)
+    assert len(back.geometries) == 2
+    assert isinstance(back.geometries[0], Point)
+    assert isinstance(back.geometries[1], LineString)
+
+
+def test_wkt_embedded_in_csv_line():
+    # The reference locates "POINT" anywhere in the record
+    # (Deserialization.WKTToSpatial).
+    p = parse_wkt("1351039728.980,9471001,POINT (13.45 52.1),extra")
+    assert (p.x, p.y) == (13.45, 52.1)
+
+
+def test_csv_schema_positions():
+    # csvTsvSchemaAttr [1, 4, 5, 6]-style reordering, with quotes + spaces.
+    line = 'ignored, "veh7", a, b, 123456, 116.5, 40.1'
+    p = parse_csv_point(line, schema=[1, 4, 5, 6], delimiter=",")
+    assert p.obj_id == "veh7"
+    assert p.timestamp == 123456
+    assert (p.x, p.y) == (116.5, 40.1)
+
+
+def test_csv_roundtrip():
+    p = Point(obj_id="o1", timestamp=999, x=1.25, y=-3.5)
+    line = to_csv_point(p)
+    back = parse_csv_point(line, schema=[0, 1, 2, 3])
+    assert (back.obj_id, back.timestamp, back.x, back.y) == ("o1", 999, 1.25, -3.5)
+
+
+def test_tsv_delimiter():
+    line = "veh1\t100\t1.0\t2.0"
+    p = parse_csv_point(line, schema=[0, 1, 2, 3], delimiter="\t")
+    assert p.obj_id == "veh1" and (p.x, p.y) == (1.0, 2.0)
+
+
+def test_parse_timestamp_fallbacks():
+    assert parse_timestamp("123", None) == 123
+    assert parse_timestamp(None, None) == 0
+    assert parse_timestamp("garbage", "yyyy-MM-dd HH:mm:ss") == 0
+    assert parse_timestamp("2008-02-02 20:12:32", "yyyy-MM-dd HH:mm:ss") == 1201983152000
