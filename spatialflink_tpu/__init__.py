@@ -31,4 +31,5 @@ Architecture (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
+from spatialflink_tpu import runtime  # noqa: F401  (configures the XLA cache)
 from spatialflink_tpu.grid import UniformGrid  # noqa: F401

@@ -1,0 +1,6 @@
+from spatialflink_tpu.apps.checkin import CheckInEvent, check_in_query  # noqa: F401
+from spatialflink_tpu.apps.staytime import (  # noqa: F401
+    cell_stay_time,
+    cell_sensor_range_intersection,
+    normalized_cell_stay_time,
+)

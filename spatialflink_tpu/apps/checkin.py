@@ -1,0 +1,78 @@
+"""CheckIn app — room-occupancy tracking (``GeoFlink/apps/CheckIn.java``).
+
+Pipeline parity with CheckIn.CheckInQuery (CheckIn.java:26-60):
+  1. per-user count windows (2, 1): two consecutive events from the same
+     door sensor (e.g. two "roomX-in" in a row) imply a missed opposite
+     event — synthesize it at the midpoint timestamp
+     (ProcessWinForInsertingMissingValues, CheckIn.java:251-321);
+  2. per-room count window (1) with a running occupancy counter:
+     "-in" increments, "-out" decrements; emit
+     (room, capacity, occupancy, wallclock) per event
+     (ProcessForCountingObjects, CheckIn.java:208-249).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+
+@dataclass
+class CheckInEvent:
+    """The reference's check-in Point variant (eventID, deviceID like
+    "room1-in", userID, ts, x, y)."""
+
+    event_id: str
+    device_id: str  # "<room>-in" | "<room>-out"
+    user_id: str
+    timestamp: int
+    x: float = 0.0
+    y: float = 0.0
+
+    @property
+    def room(self) -> str:
+        return self.device_id[: self.device_id.index("-")]
+
+    @property
+    def direction(self) -> str:
+        return self.device_id[self.device_id.index("-") + 1:]
+
+
+def _insert_missing(events: Iterable[CheckInEvent]) -> Iterator[CheckInEvent]:
+    """Per-user sliding count(2,1) pass inserting missing in/out events.
+    Only the previous event per user is needed (bounded state — the
+    reference's count window holds 2)."""
+    last: Dict[str, CheckInEvent] = {}
+    for ev in events:
+        prev = last.get(ev.user_id)
+        last[ev.user_id] = ev
+        if prev is None:
+            # First window holds a single event → emit as-is
+            # (CheckIn.java:272-276).
+            yield ev
+            continue
+        if prev.device_id == ev.device_id:
+            # Two consecutive same-door events → synthesize the opposite
+            # event at the midpoint timestamp (CheckIn.java:286-305).
+            mid_ts = (prev.timestamp + ev.timestamp) // 2
+            flip = "out" if prev.direction == "in" else "in"
+            yield CheckInEvent(
+                ev.event_id, f"{prev.room}-{flip}", ev.user_id, mid_ts,
+                ev.x, ev.y,
+            )
+        yield ev
+
+
+def check_in_query(
+    events: Iterable[CheckInEvent],
+    room_capacities: Dict[str, int],
+) -> Iterator[Tuple[str, Optional[int], int, float]]:
+    """Yield (room, capacity, occupancy, wallclock) per processed event."""
+    occupancy: Dict[str, int] = {}
+    for ev in _insert_missing(events):
+        room = ev.room
+        occupancy[room] = occupancy.get(room, 0) + (
+            1 if ev.direction == "in" else -1
+        )
+        yield (room, room_capacities.get(room), occupancy[room], time.time())

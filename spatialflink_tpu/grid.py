@@ -97,6 +97,13 @@ class UniformGrid:
             return xi * self.n + yi
         return self.num_cells
 
+    def cell_xy_indices_np(self, xy: np.ndarray) -> np.ndarray:
+        """(N, 2) int32 unclamped (xi, yi) floor indices — the join kernel's
+        left-side input (out-of-grid neighbors are masked device-side)."""
+        xi = np.floor((xy[..., 0] - self.min_x) / self.cell_length).astype(np.int32)
+        yi = np.floor((xy[..., 1] - self.min_y) / self.cell_length).astype(np.int32)
+        return np.stack([xi, yi], axis=-1)
+
     def assign_cells_np(self, xy: np.ndarray) -> np.ndarray:
         """Vectorized host-side cell assignment, same contract as ops.assign_cells."""
         xi = np.floor((xy[..., 0] - self.min_x) / self.cell_length).astype(np.int64)

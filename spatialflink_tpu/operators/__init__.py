@@ -26,6 +26,20 @@ from spatialflink_tpu.operators.knn_query import (  # noqa: F401
     LineStringLineStringKNNQuery,
     KnnWindowResult,
 )
+from spatialflink_tpu.operators.trajectory import (  # noqa: F401
+    TRangeQuery,
+    TKNNQuery,
+    TJoinQuery,
+    TAggregateQuery,
+    TStatsQuery,
+    TFilterQuery,
+    PointPolygonTRangeQuery,
+    PointPointTKNNQuery,
+    PointPointTJoinQuery,
+    PointTAggregateQuery,
+    PointTStatsQuery,
+    PointTFilterQuery,
+)
 from spatialflink_tpu.operators.join_query import (  # noqa: F401
     PointPointJoinQuery,
     PointPolygonJoinQuery,

@@ -65,6 +65,25 @@ class _TaggedEvent:
         self.event = event
 
 
+def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets):
+    """Run the grid-hash join kernel over two cell-assigned PointBatches.
+
+    Shared by PointPointJoinQuery and TJoinQuery — the one place that wires
+    batches into ops.join.join_kernel."""
+    jk = jitted(join_kernel, "grid_n", "cap")
+    cells_sorted, order = sort_by_cell(
+        jnp.asarray(right_batch.cell), grid.num_cells
+    )
+    left_ci = grid.cell_xy_indices_np(left_batch.xy)
+    return jk(
+        jnp.asarray(left_batch.xy), jnp.asarray(left_batch.valid),
+        jnp.asarray(left_ci),
+        jnp.asarray(right_batch.xy)[order], jnp.asarray(right_batch.valid)[order],
+        cells_sorted, order, offsets,
+        grid_n=grid.n, radius=radius, cap=cap,
+    )
+
+
 class PointPointJoinQuery(SpatialOperator):
     """join/PointPointJoinQuery.java (windowBased :124-183, naive :186-243)."""
 
@@ -83,7 +102,6 @@ class PointPointJoinQuery(SpatialOperator):
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
         )
-        jk = jitted(join_kernel, "grid_n", "cap")
         ck = jitted(cross_join_kernel)
         offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
         naive = self.conf.query_type == QueryType.RealTimeNaive
@@ -102,15 +120,8 @@ class PointPointJoinQuery(SpatialOperator):
                     jnp.asarray(rb.xy), jnp.asarray(rb.valid), radius,
                 )
             else:
-                cells_sorted, order = sort_by_cell(jnp.asarray(rb.cell), self.grid.num_cells)
-                xi = np.floor((lb.xy[:, 0] - self.grid.min_x) / self.grid.cell_length).astype(np.int32)
-                yi = np.floor((lb.xy[:, 1] - self.grid.min_y) / self.grid.cell_length).astype(np.int32)
-                res = jk(
-                    jnp.asarray(lb.xy), jnp.asarray(lb.valid),
-                    jnp.asarray(np.stack([xi, yi], 1)),
-                    jnp.asarray(rb.xy)[order], jnp.asarray(rb.valid)[order],
-                    cells_sorted, order, offsets,
-                    grid_n=self.grid.n, radius=radius, cap=self.cap,
+                res = grid_hash_join_batches(
+                    self.grid, lb, rb, radius, self.cap, offsets
                 )
             pm = np.asarray(res.pair_mask)
             ri = np.asarray(res.right_index)

@@ -29,6 +29,7 @@ from spatialflink_tpu.ops.knn import (
     knn_geometry_stream_kernel,
     knn_kernel,
     knn_polygon_query_kernel,
+    knn_polyline_query_kernel,
 )
 from spatialflink_tpu.utils.padding import next_bucket
 
@@ -59,7 +60,12 @@ class _PointStreamKNNQuery(SpatialOperator):
         flags = flags_for_queries(self.grid, radius, [query_obj])
         flags_d = jnp.asarray(flags)
         kp = jitted(knn_kernel, "k", "num_segments")
-        kpoly = jitted(knn_polygon_query_kernel, "k", "num_segments")
+        kpoly = jitted(
+            knn_polygon_query_kernel
+            if self.query_kind == "polygon"
+            else knn_polyline_query_kernel,
+            "k", "num_segments",
+        )
         if self.query_kind == "point":
             q = jnp.asarray(np.array([query_obj.x, query_obj.y], dtype))
         else:

@@ -1,0 +1,471 @@
+"""Trajectory-stream operators: tRange, tKnn, tJoin, tAggregate, tStats,
+tFilter — the ``spatialOperators/t*`` families re-designed as segment
+reductions over windowed batches.
+
+Reference surface kept: ``TRangeQuery``, ``TKNNQuery``, ``TJoinQuery``,
+``TAggregateQuery``, ``TStatsQuery``, ``TFilterQuery`` with the concrete
+Point* aliases. Output objects mirror the reference's tuples (windowed
+sub-trajectory LineStrings, per-cell aggregates, per-trajectory stats).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spatialflink_tpu.models.batch import PointBatch
+from spatialflink_tpu.models.objects import LineString, Point, Polygon
+from spatialflink_tpu.operators.base import (
+    SpatialOperator,
+    flags_for_queries,
+    jitted,
+    pack_query_geometries,
+)
+from spatialflink_tpu.operators.join_query import _TaggedEvent, merge_by_timestamp
+from spatialflink_tpu.ops.cells import gather_cell_flags
+from spatialflink_tpu.ops.knn import knn_kernel
+from spatialflink_tpu.ops.polygon import points_in_polygon
+from spatialflink_tpu.ops.trajectory import (
+    traj_cell_spans_kernel,
+    traj_hits_kernel,
+    traj_stats_kernel,
+)
+from spatialflink_tpu.streams.windows import WindowBatch
+from spatialflink_tpu.utils.padding import next_bucket
+
+
+def sub_trajectory(events: Sequence[Point], obj_id: str, win_start: int) -> LineString:
+    """Windowed sub-trajectory LineString: points of one objID sorted by ts
+    (GenerateWindowedTrajectory, tJoin/TJoinQuery.java:165-192)."""
+    pts = sorted(events, key=lambda p: p.timestamp)
+    coords = np.array([[p.x, p.y] for p in pts], float)
+    return LineString(obj_id=obj_id, timestamp=win_start, coords=coords)
+
+
+def group_by_oid(events: Sequence[Point]) -> Dict[str, List[Point]]:
+    groups: Dict[str, List[Point]] = {}
+    for p in events:
+        groups.setdefault(p.obj_id, []).append(p)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# tRange
+
+
+@dataclass
+class TRangeResult:
+    start: int
+    end: int
+    trajectories: List[LineString]  # one windowed sub-trajectory per hit objID
+    window_count: int
+
+
+class TRangeQuery(SpatialOperator):
+    """Trajectory range vs polygon set: a trajectory qualifies if any of its
+    window points lies inside any query polygon
+    (tRange/TRangeQuery.java:33-63, PointPolygonTRangeQuery.java:53-177).
+    Grid prefilter: only points whose cell is flagged for some polygon's
+    gridIDsSet (radius 0 → candidate cells only) reach the containment test.
+    """
+
+    def run(
+        self,
+        stream: Iterable[Point],
+        query_polygons: Sequence[Polygon],
+        dtype=np.float64,
+    ) -> Iterator[TRangeResult]:
+        verts, ev = pack_query_geometries(query_polygons, dtype)
+        qv, qe = jnp.asarray(verts), jnp.asarray(ev)
+
+        def containment(xy, valid, oid, num_segments):
+            inside = jax.vmap(lambda v, e: points_in_polygon(xy, v, e))(qv, qe)
+            return traj_hits_kernel(jnp.any(inside, axis=0), oid, valid, num_segments)
+
+        kern = jax.jit(containment, static_argnames=("num_segments",))
+
+        for win in self.windows(stream):
+            batch = self.point_batch(win.events, dtype=dtype)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            hits = np.asarray(
+                kern(jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                     jnp.asarray(batch.oid), num_segments=nseg)
+            )
+            groups = group_by_oid(win.events)
+            out = [
+                sub_trajectory(evs, oid_str, win.start)
+                for oid_str, evs in groups.items()
+                if hits[self.interner.intern(oid_str)]
+            ]
+            yield TRangeResult(win.start, win.end, out, len(win.events))
+
+
+class PointPolygonTRangeQuery(TRangeQuery):
+    """tRange/PointPolygonTRangeQuery.java."""
+
+
+# ---------------------------------------------------------------------------
+# tKnn
+
+
+@dataclass
+class TKnnResult:
+    start: int
+    end: int
+    neighbors: List[Tuple[str, float, LineString]]  # (objID, minDist, sub-traj)
+    window_count: int
+
+
+class TKNNQuery(SpatialOperator):
+    """k nearest trajectories to a query point: min distance per objID over
+    the window, top-k objIDs, each materialized as its windowed
+    sub-trajectory (tKnn/TKNNQuery.java:50-163,
+    PointPointTKNNQuery.java:181-310). The reference's three extra shuffles
+    (rejoin raw stream, per-objID window, global windowAll top-k) collapse
+    into the kNN kernel + host sub-trajectory assembly.
+    """
+
+    def run(
+        self,
+        stream: Iterable[Point],
+        query_point: Point,
+        radius: float,
+        k: int,
+        dtype=np.float64,
+    ) -> Iterator[TKnnResult]:
+        flags = flags_for_queries(self.grid, radius, [query_point])
+        flags_d = jnp.asarray(flags)
+        q = jnp.asarray(np.array([query_point.x, query_point.y], dtype))
+        kern = jitted(knn_kernel, "k", "num_segments")
+
+        for win in self.windows(stream):
+            batch = self.point_batch(win.events, dtype=dtype)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
+            res = kern(
+                jnp.asarray(batch.xy), jnp.asarray(batch.valid), pflags,
+                jnp.asarray(batch.oid), q, radius, k=k, num_segments=nseg,
+            )
+            groups = group_by_oid(win.events)
+            out = []
+            for i in range(int(res.num_valid)):
+                oid_str = self.interner.lookup(int(res.segment[i]))
+                out.append(
+                    (oid_str, float(res.dist[i]),
+                     sub_trajectory(groups[oid_str], oid_str, win.start))
+                )
+            yield TKnnResult(win.start, win.end, out, len(win.events))
+
+
+class PointPointTKNNQuery(TKNNQuery):
+    """tKnn/PointPointTKNNQuery.java."""
+
+
+# ---------------------------------------------------------------------------
+# tJoin
+
+
+@dataclass
+class TJoinResult:
+    start: int
+    end: int
+    pairs: List[Tuple[LineString, LineString, float]]  # (traj, queryTraj, minDist)
+    window_count: int
+
+
+class TJoinQuery(SpatialOperator):
+    """Trajectory join: trajectory pairs whose points come within r inside
+    the window, each pair emitted once as paired windowed sub-trajectories
+    (tJoin/TJoinQuery.java:60-154, PointPointTJoinQuery.java:183+).
+
+    Dedup: the reference keeps the latest matching point pair per
+    (traj, queryTraj) (TJoinQuery dedup map); here the pair's reported
+    distance is the *minimum* point distance in the window — same pair set,
+    a strictly more informative representative (documented deviation).
+    ``run_single`` self-joins a stream (PointPointTJoinQuery.runSingle:57).
+    """
+
+    def __init__(self, conf, grid, cap: int = 64):
+        super().__init__(conf, grid)
+        self.cap = cap
+
+    def run(
+        self,
+        stream: Iterable[Point],
+        query_stream: Iterable[Point],
+        radius: float,
+        dtype=np.float64,
+    ) -> Iterator[TJoinResult]:
+        from spatialflink_tpu.operators.join_query import grid_hash_join_batches
+
+        merged = (
+            _TaggedEvent(ev.timestamp, tag, ev)
+            for tag, ev in merge_by_timestamp(stream, query_stream)
+        )
+        offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
+
+        for win in self.windows(merged):
+            left_ev = [t.event for t in win.events if t.tag == 0]
+            right_ev = [t.event for t in win.events if t.tag == 1]
+            if not left_ev or not right_ev:
+                yield TJoinResult(win.start, win.end, [], len(win.events))
+                continue
+            lb = self.point_batch(left_ev, dtype=dtype)
+            rb = self.point_batch(right_ev, dtype=dtype)
+            res = grid_hash_join_batches(
+                self.grid, lb, rb, radius, self.cap, offsets
+            )
+            pm = np.asarray(res.pair_mask)
+            ri = np.asarray(res.right_index)
+            dd = np.asarray(res.dist)
+            best: Dict[Tuple[str, str], float] = {}
+            for i in np.nonzero(pm.any(axis=1))[0]:
+                a_id = left_ev[i].obj_id
+                for s in np.nonzero(pm[i])[0]:
+                    b_id = right_ev[int(ri[i, s])].obj_id
+                    d = float(dd[i, s])
+                    key = (a_id, b_id)
+                    if key not in best or d < best[key]:
+                        best[key] = d
+            lgroups = group_by_oid(left_ev)
+            rgroups = group_by_oid(right_ev)
+            pairs = [
+                (sub_trajectory(lgroups[a], a, win.start),
+                 sub_trajectory(rgroups[b], b, win.start), d)
+                for (a, b), d in sorted(best.items())
+            ]
+            yield TJoinResult(win.start, win.end, pairs, len(win.events))
+
+    def run_single(self, stream, radius, dtype=np.float64):
+        """Self-join: pairs within one stream, excluding identity pairs."""
+        events = list(stream)
+        for res in self.run(iter(events), iter(list(events)), radius, dtype=dtype):
+            res.pairs = [
+                (a, b, d) for a, b, d in res.pairs if a.obj_id != b.obj_id
+            ]
+            yield res
+
+
+class PointPointTJoinQuery(TJoinQuery):
+    """tJoin/PointPointTJoinQuery.java."""
+
+
+# ---------------------------------------------------------------------------
+# tAggregate
+
+
+@dataclass
+class TAggregateResult:
+    """Per-cell heatmap entry: (cellName, count, {objID: temporalLen} or
+    {'' : aggregate}) — the reference's Tuple4<gridID, count, map, latency>
+    (TAggregateQuery.java:150-250)."""
+
+    start: int
+    end: int
+    cells: Dict[str, Tuple[int, Dict[str, int]]]
+    window_count: int
+
+
+class TAggregateQuery(SpatialOperator):
+    """Per-cell trajectory temporal-length heatmap with ALL/SUM/AVG/MIN/MAX
+    aggregates and inactive-trajectory deletion
+    (tAggregate/TAggregateQuery.java:53-250; windowed variant
+    PointTAggregateQuery.java:63+).
+
+    Continuous state (the reference's MapState) is carried across windows as
+    numpy arrays keyed by interned (cell, objID) pairs; each window updates
+    it with one segment-reduction kernel over the batch.
+    """
+
+    def __init__(self, conf, grid, aggregate: str = "SUM",
+                 inactive_threshold_ms: int = 0):
+        super().__init__(conf, grid)
+        if aggregate.upper() not in ("ALL", "SUM", "AVG", "MIN", "MAX"):
+            raise ValueError(f"bad aggregate {aggregate!r}")
+        self.aggregate = aggregate.upper()
+        self.inactive_threshold_ms = inactive_threshold_ms
+        self._state: Dict[Tuple[int, str], Tuple[int, int]] = {}  # (cell, oid) → (min, max)
+
+    def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TAggregateResult]:
+        kern = jax.jit(traj_cell_spans_kernel, static_argnames=("num_pairs",))
+        for win in self.windows(stream):
+            batch = self.point_batch(win.events, dtype=dtype)
+            oid_strs = [p.obj_id for p in win.events]
+            cells = batch.cell[: len(win.events)]
+            keys = [(int(c), o) for c, o in zip(cells, oid_strs)]
+            uniq = sorted(set(keys))
+            pair_index = {kv: i for i, kv in enumerate(uniq)}
+            pair_id = np.zeros(batch.capacity, np.int32)
+            pair_id[: len(keys)] = [pair_index[kv] for kv in keys]
+            num_pairs = next_bucket(len(uniq), minimum=64)
+            spans = kern(
+                jnp.asarray(batch.ts), jnp.asarray(pair_id),
+                jnp.asarray(batch.valid), num_pairs=num_pairs,
+            )
+            mn = np.asarray(spans.min_ts)
+            mx = np.asarray(spans.max_ts)
+            # Merge into continuous state (MapState semantics).
+            for kv, i in pair_index.items():
+                old = self._state.get(kv)
+                if old is None:
+                    self._state[kv] = (int(mn[i]), int(mx[i]))
+                else:
+                    self._state[kv] = (min(old[0], int(mn[i])), max(old[1], int(mx[i])))
+            # Inactive-trajectory deletion (TAggregateQuery.deleteHalted…).
+            if self.inactive_threshold_ms > 0:
+                horizon = max(mx[: len(uniq)].max(initial=0), 0) - self.inactive_threshold_ms
+                self._state = {
+                    kv: v for kv, v in self._state.items() if v[1] >= horizon
+                }
+            yield self._aggregate_state(win)
+
+    def _aggregate_state(self, win: WindowBatch) -> TAggregateResult:
+        per_cell: Dict[int, Dict[str, int]] = {}
+        for (cell, oid), (mn, mx) in self._state.items():
+            per_cell.setdefault(cell, {})[oid] = mx - mn
+        out: Dict[str, Tuple[int, Dict[str, int]]] = {}
+        for cell, lens in per_cell.items():
+            name = self.grid.cell_name(cell) if cell < self.grid.num_cells else "out"
+            n = len(lens)
+            if self.aggregate == "ALL":
+                out[name] = (n, dict(lens))
+            elif self.aggregate == "SUM":
+                out[name] = (n, {"": sum(lens.values())})
+            elif self.aggregate == "AVG":
+                out[name] = (n, {"": round(sum(lens.values()) / n)})
+            elif self.aggregate == "MIN":
+                oid, v = min(lens.items(), key=lambda kv: kv[1])
+                out[name] = (n, {oid: v})
+            else:  # MAX
+                oid, v = max(lens.items(), key=lambda kv: kv[1])
+                out[name] = (n, {oid: v})
+        return TAggregateResult(win.start, win.end, out, len(win.events))
+
+
+class PointTAggregateQuery(TAggregateQuery):
+    """tAggregate/PointTAggregateQuery.java."""
+
+
+# ---------------------------------------------------------------------------
+# tStats
+
+
+@dataclass
+class TStatsResult:
+    """Per-trajectory stats per window: the reference's
+    Tuple4<objID, spatialLength, temporalLength, spatial/temporal>
+    (TStatsQuery.java:137-144)."""
+
+    start: int
+    end: int
+    stats: Dict[str, Tuple[float, int, float]]  # objID → (spatial, temporal, ratio)
+    window_count: int
+
+
+class TStatsQuery(SpatialOperator):
+    """Running spatial/temporal length + avg speed per trajectory
+    (tStats/TStatsQuery.java:44-189).
+
+    WindowBased recomputes per window (the WFunction variant); RealTime
+    carries running totals across micro-batches like the ValueState
+    flatmap, including its drop-out-of-order behavior (only timestamps
+    strictly greater than the last seen advance the state).
+    """
+
+    def __init__(self, conf, grid):
+        super().__init__(conf, grid)
+        self._running: Dict[str, Tuple[float, int, int, float, float]] = {}
+        # oid → (spatial, temporal, last_ts, last_x, last_y)
+
+    def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TStatsResult]:
+        from spatialflink_tpu.operators.query_config import QueryType
+
+        realtime = self.conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive)
+        kern = jax.jit(traj_stats_kernel, static_argnames=("num_segments",))
+
+        for win in self.windows(stream):
+            if realtime:
+                # Arrival order matters: the ValueState flatmap drops
+                # out-of-order tuples as they arrive (TStatsQuery.java:118).
+                yield self._realtime_update(win, win.events)
+                continue
+            events = sorted(win.events, key=lambda p: (p.obj_id, p.timestamp))
+            batch = PointBatch.from_points(events, interner=self.interner, dtype=dtype)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            res = kern(
+                jnp.asarray(batch.xy), jnp.asarray(batch.ts),
+                jnp.asarray(batch.oid), jnp.asarray(batch.valid),
+                num_segments=nseg,
+            )
+            spatial = np.asarray(res.spatial_length)
+            temporal = np.asarray(res.temporal_length)
+            count = np.asarray(res.count)
+            stats = {}
+            for oid_str in {p.obj_id for p in events}:
+                i = self.interner.intern(oid_str)
+                if count[i] > 0:
+                    t = int(temporal[i])
+                    stats[oid_str] = (
+                        float(spatial[i]), t,
+                        float(spatial[i] / t) if t > 0 else 0.0,
+                    )
+            yield TStatsResult(win.start, win.end, stats, len(win.events))
+
+    def _realtime_update(self, win, events) -> TStatsResult:
+        stats = {}
+        for p in events:
+            st = self._running.get(p.obj_id)
+            if st is None:
+                self._running[p.obj_id] = (0.0, 0, p.timestamp, p.x, p.y)
+            else:
+                spatial, temporal, last_ts, lx, ly = st
+                if p.timestamp > last_ts:  # drop out-of-order (TStatsQuery.java:118)
+                    spatial += float(np.hypot(p.x - lx, p.y - ly))
+                    temporal += p.timestamp - last_ts
+                    self._running[p.obj_id] = (spatial, temporal, p.timestamp, p.x, p.y)
+            spatial, temporal, *_ = self._running[p.obj_id]
+            stats[p.obj_id] = (
+                spatial, temporal, spatial / temporal if temporal > 0 else 0.0
+            )
+        return TStatsResult(win.start, win.end, stats, len(events))
+
+
+class PointTStatsQuery(TStatsQuery):
+    """tStats windowed/realtime variants for point streams."""
+
+
+# ---------------------------------------------------------------------------
+# tFilter
+
+
+@dataclass
+class TFilterResult:
+    start: int
+    end: int
+    trajectories: List[LineString]
+    window_count: int
+
+
+class TFilterQuery(SpatialOperator):
+    """Keep only the given trajectory IDs; emit windowed sub-trajectories
+    (tFilter/PointTFilterQuery.java:50-122). Pure host control plane —
+    there is no geometry to compute."""
+
+    def run(
+        self, stream: Iterable[Point], traj_ids: Sequence[str]
+    ) -> Iterator[TFilterResult]:
+        wanted = set(traj_ids)
+        for win in self.windows(stream):
+            groups = group_by_oid([p for p in win.events if p.obj_id in wanted])
+            out = [
+                sub_trajectory(evs, oid, win.start) for oid, evs in sorted(groups.items())
+            ]
+            yield TFilterResult(win.start, win.end, out, len(win.events))
+
+
+class PointTFilterQuery(TFilterQuery):
+    """tFilter/PointTFilterQuery.java."""

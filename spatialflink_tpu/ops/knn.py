@@ -105,6 +105,24 @@ def knn_polygon_query_kernel(
     return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
 
 
+def knn_polyline_query_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    flags: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_verts: jnp.ndarray,
+    query_edge_valid: jnp.ndarray,
+    radius,
+    k: int,
+    num_segments: int,
+) -> KnnResult:
+    """Point-stream kNN around an open linestring query: min edge distance,
+    NO containment (an open polyline encloses nothing) — the kNN analog of
+    range_query_polylines_kernel (knn/PointLineStringKNNQuery.java)."""
+    dist = point_polyline_distance(xy, query_verts, query_edge_valid)
+    return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
+
+
 def knn_geometry_stream_kernel(
     obj_verts: jnp.ndarray,
     obj_edge_valid: jnp.ndarray,

@@ -1,0 +1,106 @@
+"""Trajectory segment kernels.
+
+The reference's trajectory operators keep per-objID state in Flink keyed
+state (ValueState/MapState) and iterate per record
+(tStats/TStatsQuery.java:44-145, tAggregate/TAggregateQuery.java:53-250).
+Here a window's points are sorted by (objID, ts) once on the host and every
+per-trajectory statistic is a segment reduction over the interned objID —
+one fused XLA program per window instead of per-record state mutation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.distances import point_point_distance
+
+
+class TrajStats(NamedTuple):
+    """Per-segment (per-objID) trajectory statistics for a window.
+
+    Mirrors the output tuple of TStatsQuery (objID, spatialLength,
+    temporalLength, spatialLength/temporalLength — TStatsQuery.java:137-144).
+    """
+
+    spatial_length: jnp.ndarray  # (U,)
+    temporal_length: jnp.ndarray  # (U,) ms
+    count: jnp.ndarray  # (U,) points per trajectory
+    avg_speed: jnp.ndarray  # (U,) spatial/temporal (0 where temporal == 0)
+
+
+def traj_stats_kernel(
+    xy: jnp.ndarray,
+    ts: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_segments: int,
+) -> TrajStats:
+    """Inputs must be pre-sorted by (oid, ts); padding lanes carry
+    oid = num_segments - 1 … any valid id with valid=False (they're masked).
+
+    Consecutive-point distances within each trajectory are summed per
+    segment; out-of-order duplicates (equal timestamps) contribute like the
+    reference's window variant, which walks points in sorted order.
+    """
+    same_traj = (oid[1:] == oid[:-1]) & valid[1:] & valid[:-1]
+    seg_d = point_point_distance(xy[1:], xy[:-1])
+    seg_t = (ts[1:] - ts[:-1]).astype(seg_d.dtype)
+    contrib_d = jnp.where(same_traj, seg_d, 0)
+    contrib_t = jnp.where(same_traj, seg_t, 0)
+    # Segment sums keyed by the *later* point's trajectory.
+    spatial = jax.ops.segment_sum(contrib_d, oid[1:], num_segments=num_segments)
+    temporal = jax.ops.segment_sum(contrib_t, oid[1:], num_segments=num_segments)
+    count = jax.ops.segment_sum(
+        valid.astype(jnp.int32), oid, num_segments=num_segments
+    )
+    speed = jnp.where(temporal > 0, spatial / jnp.where(temporal > 0, temporal, 1), 0.0)
+    return TrajStats(spatial, temporal, count, speed)
+
+
+class TrajAggregate(NamedTuple):
+    """Per-(cell, objID) temporal lengths for the heatmap aggregate."""
+
+    min_ts: jnp.ndarray  # (P,) per unique (cell, objID) pair
+    max_ts: jnp.ndarray  # (P,)
+
+
+def traj_cell_spans_kernel(
+    ts: jnp.ndarray,
+    pair_id: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_pairs: int,
+) -> TrajAggregate:
+    """Min/max timestamp per dense (cell, objID) pair id.
+
+    The batched form of TAggregateQuery's MapState min/max tracking
+    (TAggregateQuery.java:150-250): pair ids are host-interned
+    (np.unique over cell*U+oid), the kernel reduces timestamps.
+    """
+    big = jnp.iinfo(ts.dtype).max
+    small = jnp.iinfo(ts.dtype).min
+    mn = jax.ops.segment_min(
+        jnp.where(valid, ts, big), pair_id, num_segments=num_pairs
+    )
+    mx = jax.ops.segment_max(
+        jnp.where(valid, ts, small), pair_id, num_segments=num_pairs
+    )
+    return TrajAggregate(mn, mx)
+
+
+def traj_hits_kernel(
+    inside_any: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_segments: int,
+) -> jnp.ndarray:
+    """(U,) bool: does any point of each trajectory satisfy the predicate?
+
+    Used by tRange: 'if any point of the trajectory is inside any query
+    polygon, the whole (windowed) trajectory qualifies'
+    (tRange/PointPolygonTRangeQuery.java:53-177).
+    """
+    hit = (inside_any & valid).astype(jnp.int32)
+    return jax.ops.segment_max(hit, oid, num_segments=num_segments) > 0

@@ -1,0 +1,31 @@
+"""Runtime configuration for the JAX backend.
+
+XLA compilation on this class of host (remote-compile TPU tunnels, modest
+CPUs) costs ~1-2 s per program; without a persistent cache every process
+pays it again. Importing ``spatialflink_tpu`` configures JAX's persistent
+compilation cache (override the location with SFT_JAX_CACHE_DIR, disable
+with SFT_JAX_CACHE_DIR=off).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def configure_jax_cache() -> None:
+    cache_dir = os.environ.get(
+        "SFT_JAX_CACHE_DIR", os.path.expanduser("~/.cache/jax_sft")
+    )
+    if cache_dir.lower() == "off":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - older jax without these flags
+        pass
+
+
+configure_jax_cache()

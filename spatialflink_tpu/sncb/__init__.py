@@ -1,0 +1,9 @@
+from spatialflink_tpu.sncb.common import (  # noqa: F401
+    GpsEvent,
+    EnrichedEvent,
+    CRSUtils,
+    BufferedZone,
+    PolygonLoader,
+    csv_to_gps_event,
+    gps_events_to_points,
+)

@@ -1,0 +1,70 @@
+"""Per-interval CSV metrics sink (``sncb/metrics/MetricsSink.java:13-101``).
+
+Rows: ``seconds,count,bytesMB,eps,throughputMBps,avgLatencyMs`` per
+reporting interval, where latency = now − event/window timestamp.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+class MetricsSink:
+    """Count records per wall-clock interval and append CSV rows."""
+
+    HEADER = "seconds,count,bytesMB,eps,throughputMBps,avgLatencyMs"
+
+    def __init__(
+        self,
+        name: str,
+        path: Optional[str] = None,
+        interval_s: float = 1.0,
+        bytes_per_record: int = 128,
+    ):
+        self.name = name
+        self.interval_s = interval_s
+        self.bytes_per_record = bytes_per_record
+        self._t0 = time.time()
+        self._interval_start = self._t0
+        self._count = 0
+        self._latency_sum_ms = 0.0
+        self.rows = []
+        self._f = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "w")
+            self._f.write(self.HEADER + "\n")
+
+    def record(self, event_ts_ms: Optional[int] = None, n: int = 1):
+        now = time.time()
+        self._count += n
+        if event_ts_ms is not None:
+            self._latency_sum_ms += max(0.0, now * 1000 - event_ts_ms) * n
+        if now - self._interval_start >= self.interval_s:
+            self._flush_interval(now)
+
+    def _flush_interval(self, now: float):
+        dt = now - self._interval_start
+        if dt <= 0:
+            return
+        eps = self._count / dt
+        mb = self._count * self.bytes_per_record / 1e6
+        avg_lat = self._latency_sum_ms / self._count if self._count else 0.0
+        row = (
+            f"{now - self._t0:.1f},{self._count},{mb:.3f},{eps:.1f},"
+            f"{mb / dt:.3f},{avg_lat:.2f}"
+        )
+        self.rows.append(row)
+        if self._f:
+            self._f.write(row + "\n")
+            self._f.flush()
+        self._interval_start = now
+        self._count = 0
+        self._latency_sum_ms = 0.0
+
+    def close(self):
+        self._flush_interval(time.time())
+        if self._f:
+            self._f.close()

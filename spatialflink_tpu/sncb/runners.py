@@ -1,0 +1,182 @@
+"""SNCB test/benchmark runners (``GeoFlink/sncb/tests/``).
+
+- ``local_test_runner``: handcrafted fixture events with per-query
+  expectations (LocalTestRunner.java:21-115);
+- ``benchmark_runner``: seeded synthetic GPS load at a target EPS with
+  per-second metrics (BenchmarkRunner.java:22-105 + SyntheticGpsSource);
+- ``mobility_query_runner``: CSV replay of the MN_Q1..Q5 suite with an
+  execution-stats report (MobilityQueryRunner.java:33-150).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+from spatialflink_tpu.sncb import mobility
+from spatialflink_tpu.sncb.common import GpsEvent, PolygonLoader, csv_to_gps_event
+from spatialflink_tpu.sncb.metrics import MetricsSink
+from spatialflink_tpu.sncb.queries import (
+    q1_high_risk,
+    q2_brake_monitor,
+    q3_trajectory,
+    q5_traj_speed_fence,
+)
+from spatialflink_tpu.streams.sources import SyntheticGpsSource
+
+# Brussels-area bbox used by the synthetic benchmark source
+# (BenchmarkRunner.java:35: lon 4.25..4.50, lat 50.75..50.95).
+BRUSSELS_BBOX = (4.25, 4.50, 50.75, 50.95)
+
+
+def sample_gps_events() -> List[GpsEvent]:
+    """Fixture in the spirit of LocalTestRunner.sampleData
+    (LocalTestRunner.java:86-115): events crafted to trip each query.
+    Zones are this package's bundled resources."""
+    t0 = 1_700_000_000_000
+    evs = [
+        # Inside high_risk "Schaerbeek yard approach" polygon (Q1 hits).
+        GpsEvent("trainA", 4.375, 50.865, t0 + 0, 30.0, 5.0, 5.0),
+        GpsEvent("trainA", 4.378, 50.867, t0 + 1000, 31.0, 5.1, 5.0),
+        # Far from any zone.
+        GpsEvent("trainB", 4.50, 50.90, t0 + 1500, 40.0, 5.0, 5.0),
+        # Q2: trainC has FA variation 0.8 (>0.6) and FF variation 0.3 (<=0.5).
+        GpsEvent("trainC", 4.45, 50.90, t0 + 2000, 20.0, 4.0, 5.0),
+        GpsEvent("trainC", 4.45, 50.90, t0 + 2500, 21.0, 4.8, 5.3),
+        # Q2 negative: trainD varies FF too much (0.9 > 0.5).
+        GpsEvent("trainD", 4.46, 50.91, t0 + 2000, 20.0, 4.0, 5.0),
+        GpsEvent("trainD", 4.46, 50.91, t0 + 2500, 21.0, 4.8, 5.9),
+        # Inside maintenance zone (excluded from Q2).
+        GpsEvent("trainE", 4.315, 50.810, t0 + 3000, 10.0, 1.0, 9.0),
+        GpsEvent("trainE", 4.316, 50.811, t0 + 3500, 11.0, 9.0, 1.0),
+        # Q5: inside fence with high speeds (avg>50, min>20).
+        GpsEvent("trainF", 4.410, 50.850, t0 + 4000, 80.0, 5.0, 5.0),
+        GpsEvent("trainF", 4.412, 50.852, t0 + 5000, 90.0, 5.0, 5.0),
+        # Q5 negative: inside fence but slow.
+        GpsEvent("trainG", 4.410, 50.855, t0 + 4000, 5.0, 5.0, 5.0),
+        GpsEvent("trainG", 4.411, 50.856, t0 + 5000, 6.0, 5.0, 5.0),
+        # Late straggler advancing watermarks past all windows.
+        GpsEvent("trainB", 4.50, 50.90, t0 + 70_000, 40.0, 5.0, 5.0),
+    ]
+    return evs
+
+
+def local_test_runner(verbose: bool = False) -> Dict[str, list]:
+    """Run Q1/Q2/Q3/Q5 over the fixture; return per-query results."""
+    risk = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
+    maint = PolygonLoader.load_geojson_buffered("maintenance_areas.geojson", 0.0)
+    fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
+
+    out = {
+        "q1": list(q1_high_risk(iter(sample_gps_events()), risk)),
+        "q2": list(
+            q2_brake_monitor(iter(sample_gps_events()), maint, slide_ms=500)
+        ),
+        "q3": list(q3_trajectory(iter(sample_gps_events()), slide_ms=1000)),
+        "q5": list(q5_traj_speed_fence(iter(sample_gps_events()), fence)),
+    }
+    if verbose:
+        for q, res in out.items():
+            print(f"{q}: {len(res)} results")
+            for r in res[:5]:
+                print("  ", r)
+    return out
+
+
+@dataclass
+class BenchmarkReport:
+    query: str
+    events: int
+    duration_s: float
+    eps: float
+    results: int
+    source_metrics: List[str]
+    sink_metrics: List[str]
+
+
+def benchmark_runner(
+    query: str = "q1",
+    target_eps: int = 20_000,
+    duration_ms: int = 30_000,
+    num_devices: int = 10,
+    out_dir: Optional[str] = None,
+) -> BenchmarkReport:
+    """BenchmarkRunner.main analog: synthetic load through one query with
+    1 s CSV metrics at source and sink (BenchmarkRunner.java:22-105)."""
+    min_x, max_x, min_y, max_y = BRUSSELS_BBOX
+    src = SyntheticGpsSource(
+        min_x, max_x, min_y, max_y,
+        target_eps=target_eps, duration_ms=duration_ms,
+        num_devices=num_devices, seed=42,
+        start_ts=1_700_000_000_000,
+        make_event=lambda device_id, x, y, timestamp, speed: GpsEvent(
+            device_id, x, y, timestamp, speed, 5.0, 5.0
+        ),
+    )
+    source_sink = MetricsSink(
+        "source", f"{out_dir}/source.csv" if out_dir else None
+    )
+    result_sink = MetricsSink(
+        f"sink-{query}", f"{out_dir}/sink-{query}.csv" if out_dir else None
+    )
+
+    def counted(it):
+        for e in it:
+            source_sink.record(e.ts)
+            yield e
+
+    risk = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
+    maint = PolygonLoader.load_geojson_buffered("maintenance_areas.geojson", 0.0)
+    fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
+
+    t0 = time.time()
+    n_results = 0
+    if query == "q1":
+        it = q1_high_risk(counted(src), risk)
+    elif query == "q2":
+        it = q2_brake_monitor(counted(src), maint, slide_ms=1000)
+    elif query == "q3":
+        it = q3_trajectory(counted(src), slide_ms=1000)
+    elif query == "q5":
+        it = q5_traj_speed_fence(counted(src), fence)
+    else:
+        raise ValueError(query)
+    for res in it:
+        ts = getattr(res, "win_end", None)
+        if ts is None and hasattr(res, "raw"):
+            ts = res.raw.ts
+        result_sink.record(ts)
+        n_results += 1
+    dt = time.time() - t0
+    source_sink.close()
+    result_sink.close()
+    n_events = src.total_events
+    return BenchmarkReport(
+        query=query, events=n_events, duration_s=dt, eps=n_events / dt,
+        results=n_results, source_metrics=source_sink.rows,
+        sink_metrics=result_sink.rows,
+    )
+
+
+def mobility_query_runner(
+    csv_path: str, queries: Iterable[str] = ("q1", "q2", "q3", "q4", "q5"),
+    limit: Optional[int] = None,
+) -> Dict[str, BenchmarkReport]:
+    """CSV replay of MN_Q1..Q5 (MobilityQueryRunner.java:33-150):
+    14-column schema, per-query timing + result counts."""
+    reports = {}
+    for q in queries:
+        with open(csv_path) as f:
+            lines = [ln for ln in f if ln.strip()]
+        if limit:
+            lines = lines[:limit]
+        t0 = time.time()
+        rows = mobility.mobility_runner(q, iter(lines))
+        dt = time.time() - t0
+        reports[q] = BenchmarkReport(
+            query=q, events=len(lines), duration_s=dt,
+            eps=len(lines) / dt if dt > 0 else 0.0,
+            results=len(rows), source_metrics=[], sink_metrics=[],
+        )
+    return reports

@@ -44,6 +44,19 @@ from spatialflink_tpu.models.objects import (
 # timestamps
 
 
+def _java_date_format(fmt: str) -> str:
+    """Java SimpleDateFormat → strftime for the tokens the reference's
+    configs use (yyyy-MM-dd HH:mm:ss)."""
+    return (
+        fmt.replace("yyyy", "%Y")
+        .replace("MM", "%m")
+        .replace("dd", "%d")
+        .replace("HH", "%H")
+        .replace("mm", "%M")
+        .replace("ss", "%S")
+    )
+
+
 def parse_timestamp(value, date_format: Optional[str], strict: bool = False) -> int:
     """Property value → epoch ms. ``date_format`` uses Java SimpleDateFormat
     conventions from the config (e.g. "yyyy-MM-dd HH:mm:ss"); None/"null"
@@ -59,16 +72,8 @@ def parse_timestamp(value, date_format: Optional[str], strict: bool = False) -> 
             raise ValueError("missing timestamp")
         return 0
     if date_format and date_format != "null":
-        fmt = (
-            date_format.replace("yyyy", "%Y")
-            .replace("MM", "%m")
-            .replace("dd", "%d")
-            .replace("HH", "%H")
-            .replace("mm", "%M")
-            .replace("ss", "%S")
-        )
         try:
-            dt = datetime.strptime(str(value), fmt)
+            dt = datetime.strptime(str(value), _java_date_format(date_format))
             return int(dt.replace(tzinfo=timezone.utc).timestamp() * 1000)
         except ValueError:
             if strict:
@@ -84,15 +89,9 @@ def parse_timestamp(value, date_format: Optional[str], strict: bool = False) -> 
 
 def format_timestamp(ts_ms: int, date_format: Optional[str]) -> str:
     if date_format and date_format != "null":
-        fmt = (
-            date_format.replace("yyyy", "%Y")
-            .replace("MM", "%m")
-            .replace("dd", "%d")
-            .replace("HH", "%H")
-            .replace("mm", "%M")
-            .replace("ss", "%S")
+        return datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc).strftime(
+            _java_date_format(date_format)
         )
-        return datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc).strftime(fmt)
     return str(ts_ms)
 
 
@@ -373,4 +372,8 @@ def parse_csv_point(
 
 
 def to_csv_point(p: Point, delimiter: str = ",") -> str:
-    return delimiter.join([str(p.obj_id), str(p.timestamp), repr(p.x), repr(p.y)])
+    # repr(float(...)): plain floats keep full precision; numpy scalars
+    # would render as "np.float64(…)" under numpy>=2.
+    return delimiter.join(
+        [str(p.obj_id), str(p.timestamp), repr(float(p.x)), repr(float(p.y))]
+    )

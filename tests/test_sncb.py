@@ -1,0 +1,220 @@
+"""SNCB domain-layer tests: CSV schema, zones, Q1–Q5, MN_Q1–Q5, runners."""
+
+import math
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.sncb.common import (
+    BufferedZone,
+    CRSUtils,
+    GpsEvent,
+    MnGpsEvent,
+    PolygonLoader,
+    contains_any_zone,
+    csv_to_gps_event,
+)
+from spatialflink_tpu.sncb.mobility import (
+    Q5_FENCE,
+    mn_q1,
+    mn_q2,
+    mn_q3,
+    mn_q4,
+    mn_q5,
+    mobility_runner,
+)
+from spatialflink_tpu.sncb.ops import trajectory_wkt, traj_speed, variance, variation
+from spatialflink_tpu.sncb.queries import (
+    q1_high_risk,
+    q2_brake_monitor,
+    q3_trajectory,
+    q4_trajectory_restricted,
+    q5_traj_speed_fence,
+)
+from spatialflink_tpu.sncb.runners import (
+    benchmark_runner,
+    local_test_runner,
+    sample_gps_events,
+)
+
+
+def test_csv_schema_14_columns():
+    # ts(0) deviceId(1) _(2) PCFA(3) PCFF(4) ... speed(11) lat(12) lon(13)
+    line = "1700000000000,trainX,z,4.5,5.2,a,b,c,d,e,f,33.5,50.8466,4.3517"
+    e = csv_to_gps_event(line)
+    assert e.device_id == "trainX"
+    assert e.ts == 1700000000000
+    assert e.fa == 4.5 and e.ff == 5.2
+    assert e.gps_speed == 33.5
+    assert (e.lon, e.lat) == (4.3517, 50.8466)
+    # Bad numerics → 0 (reference's catch-all, CSVToGpsEventMapFunction.java:20-26)
+    e2 = csv_to_gps_event("xx,dev,z,bad,bad,a,b,c,d,e,f,bad,bad,bad")
+    assert e2.ts == 0 and e2.fa == 0.0 and e2.lon == 0.0
+    assert MnGpsEvent is GpsEvent  # the missing com.mn type exists here
+
+
+def test_zone_loading_and_containment():
+    zones = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
+    assert len(zones) == 2
+    assert zones[0].buffer_m == 20.0
+    # Point inside the Schaerbeek zone vs far away.
+    inside = CRSUtils.enrich_batch([GpsEvent("a", 4.377, 50.867, 0)])
+    outside = CRSUtils.enrich_batch([GpsEvent("b", 4.5, 50.5, 0)])
+    assert contains_any_zone(zones, inside)[0]
+    assert not contains_any_zone(zones, outside)[0]
+    # Buffer semantics: ~15 m outside the edge must still hit (buffer 20 m).
+    edge = CRSUtils.enrich_batch([GpsEvent("c", 4.372, 50.867, 0)])
+    edge_shift = edge.copy()
+    edge_shift[0, 0] -= 15.0  # 15 m west of the western edge
+    assert contains_any_zone(zones, edge_shift)[0]
+    edge_shift[0, 0] -= 30.0  # 45 m out → miss
+    assert not contains_any_zone(zones, edge_shift)[0]
+
+
+def test_wkt_fence_loading():
+    fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
+    assert len(fence) == 1
+    c = CRSUtils.enrich_batch([GpsEvent("a", 4.41, 50.85, 0)])
+    assert contains_any_zone(fence, c)[0]
+
+
+def test_ops_aggregations():
+    evs = [
+        GpsEvent("d", 0, 0, 1000, 10.0, 4.0, 5.0),
+        GpsEvent("d", 0, 0, 2000, 20.0, 4.8, 5.4),
+        GpsEvent("d", 0, 0, 3000, 30.0, None, None),
+    ]
+    var_fa, var_ff = variation(evs)
+    assert var_fa == pytest.approx(0.8)
+    assert var_ff == pytest.approx(0.4)
+    n, v_fa, v_ff = variance(evs)
+    assert n == 3
+    # Reference formula: sums skip None but n counts all events.
+    mean_fa = (4.0 + 4.8) / 3
+    assert v_fa == pytest.approx(max(0.0, (4.0**2 + 4.8**2) / 3 - mean_fa**2))
+    wkt, avg, mn = traj_speed(evs)
+    assert avg == pytest.approx(20.0) and mn == 10.0
+    assert wkt.startswith("LINESTRING")
+    assert trajectory_wkt([]) == "POINT EMPTY"
+    assert trajectory_wkt(evs[:1]) == "POINT (0 0)"
+
+
+def test_q1_high_risk_fixture():
+    risk = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
+    hits = list(q1_high_risk(iter(sample_gps_events()), risk))
+    ids = {h.raw.device_id for h in hits}
+    assert ids == {"trainA"}
+    assert len(hits) == 2
+    # Enrichment carries metric coordinates.
+    assert 5_600_000 < hits[0].y_metric < 5_700_000
+
+
+def test_q2_brake_monitor_fixture():
+    maint = PolygonLoader.load_geojson_buffered("maintenance_areas.geojson", 0.0)
+    out = list(q2_brake_monitor(iter(sample_gps_events()), maint, slide_ms=500))
+    devs = {o.device_id for o in out}
+    # trainC: varFA 0.8 > 0.6, varFF 0.3 <= 0.5 → hit.
+    # trainD: varFF 0.9 > 0.5 → excluded. trainE: in maintenance → excluded.
+    assert "trainC" in devs
+    assert "trainD" not in devs and "trainE" not in devs
+
+
+def test_q3_trajectory_fixture():
+    out = list(q3_trajectory(iter(sample_gps_events()), slide_ms=1000))
+    a_trajs = [o for o in out if o.device_id == "trainA" and "LINESTRING" in o.wkt]
+    assert a_trajs
+    # Coordinates ordered by timestamp.
+    assert a_trajs[0].wkt.index("4.375") < a_trajs[0].wkt.index("4.378")
+
+
+def test_q4_restriction():
+    out = list(
+        q4_trajectory_restricted(
+            iter(sample_gps_events()), 4.3, 4.4, 50.8, 50.9,
+            1_700_000_000_000, 1_700_000_002_000, slide_ms=1000,
+        )
+    )
+    devs = {o.device_id for o in out}
+    assert devs == {"trainA"}  # only trainA is inside bbox+time range
+
+
+def test_q5_fence_fixture():
+    fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
+    out = list(q5_traj_speed_fence(iter(sample_gps_events()), fence))
+    devs = {o.device_id for o in out}
+    assert "trainF" in devs  # fast train in fence
+    assert "trainG" not in devs  # slow train filtered
+
+
+def test_local_test_runner_end_to_end():
+    out = local_test_runner()
+    assert {r.raw.device_id for r in out["q1"]} == {"trainA"}
+    assert all(o.device_id != "trainE" for o in out["q2"])
+    assert out["q3"]
+    assert {o.device_id for o in out["q5"]} == {"trainF"}
+
+
+def _mk_events(n=50, lon=4.3658, lat=50.6456, dev="d0", t0=0, dt=100):
+    return [GpsEvent(dev, lon, lat, t0 + i * dt, 10.0, 4.0, 5.0) for i in range(n)]
+
+
+def test_mn_q1_counts():
+    # 50 events at the query point + 10 far away, 5s tumbling windows.
+    evs = _mk_events(50) + [
+        GpsEvent("far", 10.0, 60.0, i * 100, 1.0, 0, 0) for i in range(10)
+    ]
+    evs.sort(key=lambda e: e.ts)
+    out = list(mn_q1(iter(evs), 4.3658, 50.6456, 2.0))
+    assert sum(o.cnt for o in out) == 50  # far events outside 2.0-degree tol
+    assert all(o.end - o.start == 5000 for o in out)
+
+
+def test_mn_q2_excludes_box_and_counts_all_key():
+    inside_box = [GpsEvent("a", 4.3, 50.4, i * 100, 1, 2.0, 2.0) for i in range(10)]
+    outside = [GpsEvent("b", 5.5, 51.5, i * 100, 1, 4.0 + (i % 2), 5.0) for i in range(10)]
+    evs = sorted(inside_box + outside, key=lambda e: e.ts)
+    out = list(mn_q2(iter(evs), slide_ms=1000))
+    assert out
+    # Only the 10 outside-box events are aggregated.
+    assert max(o.count for o in out) == 10
+    assert all(o.device_id == "ALL" for o in out)
+
+
+def test_mn_q3_q4_trajectories():
+    evs = _mk_events(20, dt=500)
+    out3 = list(mn_q3(iter(evs)))
+    assert out3 and all(o.device_id == "ALL" for o in out3)
+    out4 = list(mn_q4(iter(_mk_events(20, dt=500)), 4.0, 50.0, 5.0, 51.0, 0, 10**15))
+    assert out4
+
+
+def test_mn_q5_fence_and_speed_filter():
+    # Slow device inside fence → kept (avg < 100); fast device avg>100 &
+    # min>20 → filtered out.
+    slow = [GpsEvent("slow", 4.41, 50.85, i * 500, 30.0, 0, 0) for i in range(10)]
+    fast = [GpsEvent("fast", 4.41, 50.85, i * 500, 150.0, 0, 0) for i in range(10)]
+    evs = sorted(slow + fast, key=lambda e: e.ts)
+    out = list(mn_q5(iter(evs), Q5_FENCE, 0.001))
+    devs = {o.device_id for o in out}
+    assert "slow" in devs and "fast" not in devs
+
+
+def test_mobility_runner_csv_roundtrip(tmp_path):
+    lines = [
+        f"{i*200},dev{i%3},z,4.0,5.0,a,b,c,d,e,f,25.0,50.6456,4.3658"
+        for i in range(100)
+    ]
+    rows = mobility_runner("q1", iter(lines), out_path=str(tmp_path / "q1.csv"))
+    assert rows
+    total = sum(int(r.split(",")[2]) for r in rows)
+    assert total == 100
+    assert (tmp_path / "q1.csv").read_text().strip().count("\n") == len(rows) - 1
+
+
+def test_benchmark_runner_small():
+    rep = benchmark_runner("q1", target_eps=2000, duration_ms=2000)
+    assert rep.events == 4000
+    assert rep.eps > 0
+    # Synthetic Brussels bbox overlaps the risk zones rarely; result count
+    # bounded by event count.
+    assert 0 <= rep.results <= rep.events

@@ -1,0 +1,194 @@
+"""Trajectory operator tests vs brute-force window recomputation."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import Point, Polygon
+from spatialflink_tpu.operators import (
+    QueryConfiguration,
+    QueryType,
+    TAggregateQuery,
+    TFilterQuery,
+    TJoinQuery,
+    TKNNQuery,
+    TRangeQuery,
+    TStatsQuery,
+)
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+W30 = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+
+
+def make_trajectories(rng, n_traj=6, pts_per=20):
+    """Smooth-ish random walks, one per objID, 30s of data."""
+    events = []
+    for t in range(n_traj):
+        x, y = rng.uniform(2, 8), rng.uniform(2, 8)
+        for i in range(pts_per):
+            x = float(np.clip(x + rng.normal(0, 0.2), 0, 10))
+            y = float(np.clip(y + rng.normal(0, 0.2), 0, 10))
+            events.append(
+                Point(obj_id=f"tr{t}", timestamp=i * 1500 + t, x=x, y=y)
+            )
+    events.sort(key=lambda p: p.timestamp)
+    return events
+
+
+def test_trange_containment(rng):
+    events = make_trajectories(rng)
+    poly = Polygon(rings=[np.array([[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]], float)])
+    results = list(TRangeQuery(W30, GRID).run(iter(events), [poly]))
+    for res in results:
+        win_ev = [p for p in events if res.start <= p.timestamp < res.end]
+        expect = {
+            p.obj_id for p in win_ev if 4 < p.x < 6 and 4 < p.y < 6
+        }
+        got = {t.obj_id for t in res.trajectories}
+        # Boundary-exact points can differ; our fixtures are generic floats,
+        # never exactly on an edge.
+        assert got == expect
+        # Sub-trajectory contains all window points of that objID, sorted.
+        for t in res.trajectories:
+            n_expect = sum(1 for p in win_ev if p.obj_id == t.obj_id)
+            assert len(t.coords) == n_expect
+            # timestamps sorted → x sequence matches sort by ts
+            evs = sorted(
+                [p for p in win_ev if p.obj_id == t.obj_id], key=lambda p: p.timestamp
+            )
+            np.testing.assert_allclose(t.coords, [[p.x, p.y] for p in evs])
+
+
+def test_tknn_top_trajectories(rng):
+    events = make_trajectories(rng, n_traj=8)
+    q = Point(x=5.0, y=5.0)
+    results = list(TKNNQuery(W30, GRID).run(iter(events), q, radius=5.0, k=3))
+    for res in results:
+        win_ev = [p for p in events if res.start <= p.timestamp < res.end]
+        best = {}
+        for p in win_ev:
+            d = float(np.hypot(p.x - 5, p.y - 5))
+            if d <= 5.0 and (p.obj_id not in best or d < best[p.obj_id]):
+                best[p.obj_id] = d
+        expect = sorted(best.items(), key=lambda kv: kv[1])[:3]
+        got = [(oid, d) for oid, d, _ in res.neighbors]
+        assert [o for o, _ in got] == [o for o, _ in expect]
+        for (_, gd), (_, ed) in zip(got, expect):
+            assert gd == pytest.approx(ed, rel=1e-12)
+        # Sub-trajectories include every window point of the objID.
+        for oid, _, traj in res.neighbors:
+            assert len(traj.coords) == sum(1 for p in win_ev if p.obj_id == oid)
+
+
+def test_tjoin_pairs(rng):
+    left = make_trajectories(rng, n_traj=4)
+    right = make_trajectories(rng, n_traj=3)
+    for p in right:
+        p.obj_id = "q" + p.obj_id
+    r = 1.0
+    results = list(TJoinQuery(W30, GRID).run(iter(left), iter(right), r))
+    for res in results:
+        lwin = [p for p in left if res.start <= p.timestamp < res.end]
+        rwin = [p for p in right if res.start <= p.timestamp < res.end]
+        expect = {}
+        for a in lwin:
+            for b in rwin:
+                d = float(np.hypot(a.x - b.x, a.y - b.y))
+                if d <= r:
+                    key = (a.obj_id, b.obj_id)
+                    if key not in expect or d < expect[key]:
+                        expect[key] = d
+        got = {(a.obj_id, b.obj_id): d for a, b, d in res.pairs}
+        assert set(got) == set(expect)
+        for k in got:
+            assert got[k] == pytest.approx(expect[k], rel=1e-12)
+
+
+def test_tjoin_single_excludes_identity(rng):
+    events = make_trajectories(rng, n_traj=3)
+    results = list(TJoinQuery(W30, GRID).run_single(iter(events), 10.0))
+    for res in results:
+        assert all(a.obj_id != b.obj_id for a, b, _ in res.pairs)
+
+
+def test_taggregate_sum_and_all(rng):
+    events = make_trajectories(rng, n_traj=3, pts_per=10)
+    agg = TAggregateQuery(W30, GRID, aggregate="ALL")
+    results = list(agg.run(iter(events)))
+    assert results
+    final = results[-1]
+    # Brute force: per (cell, objID) min/max ts over ALL events (continuous state).
+    state = {}
+    for p in events:
+        c = GRID.flat_cell(p.x, p.y)
+        key = (c, p.obj_id)
+        mn, mx = state.get(key, (p.timestamp, p.timestamp))
+        state[key] = (min(mn, p.timestamp), max(mx, p.timestamp))
+    per_cell = {}
+    for (c, oid), (mn, mx) in state.items():
+        per_cell.setdefault(GRID.cell_name(c), {})[oid] = mx - mn
+    assert final.cells.keys() == per_cell.keys()
+    for name, (count, lens) in final.cells.items():
+        assert count == len(per_cell[name])
+        assert lens == per_cell[name]
+
+
+def test_taggregate_inactive_deletion(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    # One object stops sending at t=5000; another continues to 40000.
+    events = [Point(obj_id="dead", timestamp=t, x=1.0, y=1.0) for t in range(0, 5000, 1000)]
+    events += [Point(obj_id="alive", timestamp=t, x=9.0, y=9.0) for t in range(0, 40000, 1000)]
+    events.sort(key=lambda p: p.timestamp)
+    agg = TAggregateQuery(conf, GRID, aggregate="ALL", inactive_threshold_ms=8000)
+    results = list(agg.run(iter(events)))
+    last = results[-1]
+    oids = {oid for _, lens in last.cells.values() for oid in lens}
+    assert "alive" in oids and "dead" not in oids
+
+
+def test_tstats_windowed_matches_brute(rng):
+    events = make_trajectories(rng, n_traj=4)
+    results = list(TStatsQuery(W30, GRID).run(iter(events)))
+    for res in results:
+        win_ev = [p for p in events if res.start <= p.timestamp < res.end]
+        for oid_str in {p.obj_id for p in win_ev}:
+            pts = sorted(
+                [p for p in win_ev if p.obj_id == oid_str], key=lambda p: p.timestamp
+            )
+            spatial = sum(
+                float(np.hypot(b.x - a.x, b.y - a.y)) for a, b in zip(pts, pts[1:])
+            )
+            temporal = pts[-1].timestamp - pts[0].timestamp
+            gs, gt, gr = res.stats[oid_str]
+            assert gs == pytest.approx(spatial, rel=1e-9)
+            assert gt == temporal
+            if temporal:
+                assert gr == pytest.approx(spatial / temporal, rel=1e-9)
+
+
+def test_tstats_realtime_carries_state_and_drops_ooo():
+    conf = QueryConfiguration(QueryType.RealTime, realtime_batch_ms=1000)
+    events = [
+        Point(obj_id="a", timestamp=0, x=0.0, y=0.0),
+        Point(obj_id="a", timestamp=500, x=3.0, y=4.0),  # +5
+        Point(obj_id="a", timestamp=400, x=100.0, y=100.0),  # out-of-order: dropped
+        Point(obj_id="a", timestamp=1500, x=3.0, y=0.0),  # +4
+    ]
+    results = list(TStatsQuery(conf, GRID).run(iter(events)))
+    final = {}
+    for res in results:
+        final.update(res.stats)
+    spatial, temporal, ratio = final["a"]
+    assert spatial == pytest.approx(9.0)
+    assert temporal == 1500
+    assert ratio == pytest.approx(9.0 / 1500)
+
+
+def test_tfilter(rng):
+    events = make_trajectories(rng, n_traj=5)
+    results = list(TFilterQuery(W30, GRID).run(iter(events), ["tr1", "tr3"]))
+    for res in results:
+        got = {t.obj_id for t in res.trajectories}
+        win_ev = [p for p in events if res.start <= p.timestamp < res.end]
+        expect = {p.obj_id for p in win_ev if p.obj_id in ("tr1", "tr3")}
+        assert got == expect
