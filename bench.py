@@ -1,0 +1,136 @@
+"""Headline benchmark — continuous kNN (k=50) over 1M-point sliding windows.
+
+The BASELINE.md north-star metric: points/sec/chip + p50 window latency on
+continuous kNN, k=50, 1M-point windows, Beijing-extent stream, vs the
+single-node CPU reference. The reference publishes no numbers; its own
+benchmark harness is configured for a 20,000 events/sec single-node target
+(BenchmarkRunner.java:25-26, InstrumentedMN_Q1.java:88-89), so
+``vs_baseline`` = measured points/sec/chip ÷ 20,000.
+
+The measured loop is the real per-window path: host window slice → pad →
+device transfer → fused XLA program (cell-flag gather, masked distances,
+per-object segment-min dedup, top-50) → result fetch. Object ids are dense
+ints (the framework interns strings at ingest; interning is amortized
+stream-side, not per window).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+WINDOW = 1_000_000
+SLIDE = WINDOW // 2
+N_WINDOWS = 20
+K = 50
+NUM_SEGMENTS = 16_384  # distinct objIDs
+RADIUS = 0.05
+BASELINE_EPS = 20_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.ops.cells import assign_cells, gather_cell_flags
+    from spatialflink_tpu.ops.knn import knn_kernel
+
+    dev = jax.devices()[0]
+    grid = UniformGrid(100, min_x=115.5, max_x=117.6, min_y=39.6, max_y=41.1)
+    q = np.array([116.40, 40.19], np.float32)
+    flags = grid.neighbor_flags(RADIUS, [grid.flat_cell(*q)])
+
+    # Synthetic Beijing stream: enough points for N sliding windows.
+    rng = np.random.default_rng(42)
+    total = SLIDE * (N_WINDOWS - 1) + WINDOW
+    xs = rng.uniform(115.5, 117.6, total).astype(np.float32)
+    ys = rng.uniform(39.6, 41.1, total).astype(np.float32)
+    stream_xy = np.stack([xs, ys], axis=1)
+    stream_oid = (rng.integers(0, NUM_SEGMENTS, total)).astype(np.int32)
+    valid = np.ones(WINDOW, bool)
+
+    def step(xy_a, xy_b, oid_a, oid_b, valid, flags_table, query_xy):
+        # Window = two consecutive slides, concatenated on device — each
+        # ingested point crosses host→device exactly once (streaming
+        # ingest), like the window assembler's slide panes.
+        xy = jnp.concatenate([xy_a, xy_b], axis=0)
+        oid = jnp.concatenate([oid_a, oid_b], axis=0)
+        cell = assign_cells(xy, grid.min_x, grid.min_y, grid.cell_length, grid.n)
+        pflags = gather_cell_flags(cell, flags_table)
+        return knn_kernel(
+            xy, valid, pflags, oid, query_xy, np.float32(RADIUS),
+            k=K, num_segments=NUM_SEGMENTS,
+        )
+
+    jstep = jax.jit(step)
+    flags_d = jax.device_put(jnp.asarray(flags), dev)
+    q_d = jax.device_put(jnp.asarray(q), dev)
+    valid_d = jax.device_put(jnp.asarray(valid), dev)
+
+    def slide_arrays(i):
+        lo, hi = i * SLIDE, (i + 1) * SLIDE
+        return (
+            jax.device_put(stream_xy[lo:hi], dev),
+            jax.device_put(stream_oid[lo:hi], dev),
+        )
+
+    # Warm-up (compile) on window 0.
+    xy_a, oid_a = slide_arrays(0)
+    xy_b, oid_b = slide_arrays(1)
+    res = jstep(xy_a, xy_b, oid_a, oid_b, valid_d, flags_d, q_d)
+    jax.block_until_ready(res)
+
+    latencies = []
+    results = []
+    slides = [(xy_a, oid_a), (xy_b, oid_b)]
+    t_total0 = time.perf_counter()
+    for w in range(N_WINDOWS):
+        t0 = time.perf_counter()
+        if w + 2 <= N_WINDOWS:
+            # The slide after next starts transferring now (async
+            # device_put) and overlaps this window's compute + result
+            # fetch — streaming double-buffering.
+            slides.append(slide_arrays(w + 2))
+        (xy_a, oid_a), (xy_b, oid_b) = slides[w], slides[w + 1]
+        res = jstep(xy_a, xy_b, oid_a, oid_b, valid_d, flags_d, q_d)
+        nv = int(res.num_valid)  # result fetch = end-to-end window answer
+        latencies.append(time.perf_counter() - t0)
+        results.append(nv)
+        if w >= 1:
+            slides[w - 1] = None  # free the pane that left the window
+    t_total = time.perf_counter() - t_total0
+
+    # Ingest rate: distinct stream points consumed per second (each point
+    # is ingested once but evaluated in 2 overlapping windows). This is the
+    # quantity comparable to the reference's 20k events/sec baseline;
+    # window-evaluations/sec would double-count the 50% overlap.
+    distinct_points = SLIDE * (N_WINDOWS + 1)
+    points_per_sec = distinct_points / t_total
+    p50_ms = float(np.percentile(latencies, 50) * 1000)
+    assert all(r == K for r in results), f"kNN underfilled: {results[:3]}"
+
+    print(
+        json.dumps(
+            {
+                "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+                "value": round(points_per_sec, 1),
+                "unit": "points/s",
+                "vs_baseline": round(points_per_sec / BASELINE_EPS, 2),
+                "p50_window_latency_ms": round(p50_ms, 3),
+                "device": str(dev),
+                "windows": N_WINDOWS,
+                "k": K,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

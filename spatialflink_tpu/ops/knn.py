@@ -33,7 +33,15 @@ class KnnResult(NamedTuple):
     num_valid: jnp.ndarray  # () number of distinct objects within radius
 
 
-def _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments):
+def _topk_from_point_dists(
+    dist, valid, flags, oid, radius, k, num_segments,
+    axis_name=None, index_base=None,
+):
+    """Shared top-k core. With ``axis_name`` set (inside shard_map), the
+    per-object minima and representative indices are pmin-reduced across the
+    named mesh axis, and ``index_base`` offsets local indices to global ones
+    — the single- and multi-chip paths share one tie-break contract.
+    """
     big = jnp.asarray(jnp.finfo(dist.dtype).max, dist.dtype)
     mask = valid & (flags > 0) & (dist <= radius)
     masked = jnp.where(mask, dist, big)
@@ -41,17 +49,23 @@ def _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments):
     seg_min = jax.ops.segment_min(
         masked, oid, num_segments=num_segments, indices_are_sorted=False
     )  # (U,) min dist per object; +inf where object absent/out of radius
+    if axis_name is not None:
+        seg_min = jax.lax.pmin(seg_min, axis_name=axis_name)
 
     # Representative point per winning object: lowest batch index achieving
     # the object's min distance (deterministic tie-break; the reference's PQ
     # keeps the first-seen of equal distances, KNNQuery.java:221-268).
     n = dist.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
+    if index_base is not None:
+        idx = idx + index_base
     is_winner = mask & (masked == seg_min[oid])
     int_big = jnp.iinfo(jnp.int32).max
     rep = jax.ops.segment_min(
         jnp.where(is_winner, idx, int_big), oid, num_segments=num_segments
     )
+    if axis_name is not None:
+        rep = jax.lax.pmin(rep, axis_name=axis_name)
 
     neg_top, seg_ids = jax.lax.top_k(-seg_min, k)  # smallest distances
     top_dist = -neg_top

@@ -1,0 +1,7 @@
+from spatialflink_tpu.parallel.mesh import make_mesh, data_mesh  # noqa: F401
+from spatialflink_tpu.parallel.sharded import (  # noqa: F401
+    sharded_range_query,
+    sharded_range_query_2d,
+    sharded_knn,
+    sharded_join,
+)

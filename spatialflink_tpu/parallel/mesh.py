@@ -1,0 +1,38 @@
+"""Device-mesh helpers.
+
+The reference scales by Flink keyBy-hash partitioning over a cluster
+(`env.setParallelism(n)`, StreamingJob.java:177; conf default 15). The TPU
+equivalent is a ``jax.sharding.Mesh`` over the chip slice: window batches
+are sharded along a ``data`` axis (the analog of hash partitioning — but
+with no shuffle: the grid prune is a flag gather, not a key exchange), and
+query sets can shard along a second ``query`` axis. Collectives ride ICI
+(psum/pmin/all_gather inside shard_map), not a network stack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: Sequence[int], axis_names: Sequence[str], devices=None
+) -> Mesh:
+    """Build a mesh of the given logical shape over the first
+    prod(shape) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over all (or the first N) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_devices if num_devices is not None else len(devices)
+    return make_mesh((n,), ("data",), devices)

@@ -6,7 +6,8 @@ Must run before the first ``import jax`` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU (the ambient env sets JAX_PLATFORMS=axon for the real chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +16,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Belt and braces: some pytest plugin may import jax before this conftest
+# runs, in which case the env var above is read too late.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # XLA compiles are ~1s each on this host; the persistent cache makes repeat
 # test runs cheap (first run still pays compilation).
