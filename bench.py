@@ -42,9 +42,11 @@ def main() -> None:
     from spatialflink_tpu.ops.cells import assign_cells, gather_cell_flags
     from spatialflink_tpu.ops.knn import knn_kernel
 
+    from __graft_entry__ import BEIJING_GRID_ARGS, QUERY_POINT
+
     dev = jax.devices()[0]
-    grid = UniformGrid(100, min_x=115.5, max_x=117.6, min_y=39.6, max_y=41.1)
-    q = np.array([116.40, 40.19], np.float32)
+    grid = UniformGrid(**BEIJING_GRID_ARGS)
+    q = np.asarray(QUERY_POINT, np.float32)
     flags = grid.neighbor_flags(RADIUS, [grid.flat_cell(*q)])
 
     # Synthetic Beijing stream: enough points for N sliding windows.

@@ -1,0 +1,16 @@
+from spatialflink_tpu.mn.metrics import (  # noqa: F401
+    BUCKETS_MS,
+    FixedBucketLatency,
+    MetricNames,
+    MetricRegistry,
+)
+from spatialflink_tpu.mn.operators import (  # noqa: F401
+    Stamped,
+    CsvParseAndStamp,
+    CountingStage,
+)
+from spatialflink_tpu.mn.sinks import (  # noqa: F401
+    CountingLatencyFileSink,
+    CountingLatencyPrintSink,
+)
+from spatialflink_tpu.mn.reporter import NESFileReporter  # noqa: F401

@@ -1,0 +1,136 @@
+"""NES-compatible instrumentation layer tests."""
+
+import math
+import re
+
+import pytest
+
+from spatialflink_tpu.mn import (
+    BUCKETS_MS,
+    CountingStage,
+    CsvParseAndStamp,
+    FixedBucketLatency,
+    MetricNames,
+    MetricRegistry,
+    NESFileReporter,
+)
+from spatialflink_tpu.mn.queries import (
+    INSTRUMENTED,
+    instrumented_mn_q1,
+    instrumented_mn_q2,
+)
+
+
+def test_bucket_boundaries_are_nes():
+    assert BUCKETS_MS == [0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000,
+                         2000, 5000, 10000, 20000, 60000]
+
+
+def test_histogram_bucketing_and_percentiles():
+    h = FixedBucketLatency()
+    for v in [0, 1, 3, 100, 70_000]:
+        h.observe(v)
+    # 3ms → bucket le_4; 100 → le_128; 70000 clamps to le_60000.
+    assert h.buckets[BUCKETS_MS.index(0)] == 1
+    assert h.buckets[BUCKETS_MS.index(1)] == 1
+    assert h.buckets[BUCKETS_MS.index(4)] == 1
+    assert h.buckets[BUCKETS_MS.index(128)] == 1
+    assert h.buckets[-1] == 1
+    assert h.count == 5
+    assert h.percentile(0.50) == 4.0  # 3rd of 5 samples → le_4
+    assert h.percentile(0.99) == 60000.0
+    assert math.isnan(FixedBucketLatency().percentile(0.5))
+
+
+def test_counting_stage_selectivity():
+    reg = MetricRegistry()
+    stage = CountingStage("6_range", reg)
+    out = list(stage.around(range(10), lambda it: (x for x in it if x % 2 == 0)))
+    assert out == [0, 2, 4, 6, 8]
+    assert reg.counter("pipe_6_range_in_total") == 10
+    assert reg.counter("pipe_6_range_out_total") == 5
+
+
+def test_parse_and_stamp_counts_and_skips():
+    reg = MetricRegistry()
+    parse = CsvParseAndStamp(lambda ln: int(ln), reg, 1000, 64)
+    out = list(parse(["1", "x", "2"]))
+    assert [s.value for s in out] == [1, 2]
+    assert reg.counter(MetricNames.SOURCE_IN) == 2
+    assert out[0].ingest_ns <= out[1].ingest_ns
+    snap = reg.snapshot()
+    assert snap["theoretical_eps"] == 1000.0
+    assert snap["theoretical_throughput_mb_s"] == pytest.approx(0.064)
+
+
+def test_reporter_line_format(tmp_path):
+    reg = MetricRegistry()
+    rep = NESFileReporter(reg, "qx", out_dir=str(tmp_path), interval_s=5)
+    reg.inc(MetricNames.SOURCE_IN, 100)
+    reg.inc(MetricNames.SINK_OUT, 25)
+    reg.inc(MetricNames.OUT_BYTES, 12_500)
+    line = rep.report(now=1_700_000_000.0)
+    m = re.match(
+        r"METRICS ts=\S+ eps_in_avg=(\S+) eps_out_avg=(\S+) "
+        r"selectivity_e2e=(\S+) throughput_mb_s=(\S+)",
+        line,
+    )
+    assert m, line
+    assert float(m.group(3)) == pytest.approx(0.25)
+    # Second interval with no traffic → zeros, nan selectivity.
+    line2 = rep.report(now=1_700_000_005.0)
+    assert "eps_in_avg=0.00" in line2 and "selectivity_e2e=nan" in line2
+    assert (tmp_path / "EngineStats_qx_proc.stats").read_text().count("\n") == 2
+
+
+def _csv_lines(n=3000, near_every=3):
+    lines = []
+    for i in range(n):
+        # Every `near_every`-th point is near the query point (4.3658, 50.6456).
+        if i % near_every == 0:
+            lon, lat = 4.3658, 50.6456
+        else:
+            lon, lat = 5.9, 51.9
+        lines.append(
+            f"{i*10},dev{i%5},z,4.{i%10},5.0,a,b,c,d,e,f,{30+(i%20)},{lat},{lon}"
+        )
+    return lines
+
+
+def test_instrumented_q1_end_to_end(tmp_path):
+    props = {
+        "output.file": str(tmp_path / "q1.txt"),
+        "stats.dir": str(tmp_path),
+        "tol.meters": "2000.0",
+    }
+    rep = instrumented_mn_q1(iter(_csv_lines()), props)
+    assert rep.results > 0
+    m = rep.metrics
+    assert m["source_in_total"] == 3000
+    assert m["pipe_6_range_in_total"] == 3000
+    assert m["pipe_6_range_out_total"] == 1000  # 1-in-3 near the query
+    assert m["sink_out_total"] == rep.results
+    assert m["out_bytes_total"] > 0
+    assert rep.p50_ms in [float(b) for b in BUCKETS_MS]
+    # Counts per 5s window: 30s of data → 6 windows of ~167 qualifying each.
+    total = sum(int(ln.split(",")[2]) for ln in open(props["output.file"]))
+    assert total == 1000
+    assert "METRICS ts=" in rep.stats_lines[0]
+
+
+def test_instrumented_q2_variance(tmp_path):
+    props = {"output.file": str(tmp_path / "q2.txt"), "stats.dir": str(tmp_path)}
+    rep = instrumented_mn_q2(iter(_csv_lines(2000)), props)
+    assert rep.results > 0
+    # All in-box points (4.0-4.6 × 50.0-50.8) excluded; far points kept.
+    assert rep.metrics["pipe_3_exclude_in_total"] == 2000
+
+
+def test_all_instrumented_queries_run(tmp_path):
+    for q, fn in INSTRUMENTED.items():
+        props = {
+            "output.file": str(tmp_path / f"{q}.txt"),
+            "stats.dir": str(tmp_path),
+        }
+        rep = fn(iter(_csv_lines(1500)), props)
+        assert rep.metrics["source_in_total"] == 1500, q
