@@ -1,0 +1,198 @@
+// Native ingest runtime: high-rate GPS CSV parsing + device-id interning.
+//
+// The hot host-side loop of the framework is stream ingest: the reference
+// parses CSV per record on the JVM (sncb/common/CSVToGpsEventMapFunction.java,
+// com/mn/operators/CsvParseAndStamp.java). Python-side parsing tops out
+// around 10^5 rows/s — far below what a single TPU chip consumes. This
+// library parses whole buffers into the structure-of-arrays layout the
+// batch kernels take directly (ts, lon, lat, speed, fa, ff, interned
+// device id), at tens of millions of rows/s.
+//
+// Contract mirrors csv_to_gps_event (14-column schema: ts@0, deviceId@1,
+// PCFA@3, PCFF@4, speed@11, lat@12, lon@13; unparseable numerics -> 0).
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+
+#include <charconv>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Interner {
+  // string_view keys point into deque-stored strings (stable addresses),
+  // so the hot lookup path allocates nothing.
+  std::unordered_map<std::string_view, int32_t> map;
+  std::deque<std::string> table;
+
+  int32_t intern(std::string_view s) {
+    auto it = map.find(s);
+    if (it != map.end()) return it->second;
+    int32_t id = static_cast<int32_t>(table.size());
+    table.emplace_back(s);
+    map.emplace(std::string_view(table.back()), id);
+    return id;
+  }
+};
+
+// Fast, locale-independent float parse over a field; returns 0.0 on junk
+// (the reference's catch-all).
+double parse_double(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '"')) ++p;
+  while (end > p && (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '"' ||
+                     end[-1] == '\r'))
+    --end;
+  if (p >= end) return 0.0;
+  double v = 0.0;
+  auto res = std::from_chars(p, end, v);
+  if (res.ec != std::errc() || res.ptr != end) return 0.0;
+  return v;
+}
+
+int64_t parse_long(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '"')) ++p;
+  while (end > p && (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '"' ||
+                     end[-1] == '\r'))
+    --end;
+  if (p >= end) return 0;
+  int64_t v = 0;
+  auto res = std::from_chars(p, end, v);
+  if (res.ec != std::errc() || res.ptr != end) return 0;
+  return v;
+}
+
+std::string_view trim(std::string_view s) {
+  size_t a = 0, b = s.size();
+  while (a < b && (s[a] == ' ' || s[a] == '\t' || s[a] == '"')) ++a;
+  while (b > a && (s[b - 1] == ' ' || s[b - 1] == '\t' || s[b - 1] == '"' ||
+                   s[b - 1] == '\r'))
+    --b;
+  return s.substr(a, b - a);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sf_interner_new() { return new Interner(); }
+
+void sf_interner_free(void* h) { delete static_cast<Interner*>(h); }
+
+int32_t sf_interner_size(void* h) {
+  return static_cast<int32_t>(static_cast<Interner*>(h)->table.size());
+}
+
+// Copy the string for id into out (cap bytes incl. NUL). Returns length or
+// -1 if id out of range / cap too small.
+int64_t sf_interner_get(void* h, int32_t id, char* out, int64_t cap) {
+  auto* in = static_cast<Interner*>(h);
+  if (id < 0 || static_cast<size_t>(id) >= in->table.size()) return -1;
+  const std::string& s = in->table[static_cast<size_t>(id)];
+  if (static_cast<int64_t>(s.size()) + 1 > cap) return -1;
+  std::memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return static_cast<int64_t>(s.size());
+}
+
+// Parse up to max_rows lines of 14-column GPS CSV from buf[0..len).
+// Outputs are caller-allocated arrays of capacity max_rows. Lines with
+// fewer than 14 fields are skipped. Returns rows written.
+int64_t sf_parse_gps_csv(void* interner_h, const char* buf, int64_t len,
+                         char delim, int64_t max_rows, int64_t* ts,
+                         double* lon, double* lat, double* speed, double* fa,
+                         double* ff, int32_t* dev) {
+  auto* interner = static_cast<Interner*>(interner_h);
+  int64_t rows = 0;
+  const char* p = buf;
+  const char* buf_end = buf + len;
+  const char* fields[14];
+  const char* field_ends[14];
+
+  while (p < buf_end && rows < max_rows) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(buf_end - p)));
+    if (line_end == nullptr) line_end = buf_end;
+
+    // Split first 14 fields.
+    int nf = 0;
+    const char* f = p;
+    while (nf < 14 && f <= line_end) {
+      const char* c = static_cast<const char*>(
+          std::memchr(f, delim, static_cast<size_t>(line_end - f)));
+      if (c == nullptr) c = line_end;
+      fields[nf] = f;
+      field_ends[nf] = c;
+      ++nf;
+      f = c + 1;
+      if (c == line_end) break;
+    }
+    if (nf >= 14) {
+      ts[rows] = parse_long(fields[0], field_ends[0]);
+      std::string_view d =
+          trim(std::string_view(fields[1], static_cast<size_t>(field_ends[1] - fields[1])));
+      dev[rows] = interner->intern(d);
+      fa[rows] = parse_double(fields[3], field_ends[3]);
+      ff[rows] = parse_double(fields[4], field_ends[4]);
+      speed[rows] = parse_double(fields[11], field_ends[11]);
+      lat[rows] = parse_double(fields[12], field_ends[12]);
+      lon[rows] = parse_double(fields[13], field_ends[13]);
+      ++rows;
+    }
+    p = line_end + 1;
+  }
+  return rows;
+}
+
+// Generic schema variant for the CSV/TSV point streams
+// (csvTsvSchemaAttr positions [objID, timestamp, x, y] —
+// Deserialization.CSVTSVToTSpatial). Returns rows written.
+int64_t sf_parse_points_csv(void* interner_h, const char* buf, int64_t len,
+                            char delim, int32_t i_oid, int32_t i_ts,
+                            int32_t i_x, int32_t i_y, int64_t max_rows,
+                            int64_t* ts, double* x, double* y, int32_t* oid) {
+  auto* interner = static_cast<Interner*>(interner_h);
+  int32_t need = std::max(std::max(i_oid, i_ts), std::max(i_x, i_y)) + 1;
+  std::vector<const char*> fs(static_cast<size_t>(need));
+  std::vector<const char*> fe(static_cast<size_t>(need));
+  int64_t rows = 0;
+  const char* p = buf;
+  const char* buf_end = buf + len;
+
+  while (p < buf_end && rows < max_rows) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(buf_end - p)));
+    if (line_end == nullptr) line_end = buf_end;
+
+    int nf = 0;
+    const char* f = p;
+    while (nf < need && f <= line_end) {
+      const char* c = static_cast<const char*>(
+          std::memchr(f, delim, static_cast<size_t>(line_end - f)));
+      if (c == nullptr) c = line_end;
+      fs[static_cast<size_t>(nf)] = f;
+      fe[static_cast<size_t>(nf)] = c;
+      ++nf;
+      f = c + 1;
+      if (c == line_end) break;
+    }
+    if (nf >= need) {
+      ts[rows] = parse_long(fs[static_cast<size_t>(i_ts)], fe[static_cast<size_t>(i_ts)]);
+      x[rows] = parse_double(fs[static_cast<size_t>(i_x)], fe[static_cast<size_t>(i_x)]);
+      y[rows] = parse_double(fs[static_cast<size_t>(i_y)], fe[static_cast<size_t>(i_y)]);
+      std::string_view d = trim(std::string_view(
+          fs[static_cast<size_t>(i_oid)],
+          static_cast<size_t>(fe[static_cast<size_t>(i_oid)] - fs[static_cast<size_t>(i_oid)])));
+      oid[rows] = interner->intern(d);
+      ++rows;
+    }
+    p = line_end + 1;
+  }
+  return rows;
+}
+
+}  // extern "C"

@@ -15,7 +15,6 @@ measured from the oldest event in the window.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -26,20 +25,21 @@ from spatialflink_tpu.mn.operators import CountingStage, CsvParseAndStamp, Stamp
 from spatialflink_tpu.mn.reporter import NESFileReporter
 from spatialflink_tpu.mn.sinks import CountingLatencyFileSink
 from spatialflink_tpu.sncb.common import GpsEvent, csv_to_gps_event
-from spatialflink_tpu.sncb.mobility import Q5_FENCE
 from spatialflink_tpu.sncb.ops import traj_speed, trajectory_wkt, variance
 from spatialflink_tpu.streams.windows import SlidingEventTimeWindows, WindowAssembler
 
+# The reference's -D system-property names and defaults
+# (InstrumentedMN_Q1.java:86-95, InstrumentedMN_Q5.java:79-83).
 _DEFAULTS = {
     "rows.per.sec": "20000",
     "tcp.host": "localhost",
     "tcp.port": "32323",
     "query.lon": "4.3658",
     "query.lat": "50.6456",
-    "tol.meters": "2000.0",
+    "tolerance.meters": "100.0",
     "output.file": "metrics/mn_instrumented_results.txt",
     "stats.dir": "metrics",
-    "bytes.per.record": "128",
+    "bytes.per.input": "128",
 }
 
 
@@ -86,7 +86,7 @@ def _run(
         lambda ln: csv_to_gps_event(ln),
         registry,
         theoretical_rows_per_sec=int(p["rows.per.sec"]),
-        bytes_per_record=int(p["bytes.per.record"]),
+        bytes_per_record=int(p["bytes.per.input"]),
     )
     reporter = NESFileReporter(registry, query_id, out_dir=p["stats.dir"])
     src_count = CountingStage("0_source", registry)
@@ -98,9 +98,9 @@ def _run(
     ) as sink:
         stamped = parse(src_count.count_out(lines))
         for result, ingest_ns in pipeline(stamped, registry, p):
-            for _ in sink_count.count_in([result]):
-                pass
+            registry.inc(sink_count.in_name)
             sink(result, ingest_ns)
+            registry.inc(sink_count.out_name)
             n_results += 1
     line = reporter.report()
     return InstrumentedReport(
@@ -125,7 +125,7 @@ def instrumented_mn_q1(lines: Iterable[str],
 
     def pipeline(stamped, registry, p):
         lon, lat = float(p["query.lon"]), float(p["query.lat"])
-        tol_m = float(p["tol.meters"])
+        tol_m = float(p["tolerance.meters"])
         rng_count = CountingStage("6_range", registry)
         win_count = CountingStage("8_window", registry)
 
@@ -187,7 +187,8 @@ def instrumented_mn_q3(lines: Iterable[str],
 
 def instrumented_mn_q4(lines: Iterable[str],
                        props: Optional[Dict[str, str]] = None) -> InstrumentedReport:
-    """Q4: bbox/time-restricted global trajectory, 20s/2s windows."""
+    """Q4: Brussels-bbox-restricted global trajectory, 3s/1s windows
+    (InstrumentedMN_Q4.java:99-101, :152)."""
 
     def pipeline(stamped, registry, p):
         flt = CountingStage("2_filter", registry)
@@ -195,10 +196,11 @@ def instrumented_mn_q4(lines: Iterable[str],
         def bbox_time(items):
             for s in items:
                 e = s.value
-                if 4.0 <= e.lon <= 5.0 and 50.0 <= e.lat <= 51.0:
+                # Brussels bounds (InstrumentedMN_Q4.java:99-101).
+                if 4.287 <= e.lon <= 4.419 and 50.773 <= e.lat <= 50.896:
                     yield s
 
-        for win in _stamped_windows(flt.around(stamped, bbox_time), 20_000, 2000):
+        for win in _stamped_windows(flt.around(stamped, bbox_time), 3000, 1000):
             wkt = trajectory_wkt([s.value for s in win.events])
             ingest = min((s.ingest_ns for s in win.events), default=None)
             yield (win.start, win.end, "ALL", wkt), ingest
@@ -215,8 +217,14 @@ def instrumented_mn_q5(lines: Iterable[str],
     def pipeline(stamped, registry, p):
         from spatialflink_tpu.sncb.common import BufferedZone
 
+        # Reference fence: {4.3,50.8} {4.4,50.8} {4.4,50.9} {4.3,50.9}
+        # with configurable degree-space tolerance
+        # (InstrumentedMN_Q5.java:83-87).
+        fence_ring = [[4.3, 50.8], [4.4, 50.8], [4.4, 50.9], [4.3, 50.9],
+                      [4.3, 50.8]]
         fence = BufferedZone(
-            rings_metric=[np.asarray(Q5_FENCE, float)], buffer_m=0.001
+            rings_metric=[np.asarray(fence_ring, float)],
+            buffer_m=float(p["tolerance.meters"]),
         )
         fence_count = CountingStage("4_fence", registry)
 

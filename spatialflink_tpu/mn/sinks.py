@@ -22,7 +22,9 @@ class _CountingLatencySinkBase:
         self.registry.inc(MetricNames.SINK_OUT)
         self.registry.inc(MetricNames.OUT_BYTES, len(rendered) + 1)
         if ingest_ns is not None:
-            self.histogram.observe((time.monotonic_ns() - ingest_ns) / 1e6)
+            # Whole-ms truncation like the reference (deltaNs / 1_000_000
+            # as integer division) so bucket placement matches exactly.
+            self.histogram.observe((time.monotonic_ns() - ingest_ns) // 1_000_000)
 
 
 class CountingLatencyFileSink(_CountingLatencySinkBase):

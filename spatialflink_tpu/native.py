@@ -1,0 +1,181 @@
+"""ctypes bindings for the native ingest runtime (``native/sfnative.cpp``).
+
+``NativeGpsParser`` parses whole CSV buffers into the SoA arrays the batch
+kernels consume, with persistent device-id interning. Falls back to the
+pure-Python serde if the shared library isn't built; ``ensure_built()``
+compiles it on demand with the in-image toolchain (g++, no pybind11 —
+plain C ABI via ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsfnative.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the shared library if missing. Returns availability."""
+    global _build_failed
+    if os.path.exists(_LIB_PATH):
+        return True
+    if _build_failed:
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=quiet,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        _build_failed = True
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.sf_interner_new.restype = ctypes.c_void_p
+    lib.sf_interner_free.argtypes = [ctypes.c_void_p]
+    lib.sf_interner_size.argtypes = [ctypes.c_void_p]
+    lib.sf_interner_size.restype = ctypes.c_int32
+    lib.sf_interner_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.sf_interner_get.restype = ctypes.c_int64
+    dbl_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    i64_p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.sf_parse_gps_csv.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+        ctypes.c_int64, i64_p, dbl_p, dbl_p, dbl_p, dbl_p, dbl_p, i32_p,
+    ]
+    lib.sf_parse_gps_csv.restype = ctypes.c_int64
+    lib.sf_parse_points_csv.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, i64_p, dbl_p, dbl_p, i32_p,
+    ]
+    lib.sf_parse_points_csv.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeGpsParser:
+    """Buffer-at-a-time 14-column GPS CSV parser with device interning.
+
+    ``parse(data)`` → dict of SoA numpy arrays (ts, lon, lat, speed, fa,
+    ff, dev). Device ids are dense int32, stable across calls; decode with
+    ``device_name(id)`` / ``device_table()``.
+    """
+
+    def __init__(self, delimiter: str = ","):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable (build failed); use the Python serde"
+            )
+        self._lib = lib
+        self._h = lib.sf_interner_new()
+        self.delimiter = delimiter.encode()[:1]
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            self._lib.sf_interner_free(self._h)
+            self._h = None
+
+    def parse(self, data: bytes | str) -> Dict[str, np.ndarray]:
+        if isinstance(data, str):
+            data = data.encode()
+        max_rows = data.count(b"\n") + 1
+        ts = np.empty(max_rows, np.int64)
+        lon = np.empty(max_rows, np.float64)
+        lat = np.empty(max_rows, np.float64)
+        speed = np.empty(max_rows, np.float64)
+        fa = np.empty(max_rows, np.float64)
+        ff = np.empty(max_rows, np.float64)
+        dev = np.empty(max_rows, np.int32)
+        n = self._lib.sf_parse_gps_csv(
+            self._h, data, len(data), self.delimiter, max_rows,
+            ts, lon, lat, speed, fa, ff, dev,
+        )
+        return {
+            "ts": ts[:n], "lon": lon[:n], "lat": lat[:n], "speed": speed[:n],
+            "fa": fa[:n], "ff": ff[:n], "dev": dev[:n],
+        }
+
+    @property
+    def num_devices(self) -> int:
+        return int(self._lib.sf_interner_size(self._h))
+
+    def device_name(self, dev_id: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        n = self._lib.sf_interner_get(self._h, dev_id, buf, 256)
+        if n < 0:
+            raise KeyError(dev_id)
+        return buf.value.decode()
+
+    def device_table(self) -> List[str]:
+        return [self.device_name(i) for i in range(self.num_devices)]
+
+
+class NativePointParser:
+    """Schema-positional point CSV parser (csvTsvSchemaAttr semantics)."""
+
+    def __init__(self, schema=(0, 1, 2, 3), delimiter: str = ","):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.sf_interner_new()
+        self.schema = tuple(int(i) for i in schema)
+        self.delimiter = delimiter.encode()[:1]
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            self._lib.sf_interner_free(self._h)
+            self._h = None
+
+    def parse(self, data: bytes | str) -> Dict[str, np.ndarray]:
+        if isinstance(data, str):
+            data = data.encode()
+        max_rows = data.count(b"\n") + 1
+        ts = np.empty(max_rows, np.int64)
+        x = np.empty(max_rows, np.float64)
+        y = np.empty(max_rows, np.float64)
+        oid = np.empty(max_rows, np.int32)
+        i_oid, i_ts, i_x, i_y = self.schema
+        n = self._lib.sf_parse_points_csv(
+            self._h, data, len(data), self.delimiter,
+            i_oid, i_ts, i_x, i_y, max_rows, ts, x, y, oid,
+        )
+        return {"ts": ts[:n], "x": x[:n], "y": y[:n], "oid": oid[:n]}
+
+    @property
+    def num_objects(self) -> int:
+        return int(self._lib.sf_interner_size(self._h))
+
+    def object_name(self, oid: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        n = self._lib.sf_interner_get(self._h, oid, buf, 256)
+        if n < 0:
+            raise KeyError(oid)
+        return buf.value.decode()

@@ -1,0 +1,49 @@
+"""Misc helpers mirroring ``GeoFlink/utils/HelperClass.java`` leftovers."""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+import numpy as np
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import Polygon
+
+
+def generate_query_polygons(
+    num: int,
+    min_x: float,
+    min_y: float,
+    max_x: float,
+    max_y: float,
+    grid_size: int = 100,
+    seed: int = 0,
+) -> List[Polygon]:
+    """Random small rectangular query polygons inside a bbox
+    (HelperClass.generateQueryPolygons, HelperClass.java:387-439: polygon
+    side = bbox span / grid_size, uniformly placed)."""
+    rng = np.random.default_rng(seed)
+    len_x = (max_x - min_x) / grid_size
+    len_y = (max_y - min_y) / grid_size
+    out = []
+    for i in range(num):
+        x0 = rng.uniform(min_x, max_x - len_x)
+        y0 = rng.uniform(min_y, max_y - len_y)
+        ring = np.array(
+            [[x0, y0], [x0 + len_x, y0], [x0 + len_x, y0 + len_y],
+             [x0, y0 + len_y], [x0, y0]]
+        )
+        out.append(Polygon(obj_id=f"qpoly{i}", rings=[ring]))
+    return out
+
+
+def pad_leading_zeroes(value: int, width: int = 5) -> str:
+    """HelperClass.padLeadingZeroesToInt."""
+    return f"{value:0{width}d}"
+
+
+def cells_of_polygon_set(grid: UniformGrid, polygons) -> Set[int]:
+    cells: Set[int] = set()
+    for p in polygons:
+        cells.update(p.grid_cells(grid))
+    return cells

@@ -1,0 +1,85 @@
+"""Native C++ ingest parser tests: parity with the Python serde + speed."""
+
+import time
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import native
+from spatialflink_tpu.sncb.common import csv_to_gps_event
+from spatialflink_tpu.streams.serde import parse_csv_point
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library could not be built"
+)
+
+
+def make_lines(n=5000):
+    lines = []
+    for i in range(n):
+        lines.append(
+            f"{i*50},dev{i%17},z,4.{i%10},5.{i%7},a,b,c,d,e,f,"
+            f"{20.5+(i%30)},{50.6+i*1e-6},{4.36+i*1e-6}"
+        )
+    return lines
+
+
+def test_gps_parser_matches_python_serde():
+    lines = make_lines(2000)
+    p = native.NativeGpsParser()
+    out = p.parse("\n".join(lines))
+    assert len(out["ts"]) == 2000
+    for i in (0, 1, 999, 1999):
+        ref = csv_to_gps_event(lines[i])
+        assert out["ts"][i] == ref.ts
+        assert out["lon"][i] == pytest.approx(ref.lon, rel=1e-15)
+        assert out["lat"][i] == pytest.approx(ref.lat, rel=1e-15)
+        assert out["speed"][i] == pytest.approx(ref.gps_speed, rel=1e-15)
+        assert out["fa"][i] == pytest.approx(ref.fa, rel=1e-15)
+        assert out["ff"][i] == pytest.approx(ref.ff, rel=1e-15)
+        assert p.device_name(int(out["dev"][i])) == ref.device_id
+    assert p.num_devices == 17
+
+
+def test_gps_parser_interning_stable_across_calls():
+    p = native.NativeGpsParser()
+    a = p.parse("\n".join(make_lines(100)))
+    b = p.parse("\n".join(make_lines(100)))
+    np.testing.assert_array_equal(a["dev"], b["dev"])
+
+
+def test_gps_parser_skips_short_and_junk_lines():
+    p = native.NativeGpsParser()
+    lines = make_lines(10)
+    data = lines[0] + "\nshort,line\n" + lines[1] + "\n\n" + lines[2]
+    out = p.parse(data)
+    assert len(out["ts"]) == 3
+    # Junk numerics → 0 (reference catch-all parity).
+    bad = "xx,devA,z,bad,bad,a,b,c,d,e,f,bad,bad,bad"
+    out2 = p.parse(bad)
+    assert out2["ts"][0] == 0 and out2["lon"][0] == 0.0
+    assert p.device_name(int(out2["dev"][0])) == "devA"
+
+
+def test_point_parser_schema_positions():
+    p = native.NativePointParser(schema=(1, 4, 5, 6))
+    line = 'ignored, "veh7", a, b, 123456, 116.5, 40.1'
+    out = p.parse(line)
+    ref = parse_csv_point(line, schema=[1, 4, 5, 6])
+    assert out["ts"][0] == ref.timestamp
+    assert out["x"][0] == ref.x and out["y"][0] == ref.y
+    assert p.object_name(int(out["oid"][0])) == ref.obj_id
+
+
+def test_native_parser_speed():
+    lines = make_lines(200_000)
+    data = "\n".join(lines).encode()
+    p = native.NativeGpsParser()
+    t0 = time.perf_counter()
+    out = p.parse(data)
+    dt = time.perf_counter() - t0
+    assert len(out["ts"]) == 200_000
+    rows_per_sec = 200_000 / dt
+    # Must beat Python parsing by a wide margin (>2M rows/s native vs
+    # ~0.1M for the Python serde on this host).
+    assert rows_per_sec > 2_000_000, f"native parser too slow: {rows_per_sec:.0f}/s"
