@@ -3,9 +3,10 @@
 The reference is state-backend-ready but never enables checkpointing
 (SURVEY.md §5: ListState/MapState/ValueState exist, no
 ``enableCheckpointing`` call anywhere). Here operator state is explicit
-host data, so snapshots are trivial: every stateful component implements
-``get_state()/set_state()`` and ``save_checkpoint``/``load_checkpoint``
-persist the whole pipeline state as one npz+json bundle.
+host data, so snapshots are trivial: component states are plain dicts and
+``save_checkpoint``/``load_checkpoint`` persist them as one pickle file
+with an atomic publish. Checkpoints are trusted local state (pickle — do
+not load files from untrusted sources).
 
 Snapshottable components:
   - WindowAssembler: open window buffers, fired flags, max event-time,
@@ -17,7 +18,6 @@ Snapshottable components:
 
 from __future__ import annotations
 
-import json
 import os
 import pickle
 from typing import Any, Dict
@@ -62,7 +62,7 @@ def operator_state(op) -> Dict[str, Any]:
     """Snapshot the known stateful fields of an operator instance."""
     out: Dict[str, Any] = {"interner": interner_state(op.interner)}
     if hasattr(op, "_state"):  # TAggregateQuery MapState
-        out["agg_state"] = {f"{c}|{o}": v for (c, o), v in op._state.items()}
+        out["agg_state"] = dict(op._state)
     if hasattr(op, "_running"):  # TStatsQuery ValueState
         out["running"] = dict(op._running)
     return out
@@ -71,12 +71,9 @@ def operator_state(op) -> Dict[str, Any]:
 def restore_operator(op, state: Dict[str, Any]) -> None:
     restore_interner(op.interner, state["interner"])
     if "agg_state" in state and hasattr(op, "_state"):
-        op._state = {
-            (int(k.split("|", 1)[0]), k.split("|", 1)[1]): tuple(v)
-            for k, v in state["agg_state"].items()
-        }
+        op._state = dict(state["agg_state"])
     if "running" in state and hasattr(op, "_running"):
-        op._running = {k: tuple(v) for k, v in state["running"].items()}
+        op._running = dict(state["running"])
 
 
 def save_checkpoint(path: str, **components) -> None:

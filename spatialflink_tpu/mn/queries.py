@@ -36,7 +36,9 @@ _DEFAULTS = {
     "tcp.port": "32323",
     "query.lon": "4.3658",
     "query.lat": "50.6456",
-    "tolerance.meters": "100.0",
+    # tolerance.meters has per-query defaults: Q1 = 100.0 (true meters via
+    # the x111320 conversion), Q5 = 0.001 (degree-space, the reference's
+    # "degrees approximation" — InstrumentedMN_Q5.java:83).
     "output.file": "metrics/mn_instrumented_results.txt",
     "stats.dir": "metrics",
     "bytes.per.input": "128",
@@ -125,7 +127,7 @@ def instrumented_mn_q1(lines: Iterable[str],
 
     def pipeline(stamped, registry, p):
         lon, lat = float(p["query.lon"]), float(p["query.lat"])
-        tol_m = float(p["tolerance.meters"])
+        tol_m = float(p.get("tolerance.meters", "100.0"))
         rng_count = CountingStage("6_range", registry)
         win_count = CountingStage("8_window", registry)
 
@@ -224,7 +226,7 @@ def instrumented_mn_q5(lines: Iterable[str],
                       [4.3, 50.8]]
         fence = BufferedZone(
             rings_metric=[np.asarray(fence_ring, float)],
-            buffer_m=float(p["tolerance.meters"]),
+            buffer_m=float(p.get("tolerance.meters", "0.001")),
         )
         fence_count = CountingStage("4_fence", registry)
 

@@ -32,7 +32,7 @@ from spatialflink_tpu.operators.base import (
     pack_query_geometries,
     pack_query_points,
 )
-from spatialflink_tpu.ops.cells import gather_cell_flags
+from spatialflink_tpu.ops.cells import gather_cell_flags  # noqa: F401 (incremental)
 from spatialflink_tpu.ops.range import (
     geometry_range_query_kernel,
     range_query_kernel,
@@ -102,6 +102,60 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
     """range/PointPointRangeQuery.java (realtime :44-108, window :111-187)."""
 
     query_kind = "point"
+
+    def query_incremental(
+        self,
+        stream: Iterable[Point],
+        query_point: Point,
+        radius: float,
+        dtype=np.float64,
+    ) -> Iterator[RangeResult]:
+        """Incremental sliding-window variant (PointPointRangeQuery.java:195-296):
+        per window, previously-qualified results are re-emitted from carried
+        state; the distance kernel only evaluates the window's NEWEST slide
+        pane (ts >= end - slide). Carried results older than start + slide
+        are dropped. Per-window device work shrinks from O(window) to
+        O(slide).
+        """
+        flags = flags_for_queries(self.grid, radius, [query_point])
+        flags_d = jnp.asarray(flags)
+        pk = jitted(range_query_kernel, "approximate")
+        q = jnp.asarray(np.array([[query_point.x, query_point.y]], dtype))
+        slide_ms = self.conf.slide_step_ms
+        carry: List[tuple] = []  # (event, dist)
+
+        for win in self.windows(stream):
+            objects: List[SpatialObject] = []
+            dists: List[float] = []
+            next_carry = []
+            for ev, d in carry:
+                if win.start <= ev.timestamp < win.end:
+                    objects.append(ev)
+                    dists.append(d)
+                    if ev.timestamp >= win.start + slide_ms:
+                        next_carry.append((ev, d))
+            new_events = [
+                e for e in win.events if e.timestamp >= win.end - slide_ms
+            ]
+            if new_events:
+                batch = self.point_batch(new_events, dtype=dtype)
+                pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
+                keep, dist = pk(
+                    jnp.asarray(batch.xy), jnp.asarray(batch.valid), pflags,
+                    q, radius, approximate=self.conf.approximate_query,
+                )
+                keep = np.asarray(keep)
+                dist = np.asarray(dist)
+                for i in np.nonzero(keep)[0]:
+                    ev, d = new_events[i], float(dist[i])
+                    objects.append(ev)
+                    dists.append(d)
+                    if ev.timestamp >= win.start + slide_ms:
+                        next_carry.append((ev, d))
+            carry = next_carry
+            yield RangeResult(
+                win.start, win.end, objects, np.asarray(dists), len(win.events)
+            )
 
 
 class PointPolygonRangeQuery(_PointStreamRangeQuery):

@@ -4,4 +4,5 @@ from spatialflink_tpu.parallel.sharded import (  # noqa: F401
     sharded_range_query_2d,
     sharded_knn,
     sharded_join,
+    sharded_traj_stats,
 )

@@ -135,6 +135,73 @@ def sharded_knn(
     return fn(xy, valid, flags, oid, query_xy)
 
 
+def sharded_traj_stats(
+    mesh: Mesh,
+    xy: jnp.ndarray,
+    ts: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_segments: int,
+):
+    """Sequence-parallel trajectory statistics with halo exchange.
+
+    The long-trajectory analog of sequence/context parallelism: the
+    (oid, ts)-sorted point sequence is sharded over ``data``; each shard
+    computes consecutive-point contributions locally, and the one pair that
+    straddles each shard boundary is recovered by passing every shard's
+    *last* point to its right neighbor via ``lax.ppermute`` (a ring halo
+    exchange over ICI). Per-object partials are then psum'd. Exactly equals
+    the single-device ops.trajectory.traj_stats_kernel.
+    """
+    from spatialflink_tpu.ops.distances import point_point_distance
+
+    def local(xy_l, ts_l, oid_l, valid_l):
+        n_shards = jax.lax.axis_size("data")
+        # Ring halo: receive the previous shard's last (xy, ts, oid, valid).
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        prev_xy = jax.lax.ppermute(xy_l[-1], "data", perm)
+        prev_ts = jax.lax.ppermute(ts_l[-1], "data", perm)
+        prev_oid = jax.lax.ppermute(oid_l[-1], "data", perm)
+        prev_valid = jax.lax.ppermute(valid_l[-1], "data", perm)
+        # Shard 0 has no predecessor: mask its halo pair.
+        first = jax.lax.axis_index("data") == 0
+        prev_valid = prev_valid & ~first
+
+        xy_ext = jnp.concatenate([prev_xy[None, :], xy_l], axis=0)
+        ts_ext = jnp.concatenate([prev_ts[None], ts_l], axis=0)
+        oid_ext = jnp.concatenate([prev_oid[None], oid_l], axis=0)
+        valid_ext = jnp.concatenate([prev_valid[None], valid_l], axis=0)
+
+        same_traj = (oid_ext[1:] == oid_ext[:-1]) & valid_ext[1:] & valid_ext[:-1]
+        seg_d = point_point_distance(xy_ext[1:], xy_ext[:-1])
+        seg_t = (ts_ext[1:] - ts_ext[:-1]).astype(seg_d.dtype)
+        spatial = jax.ops.segment_sum(
+            jnp.where(same_traj, seg_d, 0), oid_l, num_segments=num_segments
+        )
+        temporal = jax.ops.segment_sum(
+            jnp.where(same_traj, seg_t, 0), oid_l, num_segments=num_segments
+        )
+        count = jax.ops.segment_sum(
+            valid_l.astype(jnp.int32), oid_l, num_segments=num_segments
+        )
+        spatial = jax.lax.psum(spatial, "data")
+        temporal = jax.lax.psum(temporal, "data")
+        count = jax.lax.psum(count, "data")
+        speed = jnp.where(
+            temporal > 0, spatial / jnp.where(temporal > 0, temporal, 1), 0.0
+        )
+        return spatial, temporal, count, speed
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(xy, ts, oid, valid)
+
+
 def sharded_join(
     mesh: Mesh,
     left_xy: jnp.ndarray,

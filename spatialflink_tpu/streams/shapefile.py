@@ -131,13 +131,26 @@ def write_shapefile(path: str, objects: List[SpatialObject]) -> None:
             if isinstance(obj, MultiLineString):
                 parts = obj.parts
             elif isinstance(obj, Polygon):
+                # Spec winding: exterior rings clockwise, holes
+                # counter-clockwise. For a plain Polygon, rings[0] is the
+                # exterior; a MultiPolygon's ring list alternates via parts
+                # (each member's first ring exterior).
+                exterior_idx = set()
+                if isinstance(obj, MultiPolygon) and obj.parts:
+                    i = 0
+                    for n_rings in obj.parts:
+                        exterior_idx.add(i)
+                        i += n_rings
+                else:
+                    exterior_idx.add(0)
                 parts = []
-                for r in obj.rings:
+                for ri, r in enumerate(obj.rings):
                     r = np.asarray(r, float)
                     if not np.array_equal(r[0], r[-1]):
                         r = np.vstack([r, r[:1]])
-                    # Spec: exterior rings clockwise.
-                    parts.append(r[::-1] if signed_area(r) > 0 else r)
+                    want_cw = ri in exterior_idx
+                    is_cw = signed_area(r) < 0
+                    parts.append(r if is_cw == want_cw else r[::-1])
             else:
                 parts = [obj.coords]
             allp = np.vstack(parts)

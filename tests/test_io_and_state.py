@@ -182,3 +182,27 @@ def test_generate_query_polygons():
         b = p.bbox()
         assert 0 <= b[0] and b[2] <= 10
         assert (b[2] - b[0]) == pytest.approx(0.1)
+
+
+def test_shapefile_hole_winding_roundtrip(tmp_path):
+    """Holes must round-trip as holes (CCW in file), not as solid polygons."""
+    from spatialflink_tpu.models.objects import MultiPolygon
+    from spatialflink_tpu.ops.polygon import signed_area
+
+    poly = Polygon(rings=[
+        np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], float),
+        np.array([[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]], float),
+    ])
+    path = str(tmp_path / "hole.shp")
+    write_shapefile(path, [poly])
+    (back,) = read_shapefile(path)
+    assert type(back) is Polygon  # NOT a MultiPolygon of two solids
+    assert len(back.rings) == 2
+    # Containment agrees: a point inside the hole is outside the polygon.
+    import jax.numpy as jnp
+    from spatialflink_tpu.ops.polygon import pack_rings, points_in_polygon
+
+    verts, ev = pack_rings(back.rings)
+    inside = np.asarray(points_in_polygon(
+        jnp.asarray([[1.5, 1.5], [3.0, 3.0]]), jnp.asarray(verts), jnp.asarray(ev)))
+    assert not inside[0] and inside[1]

@@ -101,7 +101,7 @@ def test_instrumented_q1_end_to_end(tmp_path):
     props = {
         "output.file": str(tmp_path / "q1.txt"),
         "stats.dir": str(tmp_path),
-        "tol.meters": "2000.0",
+        "tolerance.meters": "2000.0",
     }
     rep = instrumented_mn_q1(iter(_csv_lines()), props)
     assert rep.results > 0

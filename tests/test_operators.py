@@ -264,3 +264,21 @@ def test_knn_linestring_query_no_phantom_containment(rng):
     assert first.neighbors[0][1] == pytest.approx(0.1, rel=1e-9)
     assert first.neighbors[1][0] == "inside"
     assert first.neighbors[1][1] > 0.9
+
+
+def test_incremental_range_matches_windowed(rng):
+    """The incremental (ListState-carry) variant must produce the same
+    result multiset per window as full recomputation."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=500)
+    q = Point(x=5.0, y=5.0)
+    r = 2.5
+    full = {
+        (res.start, res.end): sorted(id(p) for p in res.objects)
+        for res in PointPointRangeQuery(conf, GRID).run(iter(pts), [q], r)
+    }
+    inc = {
+        (res.start, res.end): sorted(id(p) for p in res.objects)
+        for res in PointPointRangeQuery(conf, GRID).query_incremental(iter(pts), q, r)
+    }
+    assert full == inc

@@ -125,3 +125,34 @@ def test_sharded_knn_under_jit(rng, mesh):
         jnp.asarray(batch.oid), jnp.asarray(q), k=10, num_segments=128,
     )
     assert int(res.num_valid) == 10
+
+
+def test_sequence_parallel_traj_stats_matches_single(rng, mesh):
+    """Halo-exchange (ppermute) sequence parallelism: identical to the
+    single-device segment kernel, including cross-shard boundary pairs."""
+    from spatialflink_tpu.ops.trajectory import traj_stats_kernel
+    from spatialflink_tpu.parallel import sharded_traj_stats
+
+    n, n_traj = 2048, 7
+    oid = np.sort(rng.integers(0, n_traj, n)).astype(np.int32)
+    ts = np.zeros(n, np.int64)
+    # per-object increasing timestamps
+    for o in range(n_traj):
+        idx = np.nonzero(oid == o)[0]
+        ts[idx] = np.arange(len(idx)) * 1000
+    xy = rng.uniform(0, 10, size=(n, 2))
+    valid = np.ones(n, bool)
+    valid[rng.integers(0, n, 50)] = False
+
+    single = traj_stats_kernel(
+        jnp.asarray(xy), jnp.asarray(ts), jnp.asarray(oid), jnp.asarray(valid),
+        num_segments=8,
+    )
+    sp, tp, cnt, speed = sharded_traj_stats(
+        mesh, jnp.asarray(xy), jnp.asarray(ts), jnp.asarray(oid),
+        jnp.asarray(valid), num_segments=8,
+    )
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(single.spatial_length), rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(tp), np.asarray(single.temporal_length))
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(single.count))
+    np.testing.assert_allclose(np.asarray(speed), np.asarray(single.avg_speed), rtol=1e-12)

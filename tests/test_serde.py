@@ -157,3 +157,49 @@ def test_parse_timestamp_fallbacks():
     assert parse_timestamp(None, None) == 0
     assert parse_timestamp("garbage", "yyyy-MM-dd HH:mm:ss") == 0
     assert parse_timestamp("2008-02-02 20:12:32", "yyyy-MM-dd HH:mm:ss") == 1201983152000
+
+
+def test_deserialization_facade_streams():
+    from spatialflink_tpu.streams.deserialization import (
+        linestring_stream,
+        point_stream,
+        polygon_stream,
+        to_output_record,
+        trajectory_stream,
+    )
+
+    records = [
+        '{"type":"Feature","geometry":{"type":"Point","coordinates":[1,2]},"properties":{"oID":"a","timestamp":100}}',
+        '{"type":"Feature","geometry":{"type":"Polygon","coordinates":[[[0,0],[1,0],[1,1],[0,0]]]},"properties":{"oID":"p","timestamp":200}}',
+        "not json at all",
+    ]
+    pts = list(point_stream(records))
+    assert len(pts) == 1 and pts[0].obj_id == "a"
+    polys = list(polygon_stream(records))
+    assert len(polys) == 1 and polys[0].obj_id == "p"
+    assert list(linestring_stream(records)) == []
+    # Trajectory stream with custom property names.
+    rec2 = ['{"type":"Feature","geometry":{"type":"Point","coordinates":[3,4]},"properties":{"vid":"x","t":5}}']
+    (p,) = trajectory_stream(rec2, timestamp_property="t", objid_property="vid")
+    assert p.obj_id == "x" and p.timestamp == 5
+    # WKT + CSV paths.
+    (w,) = point_stream(["POINT (7 8)"], input_type="WKT")
+    assert (w.x, w.y) == (7.0, 8.0)
+    (c,) = point_stream(["a,1,2.0,3.0"], input_type="CSV")
+    assert (c.x, c.y) == (2.0, 3.0)
+    with pytest.raises(ValueError, match="not supported"):
+        list(point_stream([], input_type="XML"))
+    # Output schemas.
+    assert to_output_record(pts[0], "GeoJSON").startswith('{"type": "Feature"')
+    assert to_output_record(pts[0], "WKT") == "a,100,POINT (1 2)"
+    assert to_output_record(pts[0], "CSV") == "a,100,1.0,2.0"
+
+
+def test_kafka_gated():
+    from spatialflink_tpu.streams.kafka import KafkaSink, kafka_available, kafka_source
+
+    if not kafka_available():
+        with pytest.raises(RuntimeError, match="Kafka client"):
+            list(kafka_source("t", "localhost:9092", str))
+        with pytest.raises(RuntimeError, match="Kafka client"):
+            KafkaSink("t", "localhost:9092")
