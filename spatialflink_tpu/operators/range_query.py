@@ -32,7 +32,7 @@ from spatialflink_tpu.operators.base import (
     pack_query_geometries,
     pack_query_points,
 )
-from spatialflink_tpu.ops.cells import gather_cell_flags  # noqa: F401 (incremental)
+from spatialflink_tpu.ops.cells import gather_cell_flags
 from spatialflink_tpu.ops.range import (
     geometry_range_query_kernel,
     range_query_kernel,
@@ -116,7 +116,20 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
         pane (ts >= end - slide). Carried results older than start + slide
         are dropped. Per-window device work shrinks from O(window) to
         O(slide).
+
+        Semantics caveats (inherent to the carry protocol, same as the
+        reference's Java incremental variant): events arriving out of order
+        by more than one slide step miss their pane evaluation and are
+        dropped, so results equal ``run()`` only for in-order streams; and
+        allowed-lateness refires would double-emit carried results, so a
+        non-zero ``allowed_lateness`` is rejected.
         """
+        if self.conf.allowed_lateness_ms > 0:
+            raise ValueError(
+                "query_incremental does not support allowed_lateness "
+                "(late-window refires would double-emit carried results); "
+                "use run() for late-tolerant streams"
+            )
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
         pk = jitted(range_query_kernel, "approximate")

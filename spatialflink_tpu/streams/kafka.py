@@ -50,10 +50,19 @@ def kafka_source(
     group_id: str = "spatialflink-tpu",
     from_earliest: bool = True,
 ) -> Iterator[T]:
-    """Consume a topic as parsed records (FlinkKafkaConsumer analog)."""
+    """Consume a topic as parsed records (FlinkKafkaConsumer analog).
+
+    Fails at call time (not first iteration) when no client is available.
+    """
     kind, mod = _import_kafka()
     if kind is None:
         raise RuntimeError(_MISSING)
+    return _kafka_iter(kind, mod, topic, bootstrap_servers, parser,
+                       group_id, from_earliest)
+
+
+def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
+                from_earliest) -> Iterator[T]:
     if kind == "kafka":
         consumer = mod.KafkaConsumer(
             topic,
@@ -75,14 +84,24 @@ def kafka_source(
             }
         )
         consumer.subscribe([topic])
-        while True:
-            msg = consumer.poll(1.0)
-            if msg is None or msg.error():
-                continue
-            try:
-                yield parser(msg.value().decode())
-            except (ValueError, IndexError):
-                continue
+        try:
+            while True:
+                msg = consumer.poll(1.0)
+                if msg is None:
+                    continue
+                err = msg.error()
+                if err:
+                    # Transient partition events are skippable; fatal broker/
+                    # auth errors must surface, not spin forever.
+                    if getattr(err, "fatal", lambda: True)():
+                        raise RuntimeError(f"Kafka consumer error: {err}")
+                    continue
+                try:
+                    yield parser(msg.value().decode())
+                except (ValueError, IndexError):
+                    continue
+        finally:
+            consumer.close()
 
 
 class KafkaSink:

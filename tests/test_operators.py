@@ -282,3 +282,12 @@ def test_incremental_range_matches_windowed(rng):
         for res in PointPointRangeQuery(conf, GRID).query_incremental(iter(pts), q, r)
     }
     assert full == inc
+
+
+def test_incremental_range_rejects_lateness(rng):
+    conf = QueryConfiguration(
+        QueryType.WindowBased, window_size=10, slide_step=5, allowed_lateness=6
+    )
+    q = Point(x=5.0, y=5.0)
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        list(PointPointRangeQuery(conf, GRID).query_incremental(iter([]), q, 1.0))
