@@ -24,12 +24,11 @@ from spatialflink_tpu.operators.base import (
     jitted,
     pack_query_geometries,
 )
-from spatialflink_tpu.ops.cells import gather_cell_flags
 from spatialflink_tpu.ops.knn import (
     knn_geometry_stream_kernel,
-    knn_kernel,
-    knn_polygon_query_kernel,
-    knn_polyline_query_kernel,
+    knn_points_fused,
+    knn_polygon_fused,
+    knn_polyline_fused,
 )
 from spatialflink_tpu.utils.padding import next_bucket
 
@@ -59,11 +58,9 @@ class _PointStreamKNNQuery(SpatialOperator):
     ) -> Iterator[KnnWindowResult]:
         flags = flags_for_queries(self.grid, radius, [query_obj])
         flags_d = jnp.asarray(flags)
-        kp = jitted(knn_kernel, "k", "num_segments")
+        kp = jitted(knn_points_fused, "k", "num_segments")
         kpoly = jitted(
-            knn_polygon_query_kernel
-            if self.query_kind == "polygon"
-            else knn_polyline_query_kernel,
+            knn_polygon_fused if self.query_kind == "polygon" else knn_polyline_fused,
             "k", "num_segments",
         )
         if self.query_kind == "point":
@@ -75,11 +72,11 @@ class _PointStreamKNNQuery(SpatialOperator):
         for win in self.windows(stream):
             batch = self.point_batch(win.events, dtype=dtype)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
             args = (
                 jnp.asarray(batch.xy),
                 jnp.asarray(batch.valid),
-                pflags,
+                jnp.asarray(batch.cell),
+                flags_d,
                 jnp.asarray(batch.oid),
             )
             if self.query_kind == "point":

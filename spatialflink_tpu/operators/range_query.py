@@ -32,12 +32,11 @@ from spatialflink_tpu.operators.base import (
     pack_query_geometries,
     pack_query_points,
 )
-from spatialflink_tpu.ops.cells import gather_cell_flags
 from spatialflink_tpu.ops.range import (
     geometry_range_query_kernel,
-    range_query_kernel,
-    range_query_polygons_kernel,
-    range_query_polylines_kernel,
+    range_points_fused,
+    range_polygons_fused,
+    range_polylines_fused,
 )
 
 
@@ -68,9 +67,9 @@ class _PointStreamRangeQuery(SpatialOperator):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
-        pk = jitted(range_query_kernel, "approximate")
-        polyk = jitted(range_query_polygons_kernel, "approximate")
-        lk = jitted(range_query_polylines_kernel, "approximate")
+        pk = jitted(range_points_fused, "approximate")
+        polyk = jitted(range_polygons_fused, "approximate")
+        lk = jitted(range_polylines_fused, "approximate")
         if self.query_kind == "point":
             q = jnp.asarray(pack_query_points(query_set, dtype))
         else:
@@ -79,11 +78,11 @@ class _PointStreamRangeQuery(SpatialOperator):
 
         for win in self.windows(stream):
             batch = self.point_batch(win.events, dtype=dtype)
-            pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
             common = (
                 jnp.asarray(batch.xy),
                 jnp.asarray(batch.valid),
-                pflags,
+                jnp.asarray(batch.cell),
+                flags_d,
             )
             if self.query_kind == "point":
                 keep, dist = pk(*common, q, radius, approximate=self.conf.approximate_query)
@@ -132,7 +131,7 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
             )
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
-        pk = jitted(range_query_kernel, "approximate")
+        pk = jitted(range_points_fused, "approximate")
         q = jnp.asarray(np.array([[query_point.x, query_point.y]], dtype))
         slide_ms = self.conf.slide_step_ms
         carry: List[tuple] = []  # (event, dist)
@@ -152,9 +151,9 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
             ]
             if new_events:
                 batch = self.point_batch(new_events, dtype=dtype)
-                pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
                 keep, dist = pk(
-                    jnp.asarray(batch.xy), jnp.asarray(batch.valid), pflags,
+                    jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                    jnp.asarray(batch.cell), flags_d,
                     q, radius, approximate=self.conf.approximate_query,
                 )
                 keep = np.asarray(keep)

@@ -26,8 +26,7 @@ from spatialflink_tpu.operators.base import (
     pack_query_geometries,
 )
 from spatialflink_tpu.operators.join_query import _TaggedEvent, merge_by_timestamp
-from spatialflink_tpu.ops.cells import gather_cell_flags
-from spatialflink_tpu.ops.knn import knn_kernel
+from spatialflink_tpu.ops.knn import knn_points_fused
 from spatialflink_tpu.ops.polygon import points_in_polygon
 from spatialflink_tpu.ops.trajectory import (
     traj_cell_spans_kernel,
@@ -140,14 +139,14 @@ class TKNNQuery(SpatialOperator):
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
         q = jnp.asarray(np.array([query_point.x, query_point.y], dtype))
-        kern = jitted(knn_kernel, "k", "num_segments")
+        kern = jitted(knn_points_fused, "k", "num_segments")
 
         for win in self.windows(stream):
             batch = self.point_batch(win.events, dtype=dtype)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            pflags = gather_cell_flags(jnp.asarray(batch.cell), flags_d)
             res = kern(
-                jnp.asarray(batch.xy), jnp.asarray(batch.valid), pflags,
+                jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                jnp.asarray(batch.cell), flags_d,
                 jnp.asarray(batch.oid), q, radius, k=k, num_segments=nseg,
             )
             groups = group_by_oid(win.events)

@@ -137,6 +137,39 @@ def knn_polyline_query_kernel(
     return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
 
 
+def knn_points_fused(xy, valid, cell, flags_table, oid, query_xy, radius,
+                     k: int, num_segments: int) -> KnnResult:
+    """Cell-flag gather + kNN in one jitted program (per-window fast path)."""
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return knn_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), oid, query_xy,
+        radius, k=k, num_segments=num_segments,
+    )
+
+
+def knn_polygon_fused(xy, valid, cell, flags_table, oid, query_verts,
+                      query_edge_valid, radius, k: int,
+                      num_segments: int) -> KnnResult:
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return knn_polygon_query_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), oid, query_verts,
+        query_edge_valid, radius, k=k, num_segments=num_segments,
+    )
+
+
+def knn_polyline_fused(xy, valid, cell, flags_table, oid, query_verts,
+                       query_edge_valid, radius, k: int,
+                       num_segments: int) -> KnnResult:
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return knn_polyline_query_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), oid, query_verts,
+        query_edge_valid, radius, k=k, num_segments=num_segments,
+    )
+
+
 def knn_geometry_stream_kernel(
     obj_verts: jnp.ndarray,
     obj_edge_valid: jnp.ndarray,

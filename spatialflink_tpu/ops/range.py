@@ -32,6 +32,9 @@ __all__ = [
     "range_query_polylines_kernel",
     "geometry_range_query_kernel",
     "geometry_pair_distance",
+    "range_points_fused",
+    "range_polygons_fused",
+    "range_polylines_fused",
 ]
 
 
@@ -109,6 +112,40 @@ def range_query_polylines_kernel(
     )  # (L, N)
     min_dist = jnp.min(d, axis=0)
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist
+
+
+# Fused variants: cell-flag gather + query in ONE jitted program, so the
+# per-window path costs a single dispatch (no eager gather round trip).
+
+
+def range_points_fused(xy, valid, cell, flags_table, query_xy, radius,
+                       approximate: bool = False):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return range_query_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), query_xy, radius,
+        approximate=approximate,
+    )
+
+
+def range_polygons_fused(xy, valid, cell, flags_table, poly_verts,
+                         poly_edge_valid, radius, approximate: bool = False):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return range_query_polygons_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), poly_verts,
+        poly_edge_valid, radius, approximate=approximate,
+    )
+
+
+def range_polylines_fused(xy, valid, cell, flags_table, line_verts,
+                          line_edge_valid, radius, approximate: bool = False):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return range_query_polylines_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), line_verts,
+        line_edge_valid, radius, approximate=approximate,
+    )
 
 
 def _vert_valid(edge_valid: jnp.ndarray) -> jnp.ndarray:

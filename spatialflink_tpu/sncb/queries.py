@@ -232,8 +232,8 @@ def q2_brake_monitor_batch(
     win = sliding_aggregate(
         ts, key, interner.num_segments,
         int(window_s * 1000), slide_ms,
-        minmax_fields={"fa_min": fa_min_in, "fa_max": fa_max_in,
-                       "ff_min": ff_min_in, "ff_max": ff_max_in},
+        min_fields={"fa_min": fa_min_in, "ff_min": ff_min_in},
+        max_fields={"fa_max": fa_max_in, "ff_max": ff_max_in},
     )
     var_fa = win.maxs["fa_max"] - win.mins["fa_min"]
     var_ff = win.maxs["ff_max"] - win.mins["ff_min"]

@@ -70,11 +70,14 @@ def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
             group_id=group_id,
             auto_offset_reset="earliest" if from_earliest else "latest",
         )
-        for msg in consumer:
-            try:
-                yield parser(msg.value.decode())
-            except (ValueError, IndexError):
-                continue
+        try:
+            for msg in consumer:
+                try:
+                    yield parser(msg.value.decode())
+                except (ValueError, IndexError):
+                    continue
+        finally:
+            consumer.close()
     else:  # confluent
         consumer = mod.Consumer(
             {
