@@ -93,6 +93,19 @@ class PointBatch:
         )
 
 
+def flag_prefix_planes(grid: UniformGrid, flags: np.ndarray):
+    """2-D prefix sums of the candidate/guaranteed indicator planes
+    (zero-bordered: P[i, j] = count in [0:i, 0:j)). Build once per query;
+    feed to GeometryBatch.any_cell_flagged per window."""
+    n = grid.n
+    plane = flags[: grid.num_cells].reshape(n, n)
+    cand = np.zeros((n + 1, n + 1), np.int64)
+    guar = np.zeros((n + 1, n + 1), np.int64)
+    cand[1:, 1:] = np.cumsum(np.cumsum(plane == 1, axis=0), axis=1)
+    guar[1:, 1:] = np.cumsum(np.cumsum(plane == 2, axis=0), axis=1)
+    return cand, guar
+
+
 @dataclass
 class GeometryBatch:
     """Padded geometry batch: per-object packed boundary arrays.
@@ -159,17 +172,39 @@ class GeometryBatch:
         cell = grid.assign_cells_np(np.stack([cx, cy], axis=1))
         return np.where(self.valid, cell, grid.num_cells).astype(np.int32)
 
-    def any_cell_flagged(self, grid: UniformGrid, flags: np.ndarray) -> np.ndarray:
-        """Per-object max flag over all cells its bbox overlaps (host-side).
+    def any_cell_flagged(
+        self, grid: UniformGrid, flags: np.ndarray, prefix=None
+    ) -> np.ndarray:
+        """Per-object max flag over all cells its bbox overlaps (host-side,
+        vectorized).
 
         Mirrors the reference's per-object gridIDsSet ∩ neighbor-set test
         for polygon/linestring streams (e.g. PolygonPointRangeQuery filter).
+        The rectangle max over the flag grid is answered with 2-D prefix
+        sums of the candidate/guaranteed indicator planes: a flag level is
+        present in a bbox iff its indicator count over the rectangle is
+        positive — O(cells + objects) instead of per-object cell loops.
+        Pass ``prefix=flag_prefix_planes(grid, flags)`` to amortize the
+        O(cells) plane build across windows of the same query.
         """
-        out = np.zeros(self.capacity, np.uint8)
-        for i in range(self.capacity):
-            if not self.valid[i]:
-                continue
-            cells = grid.bbox_cells(*self.bbox[i])
-            if len(cells):
-                out[i] = flags[cells].max()
-        return out
+        n = grid.n
+        cand, guar = prefix if prefix is not None else flag_prefix_planes(grid, flags)
+
+        ci = grid.cell_xy_indices_np(self.bbox[:, 0:2])  # (N, 2) min corner
+        cj = grid.cell_xy_indices_np(self.bbox[:, 2:4])  # (N, 2) max corner
+        x1 = np.clip(ci[:, 0], 0, n - 1)
+        y1 = np.clip(ci[:, 1], 0, n - 1)
+        x2 = np.clip(cj[:, 0], 0, n - 1)
+        y2 = np.clip(cj[:, 1], 0, n - 1)
+        # Bboxes entirely outside the grid contribute nothing.
+        inside = (cj[:, 0] >= 0) & (cj[:, 1] >= 0) & (ci[:, 0] < n) & (ci[:, 1] < n)
+
+        def rect_count(p):
+            return (
+                p[x2 + 1, y2 + 1] - p[x1, y2 + 1] - p[x2 + 1, y1] + p[x1, y1]
+            )
+
+        has_guar = rect_count(guar) > 0
+        has_cand = rect_count(cand) > 0
+        out = np.where(has_guar, 2, np.where(has_cand, 1, 0)).astype(np.uint8)
+        return np.where(self.valid & inside, out, 0).astype(np.uint8)

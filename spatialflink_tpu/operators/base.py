@@ -134,6 +134,38 @@ def pack_query_geometries(
     return verts, ev
 
 
+def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
+                      dtype=np.float64):
+    """SoA windows → (window, padded arrays) for the run_soa fast paths.
+
+    Yields (win, xy, valid, cell, oid) with bucket padding and invalid-lane
+    cell masking identical to PointBatch.from_arrays(...).with_cells(grid).
+    """
+    from spatialflink_tpu.streams.soa import SoaWindowAssembler
+    from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
+
+    asm = SoaWindowAssembler(
+        conf.window_size_ms, conf.slide_step_ms,
+        ooo_ms=conf.allowed_lateness_ms,
+    )
+    for win in asm.stream(chunks):
+        xy = np.stack(
+            [np.asarray(win.arrays["x"], dtype), np.asarray(win.arrays["y"], dtype)],
+            axis=1,
+        )
+        n = len(xy)
+        b = next_bucket(n)
+        cell = grid.assign_cells_np(xy)
+        oid = win.arrays.get("oid")
+        yield (
+            win,
+            pad_to_bucket(xy, b),
+            pad_to_bucket(np.ones(n, bool), b, fill=False),
+            pad_to_bucket(cell, b, fill=grid.num_cells),
+            None if oid is None else pad_to_bucket(np.asarray(oid, np.int32), b, fill=0),
+        )
+
+
 @functools.lru_cache(maxsize=None)
 def jitted(fn: Callable, *static: str):
     """Module-level jit cache so every operator instance reuses programs."""

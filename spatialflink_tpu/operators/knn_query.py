@@ -102,6 +102,39 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
 
     query_kind = "point"
 
+    def run_soa(
+        self,
+        chunks,
+        query_point: Point,
+        radius: float,
+        k: int,
+        num_segments: int,
+        dtype=np.float64,
+    ):
+        """High-rate SoA path: chunks of {"ts","x","y","oid"} arrays →
+        per-window KnnResult-shaped tuples (start, end, oids, dists,
+        num_valid). ``oid`` must already be dense int32 in
+        [0, num_segments) — e.g. the native parser's interned device ids."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+
+        flags = flags_for_queries(self.grid, radius, [query_point])
+        flags_d = jnp.asarray(flags)
+        q = jnp.asarray(np.array([query_point.x, query_point.y], dtype))
+        kp = jitted(knn_points_fused, "k", "num_segments")
+        for win, xy, valid, cell, oid in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            res = kp(
+                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+                flags_d, jnp.asarray(oid),
+                q, radius, k=k, num_segments=num_segments,
+            )
+            nv = int(res.num_valid)
+            yield (
+                win.start, win.end,
+                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
+            )
+
 
 class PointPolygonKNNQuery(_PointStreamKNNQuery):
     """knn/PointPolygonKNNQuery.java:67-88 (incl. runLatency variants —
@@ -140,10 +173,13 @@ class _GeometryStreamKNNQuery(SpatialOperator):
             q = np.array([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2], dtype)
         q = jnp.asarray(q)
 
+        from spatialflink_tpu.models.batch import flag_prefix_planes
+
+        prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
             batch = self.geometry_batch(win.events, dtype=dtype)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            oflags = batch.any_cell_flagged(self.grid, flags)
+            oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             res = kg(
                 jnp.asarray(batch.verts),
                 jnp.asarray(batch.edge_valid),

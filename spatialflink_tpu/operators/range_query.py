@@ -170,6 +170,40 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
             )
 
 
+    def run_soa(
+        self,
+        chunks,
+        query_set: Sequence[Point],
+        radius: float,
+        dtype=np.float64,
+    ):
+        """High-rate SoA path: chunks of {"ts","x","y",...} arrays →
+        per-window (start, end, matched_arrays, dists), where
+        ``matched_arrays`` is the window's SoA sliced down to the matching
+        events (so callers get the actual matches, not just a count)."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+
+        if not isinstance(query_set, (list, tuple)):
+            query_set = [query_set]
+        flags = flags_for_queries(self.grid, radius, query_set)
+        flags_d = jnp.asarray(flags)
+        pk = jitted(range_points_fused, "approximate")
+        q = jnp.asarray(pack_query_points(query_set, dtype))
+        for win, xy, valid, cell, _ in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            keep, dist = pk(
+                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+                flags_d, q, radius,
+                approximate=self.conf.approximate_query,
+            )
+            n = win.count
+            keep = np.asarray(keep)[:n]
+            idx = np.nonzero(keep)[0]
+            matched = {k: np.asarray(v)[idx] for k, v in win.arrays.items()}
+            yield win.start, win.end, matched, np.asarray(dist)[:n][idx]
+
+
 class PointPolygonRangeQuery(_PointStreamRangeQuery):
     """range/PointPolygonRangeQuery.java:31-160 (bbox-approx mode at :76-80
     becomes the ``approximate_query`` flag)."""
@@ -212,9 +246,12 @@ class _GeometryStreamRangeQuery(SpatialOperator):
             qverts, qev = pack_query_geometries(query_set, dtype)
         qv, qe = jnp.asarray(qverts), jnp.asarray(qev)
 
+        from spatialflink_tpu.models.batch import flag_prefix_planes
+
+        prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
             batch = self.geometry_batch(win.events, dtype=dtype)
-            oflags = batch.any_cell_flagged(self.grid, flags)
+            oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             keep, dist = gk(
                 jnp.asarray(batch.verts),
                 jnp.asarray(batch.edge_valid),

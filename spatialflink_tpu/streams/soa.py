@@ -1,0 +1,154 @@
+"""Structure-of-arrays streaming: chunked ingest → vectorized windows.
+
+The object-based WindowAssembler (streams/windows.py) is the semantics
+reference; this module is the high-rate path. Sources deliver **chunks**
+of SoA arrays (e.g. straight from the native C++ parser), the assembler
+buffers them as arrays, and each fired window is a zero-copy-ish slice of
+a ts-sorted consolidation — no per-event Python objects anywhere.
+
+Semantics match the object assembler for in-order-within-lateness streams:
+bounded-out-of-orderness watermark (wm = max_ts − ooo), a window fires when
+the watermark passes its end, and every window containing ≥1 event fires
+exactly once. Late events beyond the watermark at consolidation time are
+dropped and counted (``dropped_late``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SoaWindow:
+    """One fired window: [start, end) and its event arrays."""
+
+    start: int
+    end: int
+    arrays: Dict[str, np.ndarray]  # each (n,), same order, incl. "ts"
+
+    @property
+    def count(self) -> int:
+        return len(self.arrays["ts"])
+
+
+class SoaWindowAssembler:
+    """Sliding event-time windows over SoA chunks."""
+
+    def __init__(self, size_ms: int, slide_ms: int, ooo_ms: int = 0):
+        if size_ms <= 0 or slide_ms <= 0:
+            raise ValueError("size and slide must be positive")
+        self.size = int(size_ms)
+        self.slide = int(slide_ms)
+        self.ooo = int(ooo_ms)
+        self._chunks: List[Dict[str, np.ndarray]] = []
+        self._max_ts: Optional[int] = None
+        self._next_start: Optional[int] = None  # earliest unfired window start
+        self.dropped_late = 0
+
+    def feed(self, chunk: Dict[str, np.ndarray]) -> List[SoaWindow]:
+        """Add one SoA chunk; return windows that fire."""
+        ts = np.asarray(chunk["ts"], np.int64)
+        if len(ts) == 0:
+            return []
+        self._chunks.append({k: np.asarray(v) for k, v in chunk.items()})
+        mx = int(ts.max())
+        if self._max_ts is None or mx > self._max_ts:
+            self._max_ts = mx
+        if self._next_start is None:
+            # Earliest window that could ever hold a non-late event: bounded
+            # by both the first observed timestamp and the initial watermark
+            # (later within-bound arrivals may precede the first event).
+            horizon = min(int(ts.min()), self._max_ts - self.ooo)
+            self._next_start = self._earliest_window_of(horizon)
+        return self._fire(self._max_ts - self.ooo)
+
+    def flush(self) -> List[SoaWindow]:
+        """End of stream: fire everything up to the last event."""
+        if self._max_ts is None:
+            return []
+        return self._fire(self._max_ts + self.size + 1)
+
+    # -- internals ------------------------------------------------------------
+
+    def _consolidate(self) -> Dict[str, np.ndarray]:
+        if len(self._chunks) == 1:
+            merged = self._chunks[0]
+        else:
+            merged = {
+                k: np.concatenate([c[k] for c in self._chunks])
+                for k in self._chunks[0]
+            }
+        order = np.argsort(merged["ts"], kind="stable")
+        merged = {k: v[order] for k, v in merged.items()}
+        self._chunks = [merged]
+        return merged
+
+    def _earliest_window_of(self, ts_val: int) -> int:
+        """Start of the earliest window containing ts_val."""
+        last = ts_val - ((ts_val % self.slide) + self.slide) % self.slide
+        return last - self.size + self.slide
+
+    def _fire(self, wm: int) -> List[SoaWindow]:
+        out: List[SoaWindow] = []
+        if self._next_start is None or self._next_start + self.size > wm:
+            return out
+        merged = self._consolidate()
+        ts = merged["ts"]
+        # Events older than the earliest live window start are late beyond
+        # every remaining window: count and trim.
+        late = int(np.searchsorted(ts, self._next_start, side="left"))
+        if late:
+            self.dropped_late += late
+        while self._next_start + self.size <= wm:
+            s, e = self._next_start, self._next_start + self.size
+            lo = int(np.searchsorted(ts, s, side="left"))
+            hi = int(np.searchsorted(ts, e, side="left"))
+            if hi > lo:
+                out.append(
+                    SoaWindow(s, e, {k: v[lo:hi] for k, v in merged.items()})
+                )
+                self._next_start += self.slide
+            elif lo < len(ts):
+                # Empty window: fast-forward to the earliest window holding
+                # the next buffered event (no O(gap/slide) spinning).
+                self._next_start = max(
+                    self._next_start + self.slide,
+                    self._earliest_window_of(int(ts[lo])),
+                )
+            else:
+                # No buffered events at/after s: wait for more data.
+                self._next_start += self.slide
+                break
+        # Evict rows no live window can need.
+        keep_from = int(np.searchsorted(ts, self._next_start, side="left"))
+        if keep_from:
+            self._chunks = [{k: v[keep_from:] for k, v in merged.items()}]
+        return out
+
+    def stream(self, chunks: Iterable[Dict[str, np.ndarray]]) -> Iterator[SoaWindow]:
+        for c in chunks:
+            yield from self.feed(c)
+        yield from self.flush()
+
+
+def csv_chunk_source(path: str, parser, chunk_bytes: int = 1 << 22):
+    """File → SoA chunks via a buffer-at-a-time parser (native.NativeGpsParser
+    or NativePointParser): reads ~chunk_bytes at line boundaries."""
+    with open(path, "rb") as f:
+        rest = b""
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                if rest.strip():
+                    yield parser.parse(rest)
+                return
+            block = rest + block
+            cut = block.rfind(b"\n")
+            if cut < 0:
+                rest = block
+                continue
+            rest = block[cut + 1:]
+            yield parser.parse(block[: cut + 1])

@@ -230,3 +230,31 @@ def test_cross_join_matches_brute(rng):
         for j in np.nonzero(pm[i])[0]:
             got.add((i, int(j)))
     assert got == brute_join(a, b, r)
+
+
+def test_any_cell_flagged_matches_per_object_loop(rng):
+    """Vectorized prefix-sum rectangle test == per-object cell loop."""
+    from spatialflink_tpu.models.batch import GeometryBatch
+    from spatialflink_tpu.models.objects import Polygon
+
+    grid = UniformGrid(20, **GRID)
+    polys = []
+    for i in range(60):
+        cx, cy = rng.uniform(-1, 11), rng.uniform(-1, 11)  # some out of grid
+        w, h = rng.uniform(0.1, 2.5), rng.uniform(0.1, 2.5)
+        polys.append(Polygon(
+            obj_id=f"p{i}", timestamp=i,
+            rings=[np.array([[cx, cy], [cx + w, cy], [cx + w, cy + h],
+                             [cx, cy + h], [cx, cy]])],
+        ))
+    gb = GeometryBatch.from_objects(polys)
+    flags = grid.neighbor_flags(1.2, [grid.flat_cell(5.0, 5.0)])
+    got = gb.any_cell_flagged(grid, flags)
+    # Brute force: per object, max flag over bbox-overlapped cells.
+    for i in range(gb.capacity):
+        if not gb.valid[i]:
+            assert got[i] == 0
+            continue
+        cells = grid.bbox_cells(*gb.bbox[i])
+        expect = flags[cells].max() if len(cells) else 0
+        assert got[i] == expect, (i, gb.bbox[i])
