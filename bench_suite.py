@@ -1,0 +1,242 @@
+"""Extended benchmark suite — the five BASELINE.json configs.
+
+``bench.py`` stays the driver's single-line headline (continuous kNN k=50,
+1M-pt windows). This script exercises every configuration listed in
+BASELINE.json's ``configs`` and prints one JSON line per config plus a
+summary line. All rates are distinct-ingested-points/sec on the current
+default device; ``vs_baseline`` divides by the reference's 20k EPS
+single-node target.
+
+Run: ``python bench_suite.py [--quick]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINE_EPS = 20_000.0
+
+
+def _stream(n, seed=42, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    xy = np.stack(
+        [rng.uniform(115.5, 117.6, n), rng.uniform(39.6, 41.1, n)], axis=1
+    ).astype(dtype)
+    oid = (rng.integers(0, 16_384, n)).astype(np.int32)
+    ts = (np.arange(n, dtype=np.int64) * 1000) // 200_000  # 200k EPS event time
+    return xy, oid, ts
+
+
+def _result(name, n_points, seconds, extra=None):
+    eps = n_points / seconds
+    out = {
+        "config": name,
+        "points_per_sec": round(eps, 1),
+        "vs_baseline": round(eps / BASELINE_EPS, 2),
+    }
+    if extra:
+        out.update(extra)
+    print(json.dumps(out))
+    return out
+
+
+def bench_range_window(jax, jnp, grid, quick):
+    """Config 1: Point-Point range, r≈500m (0.005°), 100×100 grid, 10s
+    tumbling windows."""
+    from spatialflink_tpu.ops.range import range_points_fused
+
+    n_win = 4 if quick else 10
+    win_pts = 500_000
+    xy, oid, ts = _stream(win_pts * n_win)
+    q = jnp.asarray(np.array([[116.40, 40.19]], np.float32))
+    flags = grid.neighbor_flags(0.005, [grid.flat_cell(116.40, 40.19)])
+    flags_d = jnp.asarray(flags)
+    fn = jax.jit(range_points_fused, static_argnames=("approximate",))
+
+    def one(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        cell = grid.assign_cells_np(xy[sl])
+        keep, dist = fn(
+            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
+            jnp.asarray(cell), flags_d, q, np.float32(0.005),
+        )
+        return int(np.asarray(keep).sum())
+
+    one(0)  # warm
+    t0 = time.perf_counter()
+    hits = sum(one(i) for i in range(n_win))
+    dt = time.perf_counter() - t0
+    return _result("range_pp_r500m_10s_tumbling", n_win * win_pts, dt,
+                   {"hits": hits})
+
+
+def bench_knn_k(jax, jnp, grid, k, quick):
+    """Config 2: continuous kNN, k ∈ {10, 50, 500}, 5s sliding windows."""
+    from spatialflink_tpu.ops.knn import knn_points_fused
+
+    n_win = 4 if quick else 10
+    win_pts = 500_000
+    xy, oid, ts = _stream(win_pts * n_win)
+    q = jnp.asarray(np.array([116.40, 40.19], np.float32))
+    flags = grid.neighbor_flags(0.05, [grid.flat_cell(116.40, 40.19)])
+    flags_d = jnp.asarray(flags)
+    fn = jax.jit(knn_points_fused, static_argnames=("k", "num_segments"))
+
+    def one(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        cell = grid.assign_cells_np(xy[sl])
+        res = fn(
+            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
+            jnp.asarray(cell), flags_d, jnp.asarray(oid[sl]),
+            q, np.float32(0.05), k=k, num_segments=16_384,
+        )
+        return int(res.num_valid)
+
+    one(0)
+    t0 = time.perf_counter()
+    nv = [one(i) for i in range(n_win)]
+    dt = time.perf_counter() - t0
+    return _result(f"continuous_knn_k{k}_5s_sliding", n_win * win_pts, dt,
+                   {"num_valid_last": nv[-1]})
+
+
+def bench_polygon_range(jax, jnp, grid, quick):
+    """Config 3: Point-Polygon range with a 1k-polygon query set."""
+    from spatialflink_tpu.ops.range import range_polygons_fused
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+    from spatialflink_tpu.operators.base import pack_query_geometries
+
+    n_polys = 256 if quick else 1000
+    win_pts = 131_072 if quick else 262_144
+    n_win = 3 if quick else 5
+    polys = generate_query_polygons(
+        n_polys, 115.5, 39.6, 117.6, 41.1, grid_size=100, seed=3
+    )
+    verts, ev = pack_query_geometries(polys, np.float32)
+    qv, qe = jnp.asarray(verts), jnp.asarray(ev)
+    cells = []
+    for p in polys:
+        cells.extend(p.grid_cells(grid))
+    flags = grid.neighbor_flags(0.002, cells)
+    flags_d = jnp.asarray(flags)
+    xy, oid, ts = _stream(win_pts * n_win, seed=7)
+    fn = jax.jit(range_polygons_fused, static_argnames=("approximate",))
+
+    def one(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        cell = grid.assign_cells_np(xy[sl])
+        keep, _ = fn(
+            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
+            jnp.asarray(cell), flags_d, qv, qe, np.float32(0.002),
+        )
+        return int(np.asarray(keep).sum())
+
+    one(0)
+    t0 = time.perf_counter()
+    hits = sum(one(i) for i in range(n_win))
+    dt = time.perf_counter() - t0
+    return _result(f"range_point_{n_polys}polygons", n_win * win_pts, dt,
+                   {"hits": hits})
+
+
+def bench_join(jax, jnp, grid, quick):
+    """Config 4: spatial join of two streams, r≈200m (0.002°), grid-bucketed."""
+    from spatialflink_tpu.ops.join import join_kernel_compact, sort_by_cell
+
+    win_pts = 131_072
+    n_win = 3 if quick else 8
+    xy_a, _, _ = _stream(win_pts * n_win, seed=1)
+    xy_b, _, _ = _stream(win_pts * n_win, seed=2)
+    r = np.float32(0.002)
+    offsets = jnp.asarray(grid.neighbor_offsets(float(r)))
+    fn = jax.jit(
+        join_kernel_compact, static_argnames=("grid_n", "cap", "max_pairs")
+    )
+
+    def one(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        a, b = xy_a[sl], xy_b[sl]
+        bc = grid.assign_cells_np(b)
+        cells_sorted, order = sort_by_cell(jnp.asarray(bc), grid.num_cells)
+        res = fn(
+            jnp.asarray(a), jnp.asarray(np.ones(win_pts, bool)),
+            jnp.asarray(grid.cell_xy_indices_np(a)),
+            jnp.asarray(b)[order], jnp.asarray(np.ones(win_pts, bool))[order],
+            cells_sorted, order, offsets,
+            grid_n=grid.n, radius=r, cap=40, max_pairs=262_144,
+        )
+        return int(res.count), int(res.overflow)
+
+    one(0)
+    t0 = time.perf_counter()
+    stats = [one(i) for i in range(n_win)]
+    dt = time.perf_counter() - t0
+    return _result(
+        "join_two_streams_r200m", 2 * n_win * win_pts, dt,
+        {"pairs": sum(s[0] for s in stats), "overflow": sum(s[1] for s in stats)},
+    )
+
+
+def bench_tknn(jax, jnp, grid, quick):
+    """Config 5: trajectory kNN, per-objID grouped, k=20."""
+    from spatialflink_tpu.ops.knn import knn_points_fused
+
+    win_pts = 262_144
+    n_win = 3 if quick else 6
+    xy, oid, ts = _stream(win_pts * n_win, seed=11)
+    q = jnp.asarray(np.array([116.40, 40.19], np.float32))
+    flags = grid.neighbor_flags(0.1, [grid.flat_cell(116.40, 40.19)])
+    flags_d = jnp.asarray(flags)
+    fn = jax.jit(knn_points_fused, static_argnames=("k", "num_segments"))
+
+    def one(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        cell = grid.assign_cells_np(xy[sl])
+        res = fn(
+            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
+            jnp.asarray(cell), flags_d, jnp.asarray(oid[sl]),
+            q, np.float32(0.1), k=20, num_segments=16_384,
+        )
+        return int(res.num_valid)
+
+    one(0)
+    t0 = time.perf_counter()
+    for i in range(n_win):
+        one(i)
+    dt = time.perf_counter() - t0
+    return _result("trajectory_knn_k20_per_objid", n_win * win_pts, dt)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.grid import UniformGrid
+
+    grid = UniformGrid(100, min_x=115.5, max_x=117.6, min_y=39.6, max_y=41.1)
+    results = [
+        bench_range_window(jax, jnp, grid, args.quick),
+        bench_knn_k(jax, jnp, grid, 10, args.quick),
+        bench_knn_k(jax, jnp, grid, 50, args.quick),
+        bench_knn_k(jax, jnp, grid, 500, args.quick),
+        bench_polygon_range(jax, jnp, grid, args.quick),
+        bench_join(jax, jnp, grid, args.quick),
+        bench_tknn(jax, jnp, grid, args.quick),
+    ]
+    worst = min(r["vs_baseline"] for r in results)
+    print(json.dumps({
+        "summary": "bench_suite", "device": str(jax.devices()[0]),
+        "configs": len(results), "min_vs_baseline": worst,
+    }))
+
+
+if __name__ == "__main__":
+    main()

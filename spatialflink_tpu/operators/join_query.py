@@ -31,6 +31,7 @@ from spatialflink_tpu.ops.join import (
     cross_join_kernel,
     geometry_geometry_join_kernel,
     join_kernel,
+    join_kernel_compact,
     point_geometry_join_kernel,
     sort_by_cell,
 )
@@ -65,23 +66,29 @@ class _TaggedEvent:
         self.event = event
 
 
-def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets):
+def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
+                           max_pairs=None):
     """Run the grid-hash join kernel over two cell-assigned PointBatches.
 
-    Shared by PointPointJoinQuery and TJoinQuery — the one place that wires
-    batches into ops.join.join_kernel."""
-    jk = jitted(join_kernel, "grid_n", "cap")
+    Shared by PointPointJoinQuery and TJoinQuery. With ``max_pairs`` set,
+    pairs are compacted on device (CompactJoinResult) so only matches cross
+    the host boundary — the dense mask path transfers O(N·K·cap) per
+    window."""
     cells_sorted, order = sort_by_cell(
         jnp.asarray(right_batch.cell), grid.num_cells
     )
     left_ci = grid.cell_xy_indices_np(left_batch.xy)
-    return jk(
+    args = (
         jnp.asarray(left_batch.xy), jnp.asarray(left_batch.valid),
         jnp.asarray(left_ci),
         jnp.asarray(right_batch.xy)[order], jnp.asarray(right_batch.valid)[order],
         cells_sorted, order, offsets,
-        grid_n=grid.n, radius=radius, cap=cap,
     )
+    if max_pairs is None:
+        jk = jitted(join_kernel, "grid_n", "cap")
+        return jk(*args, grid_n=grid.n, radius=radius, cap=cap)
+    jk = jitted(join_kernel_compact, "grid_n", "cap", "max_pairs")
+    return jk(*args, grid_n=grid.n, radius=radius, cap=cap, max_pairs=max_pairs)
 
 
 class PointPointJoinQuery(SpatialOperator):
@@ -90,6 +97,7 @@ class PointPointJoinQuery(SpatialOperator):
     def __init__(self, conf, grid, cap: int = 64):
         super().__init__(conf, grid)
         self.cap = cap
+        self._max_pairs = 0  # grown budget persists across windows
 
     def run(
         self,
@@ -119,19 +127,45 @@ class PointPointJoinQuery(SpatialOperator):
                     jnp.asarray(lb.xy), jnp.asarray(lb.valid),
                     jnp.asarray(rb.xy), jnp.asarray(rb.valid), radius,
                 )
+                pm = np.asarray(res.pair_mask)
+                ri = np.asarray(res.right_index)
+                dd = np.asarray(res.dist)
+                pairs = []
+                for i in np.nonzero(pm.any(axis=1))[0]:
+                    for s in np.nonzero(pm[i])[0]:
+                        pairs.append(
+                            (left_ev[i], right_ev[int(ri[i, s])], float(dd[i, s]))
+                        )
+                overflow = int(res.overflow)
             else:
-                res = grid_hash_join_batches(
-                    self.grid, lb, rb, radius, self.cap, offsets
-                )
-            pm = np.asarray(res.pair_mask)
-            ri = np.asarray(res.right_index)
-            dd = np.asarray(res.dist)
-            pairs = []
-            for i in np.nonzero(pm.any(axis=1))[0]:
-                for s in np.nonzero(pm[i])[0]:
-                    pairs.append((left_ev[i], right_ev[int(ri[i, s])], float(dd[i, s])))
+                # Device-compacted pairs; a window whose match count exceeds
+                # the budget retries once with a doubled power-of-two budget,
+                # and the grown budget persists (dense workloads pay the
+                # retry once, not per window; compile cache stays bounded).
+                self._max_pairs = max(self._max_pairs, 1024, 4 * lb.capacity)
+                while True:
+                    res = grid_hash_join_batches(
+                        self.grid, lb, rb, radius, self.cap, offsets,
+                        max_pairs=self._max_pairs,
+                    )
+                    count = int(res.count)
+                    if count <= self._max_pairs:
+                        break
+                    self._max_pairs = int(2 ** np.ceil(np.log2(count)))
+                # Transfer whole fixed-shape arrays, slice in numpy — a
+                # device slice of data-dependent length would compile per
+                # distinct count.
+                li = np.asarray(res.left_index)[:count]
+                ri = np.asarray(res.right_index)[:count]
+                dd = np.asarray(res.dist)[:count]
+                pairs = [
+                    (left_ev[int(a)], right_ev[int(b)], float(d))
+                    for a, b, d in zip(li, ri, dd)
+                    if a >= 0
+                ]
+                overflow = int(res.overflow)
             yield JoinWindowResult(
-                win.start, win.end, pairs, int(res.overflow), len(win.events)
+                win.start, win.end, pairs, overflow, len(win.events)
             )
 
 

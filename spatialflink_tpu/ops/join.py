@@ -96,9 +96,14 @@ def join_kernel(
     lane_ok = lane[None, None, :] < span[:, :, None]
     pos_c = jnp.clip(pos, 0, m - 1)
 
-    cand_xy = right_xy_sorted[pos_c]  # (N, K, cap, 2)
+    # Gather x and y planes separately: a (N, K, cap, 2) gather would be
+    # tiled to 128 lanes on its trailing dim-2 axis on TPU (64× HBM waste).
+    cand_x = right_xy_sorted[:, 0][pos_c]  # (N, K, cap)
+    cand_y = right_xy_sorted[:, 1][pos_c]
     cand_valid = right_valid_sorted[pos_c] & lane_ok
-    d = point_point_distance(left_xy[:, None, None, :], cand_xy)
+    dx = cand_x - left_xy[:, 0][:, None, None]
+    dy = cand_y - left_xy[:, 1][:, None, None]
+    d = jnp.sqrt(dx * dx + dy * dy)
     pair = cand_valid & left_valid[:, None, None] & (d <= radius)
 
     right_idx = jnp.where(cand_valid, right_order[pos_c], -1)
@@ -109,6 +114,58 @@ def join_kernel(
         d.reshape(n, k * cap),
         overflow,
     )
+
+
+class CompactJoinResult(NamedTuple):
+    """Device-compacted join output: only the matching pairs cross the
+    host boundary (the dense (N, K·cap) mask stays on device).
+
+    ``left_index``/``right_index``: (max_pairs,) original-batch indices,
+    -1 padding; ``dist``: (max_pairs,); ``count``: () true number of pairs
+    (> max_pairs means truncation); ``overflow``: () cell-capacity drops.
+    """
+
+    left_index: jnp.ndarray
+    right_index: jnp.ndarray
+    dist: jnp.ndarray
+    count: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def join_kernel_compact(
+    left_xy: jnp.ndarray,
+    left_valid: jnp.ndarray,
+    left_cell_xy_idx: jnp.ndarray,
+    right_xy_sorted: jnp.ndarray,
+    right_valid_sorted: jnp.ndarray,
+    right_cells_sorted: jnp.ndarray,
+    right_order: jnp.ndarray,
+    neighbor_offsets: jnp.ndarray,
+    grid_n: int,
+    radius,
+    cap: int,
+    max_pairs: int,
+) -> CompactJoinResult:
+    """Grid-hash join with on-device pair compaction (static ``max_pairs``).
+
+    Fetching the dense pair mask costs O(N·K·cap) transfer per window;
+    real joins are sparse, so compacting on device turns egress into
+    O(max_pairs)."""
+    res = join_kernel(
+        left_xy, left_valid, left_cell_xy_idx,
+        right_xy_sorted, right_valid_sorted, right_cells_sorted, right_order,
+        neighbor_offsets, grid_n=grid_n, radius=radius, cap=cap,
+    )
+    n, kc = res.pair_mask.shape
+    flat = res.pair_mask.reshape(-1)
+    (hit_idx,) = jnp.nonzero(flat, size=max_pairs, fill_value=-1)
+    found = hit_idx >= 0
+    hit_c = jnp.maximum(hit_idx, 0)
+    left_idx = jnp.where(found, (hit_c // kc).astype(jnp.int32), -1)
+    right_idx = jnp.where(found, res.right_index.reshape(-1)[hit_c], -1)
+    dist = jnp.where(found, res.dist.reshape(-1)[hit_c], jnp.inf)
+    count = jnp.sum(flat.astype(jnp.int32))
+    return CompactJoinResult(left_idx, right_idx, dist, count, res.overflow)
 
 
 def point_geometry_join_kernel(

@@ -76,21 +76,53 @@ def range_query_polygons_kernel(
     poly_edge_valid: jnp.ndarray,
     radius,
     approximate: bool = False,
+    poly_chunk: int = 32,
 ):
     """Point stream vs polygon query set (JTS-distance semantics: 0 inside).
 
     ``poly_verts``: (P, V, 2) packed rings per query polygon;
     ``poly_edge_valid``: (P, V-1). The batched form of
     PointPolygonRangeQuery's window loop (range/PointPolygonRangeQuery.java:37-101).
+
+    Large query sets (the 1k-polygon benchmark config) are processed in
+    ``poly_chunk``-polygon blocks via ``lax.map`` so the (chunk, N, E)
+    intermediate stays bounded instead of materializing (P, N, E). When P
+    isn't a multiple of the chunk, it is padded with all-invalid dummy
+    polygons (infinite distance, never inside).
     """
     def one_poly(verts, ev):
         edge_d = point_polyline_distance(xy, verts, ev)
         inside = points_in_polygon(xy, verts, ev)
         return jnp.where(inside, jnp.zeros((), edge_d.dtype), edge_d)
 
-    d = jax.vmap(one_poly)(poly_verts, poly_edge_valid)  # (P, N)
-    min_dist = jnp.min(d, axis=0)
+    min_dist = _chunked_min_over_geoms(
+        one_poly, poly_verts, poly_edge_valid, poly_chunk
+    )
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist
+
+
+def _chunked_min_over_geoms(one_fn, verts, edge_valid, chunk):
+    """min over geometries of per-geometry point distances, processed in
+    ``chunk``-geometry lax.map blocks so the (chunk, N, E) intermediate
+    stays bounded. Short sets take the plain vmap path; padding uses
+    all-invalid dummies (infinite distance, never inside)."""
+    p = verts.shape[0]
+    if p <= chunk:
+        return jnp.min(jax.vmap(one_fn)(verts, edge_valid), axis=0)
+    pad = (-p) % chunk
+    if pad:
+        verts = jnp.concatenate(
+            [verts, jnp.zeros((pad,) + verts.shape[1:], verts.dtype)], axis=0
+        )
+        edge_valid = jnp.concatenate(
+            [edge_valid, jnp.zeros((pad,) + edge_valid.shape[1:], bool)], axis=0
+        )
+    vb = verts.reshape(-1, chunk, *verts.shape[1:])
+    eb = edge_valid.reshape(-1, chunk, *edge_valid.shape[1:])
+    block_min = jax.lax.map(
+        lambda be: jnp.min(jax.vmap(one_fn)(be[0], be[1]), axis=0), (vb, eb)
+    )  # (P/chunk, N)
+    return jnp.min(block_min, axis=0)
 
 
 def range_query_polylines_kernel(
@@ -101,16 +133,20 @@ def range_query_polylines_kernel(
     line_edge_valid: jnp.ndarray,
     radius,
     approximate: bool = False,
+    line_chunk: int = 32,
 ):
     """Point stream vs linestring query set (min edge distance).
 
     Batched form of PointLineStringRangeQuery's loop
-    (range/PointLineStringRangeQuery.java).
+    (range/PointLineStringRangeQuery.java). Large query sets are chunked
+    like range_query_polygons_kernel.
     """
-    d = jax.vmap(lambda v, e: point_polyline_distance(xy, v, e))(
-        line_verts, line_edge_valid
-    )  # (L, N)
-    min_dist = jnp.min(d, axis=0)
+    def one_line(v, e):
+        return point_polyline_distance(xy, v, e)
+
+    min_dist = _chunked_min_over_geoms(
+        one_line, line_verts, line_edge_valid, line_chunk
+    )
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist
 
 

@@ -258,3 +258,24 @@ def test_any_cell_flagged_matches_per_object_loop(rng):
         cells = grid.bbox_cells(*gb.bbox[i])
         expect = flags[cells].max() if len(cells) else 0
         assert got[i] == expect, (i, gb.bbox[i])
+
+
+def test_polygon_kernel_chunked_matches_unchunked(rng):
+    """Large polygon sets via lax.map chunks == plain vmap path."""
+    from spatialflink_tpu.ops.range import range_query_polygons_kernel
+    from spatialflink_tpu.operators.base import pack_query_geometries
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+
+    grid = UniformGrid(20, **GRID)
+    batch = make_batch(rng, n=400, bucket=512).with_cells(grid)
+    polys = generate_query_polygons(70, 0, 0, 10, 10, seed=5)  # > chunk of 32
+    verts, ev = pack_query_geometries(polys)
+    cells = [c for p in polys for c in p.grid_cells(grid)]
+    flags = grid.neighbor_flags(0.3, cells)
+    pflags = np.asarray(gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags)))
+    args = (jnp.asarray(batch.xy), jnp.asarray(batch.valid), jnp.asarray(pflags),
+            jnp.asarray(verts), jnp.asarray(ev), 0.3)
+    keep_c, dist_c = range_query_polygons_kernel(*args, poly_chunk=32)
+    keep_u, dist_u = range_query_polygons_kernel(*args, poly_chunk=128)
+    np.testing.assert_array_equal(np.asarray(keep_c), np.asarray(keep_u))
+    np.testing.assert_allclose(np.asarray(dist_c), np.asarray(dist_u), rtol=1e-12)
