@@ -85,14 +85,39 @@ class SpatialOperator:
 
     # -- batch building -------------------------------------------------------
 
-    def point_batch(self, events: Sequence[Point], dtype=np.float64) -> PointBatch:
-        batch = PointBatch.from_points(events, interner=self.interner, dtype=dtype)
+    def point_batch(self, events: Sequence[Point]) -> PointBatch:
+        # Batches stay float64 on the host regardless of the kernel dtype:
+        # the f32 cast happens at the device boundary AFTER origin-centering
+        # (see center_coords) so no precision is lost to ~116° magnitudes.
+        batch = PointBatch.from_points(events, interner=self.interner, dtype=np.float64)
         return batch.with_cells(self.grid)
 
+    def device_xy(self, batch: PointBatch, dtype):
+        """Device-ready coordinates: origin-centered for sub-f64 dtypes."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(center_coords(self.grid, batch.xy, dtype))
+
+    def device_q(self, coords, dtype):
+        """Device-ready query coordinates (any (..., 2) array)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(
+            center_coords(self.grid, np.asarray(coords, np.float64), dtype)
+        )
+
     def geometry_batch(
-        self, events: Sequence[Polygon | LineString], dtype=np.float64
+        self, events: Sequence[Polygon | LineString]
     ) -> GeometryBatch:
-        return GeometryBatch.from_objects(events, interner=self.interner, dtype=dtype)
+        # Host storage is f64; centering/casting happens at the boundary.
+        return GeometryBatch.from_objects(events, interner=self.interner,
+                                          dtype=np.float64)
+
+    def device_verts(self, verts: np.ndarray, dtype):
+        """Device-ready packed boundary vertices ((..., 2) arrays)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(center_coords(self.grid, verts, dtype))
 
 
 def query_cells_of(grid: UniformGrid, query_obj) -> List[int]:
@@ -134,6 +159,34 @@ def pack_query_geometries(
     return verts, ev
 
 
+def center_coords(grid: UniformGrid, xy: np.ndarray, dtype) -> np.ndarray:
+    """Origin-center coordinates before a float32 cast.
+
+    Degree-scale values (~116°) have f32 ulps of ~7.6e-6°, so distances
+    between nearby points lose ~meters of precision to cancellation.
+    Subtracting the grid center in float64 FIRST and then casting leaves
+    magnitudes of O(bbox span), where f32 ulps are ~1e-7° — radius-boundary
+    decisions match the f64 reference for all practical radii. Distances
+    are translation-invariant, so kernels need no other change (cell
+    assignment uses the original coordinates).
+
+    The decision keys on the EFFECTIVE device dtype: with jax x64 disabled
+    (the TPU default), a float64 request still lands as f32 on device
+    (jnp.asarray silently downcasts), so centering must happen then too.
+    """
+    import jax
+
+    effective_f64 = (
+        np.dtype(dtype) == np.float64 and jax.config.jax_enable_x64
+    )
+    if effective_f64:
+        return np.asarray(xy, np.float64)
+    cx = (grid.min_x + grid.max_x) / 2.0
+    cy = (grid.min_y + grid.max_y) / 2.0
+    out_dtype = np.float32 if np.dtype(dtype) == np.float64 else dtype
+    return (np.asarray(xy, np.float64) - np.array([cx, cy])).astype(out_dtype)
+
+
 def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
                       dtype=np.float64):
     """SoA windows → (window, padded arrays) for the run_soa fast paths.
@@ -149,17 +202,18 @@ def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
         ooo_ms=conf.allowed_lateness_ms,
     )
     for win in asm.stream(chunks):
-        xy = np.stack(
-            [np.asarray(win.arrays["x"], dtype), np.asarray(win.arrays["y"], dtype)],
+        xy64 = np.stack(
+            [np.asarray(win.arrays["x"], np.float64),
+             np.asarray(win.arrays["y"], np.float64)],
             axis=1,
         )
-        n = len(xy)
+        n = len(xy64)
         b = next_bucket(n)
-        cell = grid.assign_cells_np(xy)
+        cell = grid.assign_cells_np(xy64)
         oid = win.arrays.get("oid")
         yield (
             win,
-            pad_to_bucket(xy, b),
+            pad_to_bucket(center_coords(grid, xy64, dtype), b),
             pad_to_bucket(np.ones(n, bool), b, fill=False),
             pad_to_bucket(cell, b, fill=grid.num_cells),
             None if oid is None else pad_to_bucket(np.asarray(oid, np.int32), b, fill=0),

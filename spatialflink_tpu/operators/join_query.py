@@ -67,21 +67,25 @@ class _TaggedEvent:
 
 
 def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
-                           max_pairs=None):
+                           max_pairs=None, dtype=np.float64):
     """Run the grid-hash join kernel over two cell-assigned PointBatches.
 
     Shared by PointPointJoinQuery and TJoinQuery. With ``max_pairs`` set,
     pairs are compacted on device (CompactJoinResult) so only matches cross
     the host boundary — the dense mask path transfers O(N·K·cap) per
     window."""
+    from spatialflink_tpu.operators.base import center_coords
+
     cells_sorted, order = sort_by_cell(
         jnp.asarray(right_batch.cell), grid.num_cells
     )
     left_ci = grid.cell_xy_indices_np(left_batch.xy)
     args = (
-        jnp.asarray(left_batch.xy), jnp.asarray(left_batch.valid),
+        jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
+        jnp.asarray(left_batch.valid),
         jnp.asarray(left_ci),
-        jnp.asarray(right_batch.xy)[order], jnp.asarray(right_batch.valid)[order],
+        jnp.asarray(center_coords(grid, right_batch.xy, dtype))[order],
+        jnp.asarray(right_batch.valid)[order],
         cells_sorted, order, offsets,
     )
     if max_pairs is None:
@@ -120,12 +124,12 @@ class PointPointJoinQuery(SpatialOperator):
             if not left_ev or not right_ev:
                 yield JoinWindowResult(win.start, win.end, [], 0, len(win.events))
                 continue
-            lb = self.point_batch(left_ev, dtype=dtype)
-            rb = self.point_batch(right_ev, dtype=dtype)
+            lb = self.point_batch(left_ev)
+            rb = self.point_batch(right_ev)
             if naive:
                 res = ck(
-                    jnp.asarray(lb.xy), jnp.asarray(lb.valid),
-                    jnp.asarray(rb.xy), jnp.asarray(rb.valid), radius,
+                    self.device_xy(lb, dtype), jnp.asarray(lb.valid),
+                    self.device_xy(rb, dtype), jnp.asarray(rb.valid), radius,
                 )
                 pm = np.asarray(res.pair_mask)
                 ri = np.asarray(res.right_index)
@@ -146,7 +150,7 @@ class PointPointJoinQuery(SpatialOperator):
                 while True:
                     res = grid_hash_join_batches(
                         self.grid, lb, rb, radius, self.cap, offsets,
-                        max_pairs=self._max_pairs,
+                        max_pairs=self._max_pairs, dtype=dtype,
                     )
                     count = int(res.count)
                     if count <= self._max_pairs:
@@ -200,12 +204,12 @@ class _PointGeometryJoinQuery(SpatialOperator):
             if not left_ev or not right_ev:
                 yield JoinWindowResult(win.start, win.end, [], 0, len(win.events))
                 continue
-            lb = self.point_batch(left_ev, dtype=dtype)
-            gb = self.geometry_batch(right_ev, dtype=dtype)
+            lb = self.point_batch(left_ev)
+            gb = self.geometry_batch(right_ev)
             mask, d = kernel(
-                jnp.asarray(lb.xy),
+                self.device_xy(lb, dtype),
                 jnp.asarray(lb.valid),
-                jnp.asarray(gb.verts),
+                self.device_verts(gb.verts, dtype),
                 jnp.asarray(gb.edge_valid),
                 jnp.asarray(gb.valid),
                 radius,
@@ -257,13 +261,13 @@ class _GeometryGeometryJoinQuery(SpatialOperator):
             if not left_ev or not right_ev:
                 yield JoinWindowResult(win.start, win.end, [], 0, len(win.events))
                 continue
-            la = self.geometry_batch(left_ev, dtype=dtype)
-            ra = self.geometry_batch(right_ev, dtype=dtype)
+            la = self.geometry_batch(left_ev)
+            ra = self.geometry_batch(right_ev)
             mask, d = kernel(
-                jnp.asarray(la.verts),
+                self.device_verts(la.verts, dtype),
                 jnp.asarray(la.edge_valid),
                 jnp.asarray(la.valid),
-                jnp.asarray(ra.verts),
+                self.device_verts(ra.verts, dtype),
                 jnp.asarray(ra.edge_valid),
                 jnp.asarray(ra.valid),
                 radius,

@@ -64,16 +64,16 @@ class _PointStreamKNNQuery(SpatialOperator):
             "k", "num_segments",
         )
         if self.query_kind == "point":
-            q = jnp.asarray(np.array([query_obj.x, query_obj.y], dtype))
+            q = self.device_q([query_obj.x, query_obj.y], dtype)
         else:
-            verts, ev = pack_query_geometries([query_obj], dtype)
-            qv, qe = jnp.asarray(verts[0]), jnp.asarray(ev[0])
+            verts, ev = pack_query_geometries([query_obj], np.float64)
+            qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events, dtype=dtype)
+            batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             args = (
-                jnp.asarray(batch.xy),
+                self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell),
                 flags_d,
@@ -119,7 +119,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
 
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
-        q = jnp.asarray(np.array([query_point.x, query_point.y], dtype))
+        q = self.device_q([query_point.x, query_point.y], dtype)
         kp = jitted(knn_points_fused, "k", "num_segments")
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
@@ -167,21 +167,20 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         flags = flags_for_queries(self.grid, radius, [query_obj])
         kg = jitted(knn_geometry_stream_kernel, "k", "num_segments")
         if isinstance(query_obj, Point):
-            q = np.array([query_obj.x, query_obj.y], dtype)
+            q = self.device_q([query_obj.x, query_obj.y], dtype)
         else:
             b = query_obj.bbox()
-            q = np.array([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2], dtype)
-        q = jnp.asarray(q)
+            q = self.device_q([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2], dtype)
 
         from spatialflink_tpu.models.batch import flag_prefix_planes
 
         prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
-            batch = self.geometry_batch(win.events, dtype=dtype)
+            batch = self.geometry_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             res = kg(
-                jnp.asarray(batch.verts),
+                self.device_verts(batch.verts, dtype),
                 jnp.asarray(batch.edge_valid),
                 jnp.asarray(batch.valid),
                 jnp.asarray(oflags),

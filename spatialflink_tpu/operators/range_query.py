@@ -71,15 +71,15 @@ class _PointStreamRangeQuery(SpatialOperator):
         polyk = jitted(range_polygons_fused, "approximate")
         lk = jitted(range_polylines_fused, "approximate")
         if self.query_kind == "point":
-            q = jnp.asarray(pack_query_points(query_set, dtype))
+            q = self.device_q(pack_query_points(query_set, np.float64), dtype)
         else:
-            verts, ev = pack_query_geometries(query_set, dtype)
-            qv, qe = jnp.asarray(verts), jnp.asarray(ev)
+            verts, ev = pack_query_geometries(query_set, np.float64)
+            qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events, dtype=dtype)
+            batch = self.point_batch(win.events)
             common = (
-                jnp.asarray(batch.xy),
+                self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell),
                 flags_d,
@@ -132,7 +132,7 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
         pk = jitted(range_points_fused, "approximate")
-        q = jnp.asarray(np.array([[query_point.x, query_point.y]], dtype))
+        q = self.device_q([[query_point.x, query_point.y]], dtype)
         slide_ms = self.conf.slide_step_ms
         carry: List[tuple] = []  # (event, dist)
 
@@ -150,9 +150,9 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
                 e for e in win.events if e.timestamp >= win.end - slide_ms
             ]
             if new_events:
-                batch = self.point_batch(new_events, dtype=dtype)
+                batch = self.point_batch(new_events)
                 keep, dist = pk(
-                    jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                    self.device_xy(batch, dtype), jnp.asarray(batch.valid),
                     jnp.asarray(batch.cell), flags_d,
                     q, radius, approximate=self.conf.approximate_query,
                 )
@@ -188,7 +188,7 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
         pk = jitted(range_points_fused, "approximate")
-        q = jnp.asarray(pack_query_points(query_set, dtype))
+        q = self.device_q(pack_query_points(query_set, np.float64), dtype)
         for win, xy, valid, cell, _ in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
@@ -239,21 +239,21 @@ class _GeometryStreamRangeQuery(SpatialOperator):
         )
         if self.query_kind == "point":
             # Points as degenerate 2-vertex polylines.
-            q = pack_query_points(query_set, dtype)
+            q = pack_query_points(query_set, np.float64)
             qverts = np.repeat(q[:, None, :], 2, axis=1)
             qev = np.ones((len(query_set), 1), bool)
         else:
-            qverts, qev = pack_query_geometries(query_set, dtype)
-        qv, qe = jnp.asarray(qverts), jnp.asarray(qev)
+            qverts, qev = pack_query_geometries(query_set, np.float64)
+        qv, qe = self.device_verts(qverts, dtype), jnp.asarray(qev)
 
         from spatialflink_tpu.models.batch import flag_prefix_planes
 
         prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
-            batch = self.geometry_batch(win.events, dtype=dtype)
+            batch = self.geometry_batch(win.events)
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             keep, dist = gk(
-                jnp.asarray(batch.verts),
+                self.device_verts(batch.verts, dtype),
                 jnp.asarray(batch.edge_valid),
                 jnp.asarray(batch.valid),
                 jnp.asarray(oflags),

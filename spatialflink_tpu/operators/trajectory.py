@@ -78,8 +78,9 @@ class TRangeQuery(SpatialOperator):
         query_polygons: Sequence[Polygon],
         dtype=np.float64,
     ) -> Iterator[TRangeResult]:
-        verts, ev = pack_query_geometries(query_polygons, dtype)
-        qv, qe = jnp.asarray(verts), jnp.asarray(ev)
+        verts, ev = pack_query_geometries(query_polygons, np.float64)
+        qv = self.device_verts(verts, dtype)
+        qe = jnp.asarray(ev)
 
         def containment(xy, valid, oid, num_segments):
             inside = jax.vmap(lambda v, e: points_in_polygon(xy, v, e))(qv, qe)
@@ -88,10 +89,10 @@ class TRangeQuery(SpatialOperator):
         kern = jax.jit(containment, static_argnames=("num_segments",))
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events, dtype=dtype)
+            batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             hits = np.asarray(
-                kern(jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                kern(self.device_xy(batch, dtype), jnp.asarray(batch.valid),
                      jnp.asarray(batch.oid), num_segments=nseg)
             )
             groups = group_by_oid(win.events)
@@ -138,14 +139,14 @@ class TKNNQuery(SpatialOperator):
     ) -> Iterator[TKnnResult]:
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
-        q = jnp.asarray(np.array([query_point.x, query_point.y], dtype))
+        q = self.device_q([query_point.x, query_point.y], dtype)
         kern = jitted(knn_points_fused, "k", "num_segments")
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events, dtype=dtype)
+            batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             res = kern(
-                jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+                self.device_xy(batch, dtype), jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell), flags_d,
                 jnp.asarray(batch.oid), q, radius, k=k, num_segments=nseg,
             )
@@ -213,10 +214,10 @@ class TJoinQuery(SpatialOperator):
             if not left_ev or not right_ev:
                 yield TJoinResult(win.start, win.end, [], len(win.events))
                 continue
-            lb = self.point_batch(left_ev, dtype=dtype)
-            rb = self.point_batch(right_ev, dtype=dtype)
+            lb = self.point_batch(left_ev)
+            rb = self.point_batch(right_ev)
             res = grid_hash_join_batches(
-                self.grid, lb, rb, radius, self.cap, offsets
+                self.grid, lb, rb, radius, self.cap, offsets, dtype=dtype
             )
             pm = np.asarray(res.pair_mask)
             ri = np.asarray(res.right_index)
@@ -292,7 +293,7 @@ class TAggregateQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TAggregateResult]:
         kern = jax.jit(traj_cell_spans_kernel, static_argnames=("num_pairs",))
         for win in self.windows(stream):
-            batch = self.point_batch(win.events, dtype=dtype)
+            batch = self.point_batch(win.events)
             oid_strs = [p.obj_id for p in win.events]
             cells = batch.cell[: len(win.events)]
             keys = [(int(c), o) for c, o in zip(cells, oid_strs)]
@@ -393,10 +394,13 @@ class TStatsQuery(SpatialOperator):
                 yield self._realtime_update(win, win.events)
                 continue
             events = sorted(win.events, key=lambda p: (p.obj_id, p.timestamp))
-            batch = PointBatch.from_points(events, interner=self.interner, dtype=dtype)
+            batch = PointBatch.from_points(events, interner=self.interner,
+                                           dtype=np.float64)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            from spatialflink_tpu.operators.base import center_coords
             res = kern(
-                jnp.asarray(batch.xy), jnp.asarray(batch.ts),
+                jnp.asarray(center_coords(self.grid, batch.xy, dtype)),
+                jnp.asarray(batch.ts),
                 jnp.asarray(batch.oid), jnp.asarray(batch.valid),
                 num_segments=nseg,
             )

@@ -291,3 +291,40 @@ def test_incremental_range_rejects_lateness(rng):
     q = Point(x=5.0, y=5.0)
     with pytest.raises(ValueError, match="allowed_lateness"):
         list(PointPointRangeQuery(conf, GRID).query_incremental(iter([]), q, 1.0))
+
+
+def test_f32_centering_preserves_radius_boundary():
+    """Origin-centering before the f32 cast keeps radius-boundary decisions
+    identical to f64 at degree-scale coordinates (Beijing ~116°), where a
+    raw f32 cast loses ~7.6e-6° to cancellation."""
+    from spatialflink_tpu.grid import UniformGrid
+
+    bj = UniformGrid(100, 115.5, 117.6, 39.6, 41.1)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    r = 0.003
+    q = []
+    left = []
+    # Pairs placed within ±2e-6 of the radius boundary.
+    rng2 = np.random.default_rng(17)
+    for i in range(200):
+        x, y = 116.4 + i * 1e-4, 40.2
+        # Keep the margin above the centered-f32 noise floor (~2e-8) so
+        # the assertion tests centering, not rounding luck.
+        sign = 1 if rng2.uniform() < 0.5 else -1
+        d = r + sign * rng2.uniform(5e-7, 2e-6)
+        theta = rng2.uniform(0, 2 * np.pi)
+        left.append(Point(obj_id=f"l{i}", timestamp=i, x=x, y=y))
+        q.append(Point(obj_id=f"q{i}", timestamp=i,
+                       x=x + d * np.cos(theta), y=y + d * np.sin(theta)))
+    # All points share ~2 grid cells; raise the per-cell capacity so the
+    # grid-hash join stays exact (overflow == 0).
+    res = list(PointPointJoinQuery(conf, bj, cap=256).run(
+        iter(left), iter(q), r, dtype=np.float32))
+    assert all(rr.overflow == 0 for rr in res)
+    got = {(a.obj_id, b.obj_id) for rr in res for a, b, _ in rr.pairs}
+    expect = {
+        (a.obj_id, b.obj_id)
+        for a in left for b in q
+        if np.hypot(a.x - b.x, a.y - b.y) <= r
+    }
+    assert got == expect
