@@ -92,19 +92,19 @@ class SpatialOperator:
         batch = PointBatch.from_points(events, interner=self.interner, dtype=np.float64)
         return batch.with_cells(self.grid)
 
-    def device_xy(self, batch: PointBatch, dtype):
-        """Device-ready coordinates: origin-centered for sub-f64 dtypes."""
-        import jax.numpy as jnp
-
-        return jnp.asarray(center_coords(self.grid, batch.xy, dtype))
-
     def device_q(self, coords, dtype):
-        """Device-ready query coordinates (any (..., 2) array)."""
+        """Device-ready coordinates (any (..., 2) array-like): origin-
+        centered before sub-f64 casts. The one centering entry point —
+        device_xy/device_verts are shape-documenting aliases."""
         import jax.numpy as jnp
 
         return jnp.asarray(
             center_coords(self.grid, np.asarray(coords, np.float64), dtype)
         )
+
+    def device_xy(self, batch: PointBatch, dtype):
+        """Device-ready point-batch coordinates."""
+        return self.device_q(batch.xy, dtype)
 
     def geometry_batch(
         self, events: Sequence[Polygon | LineString]
@@ -115,9 +115,7 @@ class SpatialOperator:
 
     def device_verts(self, verts: np.ndarray, dtype):
         """Device-ready packed boundary vertices ((..., 2) arrays)."""
-        import jax.numpy as jnp
-
-        return jnp.asarray(center_coords(self.grid, verts, dtype))
+        return self.device_q(verts, dtype)
 
 
 def query_cells_of(grid: UniformGrid, query_obj) -> List[int]:

@@ -397,9 +397,8 @@ class TStatsQuery(SpatialOperator):
             batch = PointBatch.from_points(events, interner=self.interner,
                                            dtype=np.float64)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            from spatialflink_tpu.operators.base import center_coords
             res = kern(
-                jnp.asarray(center_coords(self.grid, batch.xy, dtype)),
+                self.device_q(batch.xy, dtype),
                 jnp.asarray(batch.ts),
                 jnp.asarray(batch.oid), jnp.asarray(batch.valid),
                 num_segments=nseg,

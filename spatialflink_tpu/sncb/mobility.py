@@ -179,10 +179,12 @@ def mobility_runner(
     source: Iterable[str],
     out_path: Optional[str] = None,
     delimiter: str = ",",
+    collect: bool = True,
 ):
     """MobilityRunner.main analog (MobilityRunner.java:14-73): CSV lines →
     GpsEvents → query q1..q5 → CSV rows (returned, and written if
-    ``out_path`` given)."""
+    ``out_path`` given). ``collect=False`` streams to the file only and
+    returns the row count — O(1) memory for unbounded socket feeds."""
     events = (csv_to_gps_event(ln, delimiter) for ln in source if ln.strip())
     q = query.lower()
     if q == "q1":
@@ -207,14 +209,48 @@ def mobility_runner(
     else:
         raise ValueError(f"unknown query {query!r}")
 
-    collected = []
     sink = open(out_path, "w") if out_path else None
+    collected = [] if collect else None
+    n = 0
     try:
         for row in rows:
-            collected.append(row)
+            n += 1
+            if collected is not None:
+                collected.append(row)
             if sink:
                 sink.write(row + "\n")
     finally:
         if sink:
             sink.close()
-    return collected
+    return collected if collected is not None else n
+
+
+def main(argv=None):
+    """MobilityRunner.main CLI parity (MobilityRunner.java:14-73):
+    ``python -m spatialflink_tpu.sncb.mobility [q1..q5] [host] [port] [outDir]``
+    — socket text stream → CSV parse → query → per-query CSV file.
+
+    Documented deviation: defaults are host ``localhost`` and outDir
+    ``Output`` (the reference defaults to ``host.docker.internal`` and
+    ``/workspace/Output`` — container-specific paths that don't apply
+    here)."""
+    import os
+    import sys
+
+    from spatialflink_tpu.streams.sources import socket_source
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    q = (args[0] if args else "q1").lower()
+    host = args[1] if len(args) > 1 else "localhost"
+    port = int(args[2]) if len(args) > 2 else 32323
+    out_dir = args[3] if len(args) > 3 else "Output"
+    os.makedirs(out_dir, exist_ok=True)
+    lines = socket_source(host, port, parser=lambda s: s)
+    out_path = os.path.join(out_dir, f"output_query{q[1:]}.csv")
+    n = mobility_runner(q, lines, out_path=out_path, collect=False)
+    print(f"{q}: {n} rows -> {out_path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
