@@ -145,29 +145,28 @@ def bench_polygon_range(jax, jnp, grid, quick):
 
 def bench_join(jax, jnp, grid, quick):
     """Config 4: spatial join of two streams, r≈200m (0.002°), grid-bucketed."""
-    from spatialflink_tpu.ops.join import join_kernel_compact, sort_by_cell
+    from spatialflink_tpu.ops.join import join_window_bucketed
 
     win_pts = 131_072
     n_win = 3 if quick else 8
     xy_a, _, _ = _stream(win_pts * n_win, seed=1)
     xy_b, _, _ = _stream(win_pts * n_win, seed=2)
     r = np.float32(0.002)
-    offsets = jnp.asarray(grid.neighbor_offsets(float(r)))
+    layers = grid.candidate_layers(float(r))
+    ones = jnp.asarray(np.ones(win_pts, bool))
     fn = jax.jit(
-        join_kernel_compact, static_argnames=("grid_n", "cap", "max_pairs")
+        join_window_bucketed,
+        static_argnames=("grid_n", "layers", "cap_left", "cap_right", "max_pairs"),
     )
 
     def one(i):
         sl = slice(i * win_pts, (i + 1) * win_pts)
         a, b = xy_a[sl], xy_b[sl]
-        bc = grid.assign_cells_np(b)
-        cells_sorted, order = sort_by_cell(jnp.asarray(bc), grid.num_cells)
         res = fn(
-            jnp.asarray(a), jnp.asarray(np.ones(win_pts, bool)),
-            jnp.asarray(grid.cell_xy_indices_np(a)),
-            jnp.asarray(b)[order], jnp.asarray(np.ones(win_pts, bool))[order],
-            cells_sorted, order, offsets,
-            grid_n=grid.n, radius=r, cap=40, max_pairs=262_144,
+            jnp.asarray(a), ones, jnp.asarray(grid.assign_cells_np(a)),
+            jnp.asarray(b), ones, jnp.asarray(grid.assign_cells_np(b)),
+            grid_n=grid.n, layers=layers, radius=r,
+            cap_left=48, cap_right=48, max_pairs=262_144,
         )
         return int(res.count), int(res.overflow)
 

@@ -32,6 +32,8 @@ from spatialflink_tpu.ops.join import (
     geometry_geometry_join_kernel,
     join_kernel,
     join_kernel_compact,
+    join_window_bucketed,
+    join_window_compact,
     point_geometry_join_kernel,
     sort_by_cell,
 )
@@ -76,27 +78,71 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
     window."""
     from spatialflink_tpu.operators.base import center_coords
 
+    if max_pairs is not None:
+        layers = grid.candidate_layers(radius)
+        span2 = (2 * layers + 1) ** 2
+        lanes = grid.num_cells * cap * cap * span2
+        if lanes <= 300_000_000:
+            # Dense-bucket join: static roll shifts, no per-candidate
+            # gathers — the fast path while the cells×cap²×span² mask
+            # stack stays bounded.
+            jk = jitted(
+                join_window_bucketed,
+                "grid_n", "layers", "cap_left", "cap_right", "max_pairs",
+            )
+            return jk(
+                jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
+                jnp.asarray(left_batch.valid),
+                jnp.asarray(left_batch.cell),
+                jnp.asarray(center_coords(grid, right_batch.xy, dtype)),
+                jnp.asarray(right_batch.valid),
+                jnp.asarray(right_batch.cell),
+                grid_n=grid.n, layers=layers,
+                radius=radius, cap_left=cap, cap_right=cap,
+                max_pairs=max_pairs,
+            )
+        # High per-cell capacity: gather-based join (memory O(N·span²·cap)).
+        jk = jitted(join_window_compact, "grid_n", "cap", "max_pairs")
+        left_in_grid = left_batch.valid & (left_batch.cell < grid.num_cells)
+        return jk(
+            jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
+            jnp.asarray(left_in_grid),
+            jnp.asarray(grid.cell_xy_indices_np(left_batch.xy)),
+            jnp.asarray(center_coords(grid, right_batch.xy, dtype)),
+            jnp.asarray(right_batch.valid),
+            jnp.asarray(right_batch.cell),
+            offsets,
+            grid_n=grid.n, radius=radius, cap=cap, max_pairs=max_pairs,
+        )
+    left_ci = grid.cell_xy_indices_np(left_batch.xy)
+    # Reference semantics: out-of-grid points carry keys that never match a
+    # neighbor set (HelperClass.assignGridCellID), so they never join.
+    left_in_grid = left_batch.valid & (left_batch.cell < grid.num_cells)
     cells_sorted, order = sort_by_cell(
         jnp.asarray(right_batch.cell), grid.num_cells
     )
-    left_ci = grid.cell_xy_indices_np(left_batch.xy)
     args = (
         jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
-        jnp.asarray(left_batch.valid),
+        jnp.asarray(left_in_grid),
         jnp.asarray(left_ci),
         jnp.asarray(center_coords(grid, right_batch.xy, dtype))[order],
         jnp.asarray(right_batch.valid)[order],
         cells_sorted, order, offsets,
     )
-    if max_pairs is None:
-        jk = jitted(join_kernel, "grid_n", "cap")
-        return jk(*args, grid_n=grid.n, radius=radius, cap=cap)
-    jk = jitted(join_kernel_compact, "grid_n", "cap", "max_pairs")
-    return jk(*args, grid_n=grid.n, radius=radius, cap=cap, max_pairs=max_pairs)
+    jk = jitted(join_kernel, "grid_n", "cap")
+    return jk(*args, grid_n=grid.n, radius=radius, cap=cap)
 
 
 class PointPointJoinQuery(SpatialOperator):
-    """join/PointPointJoinQuery.java (windowBased :124-183, naive :186-243)."""
+    """join/PointPointJoinQuery.java (windowBased :124-183, naive :186-243).
+
+    ``cap`` is the per-cell point capacity. The dense-bucket fast path caps
+    BOTH sides per cell; results are exact iff every window's
+    ``overflow == 0`` — a nonzero overflow means some cell exceeded ``cap``
+    and the join dropped candidates (raise ``cap`` for dense data; the
+    gather fallback engages automatically when cap²·cells grows too large).
+    Out-of-grid points never join, matching the reference's key semantics.
+    """
 
     def __init__(self, conf, grid, cap: int = 64):
         super().__init__(conf, grid)

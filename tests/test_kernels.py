@@ -279,3 +279,75 @@ def test_polygon_kernel_chunked_matches_unchunked(rng):
     keep_u, dist_u = range_query_polygons_kernel(*args, poly_chunk=128)
     np.testing.assert_array_equal(np.asarray(keep_c), np.asarray(keep_u))
     np.testing.assert_allclose(np.asarray(dist_c), np.asarray(dist_u), rtol=1e-12)
+
+
+def test_bucketed_join_matches_brute(rng):
+    """Dense-bucket (roll-shift) join == brute force, exact when no overflow."""
+    from spatialflink_tpu.ops.join import join_window_bucketed
+
+    grid = UniformGrid(20, **GRID)
+    r = 0.8
+    a = make_batch(rng, n=300, bucket=512).with_cells(grid)
+    b = make_batch(rng, n=200, bucket=256).with_cells(grid)
+    layers = grid.candidate_layers(r)
+    res = join_window_bucketed(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(a.cell),
+        jnp.asarray(b.xy), jnp.asarray(b.valid), jnp.asarray(b.cell),
+        grid_n=grid.n, layers=layers, radius=r,
+        cap_left=16, cap_right=16, max_pairs=65536,
+    )
+    assert int(res.overflow) == 0
+    count = int(res.count)
+    assert count <= 65536
+    li = np.asarray(res.left_index)
+    ri = np.asarray(res.right_index)
+    got = {(int(x), int(y)) for x, y in zip(li, ri) if x >= 0}
+    assert len(got) == count
+    assert got == brute_join(a, b, r)
+
+
+def test_bucketed_join_overflow_and_truncation(rng):
+    from spatialflink_tpu.ops.join import join_window_bucketed
+
+    grid = UniformGrid(20, **GRID)
+    # 60 points in one cell with cap 16 → overflow reported.
+    xy = np.full((60, 2), 5.05) + rng.normal(0, 0.001, (60, 2))
+    b = PointBatch.from_arrays(xy, bucket=64).with_cells(grid)
+    a = PointBatch.from_arrays(np.array([[5.05, 5.05]]), bucket=256).with_cells(grid)
+    res = join_window_bucketed(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(a.cell),
+        jnp.asarray(b.xy), jnp.asarray(b.valid), jnp.asarray(b.cell),
+        grid_n=grid.n, layers=1, radius=0.5,
+        cap_left=4, cap_right=16, max_pairs=4096,
+    )
+    assert int(res.overflow) > 0
+    # Truncation signalling: tiny max_pairs → count > max_pairs sentinel.
+    a2 = make_batch(rng, n=200, bucket=256).with_cells(grid)
+    b2 = make_batch(rng, n=200, bucket=256).with_cells(grid)
+    res2 = join_window_bucketed(
+        jnp.asarray(a2.xy), jnp.asarray(a2.valid), jnp.asarray(a2.cell),
+        jnp.asarray(b2.xy), jnp.asarray(b2.valid), jnp.asarray(b2.cell),
+        grid_n=grid.n, layers=grid.candidate_layers(2.0), radius=2.0,
+        cap_left=16, cap_right=16, max_pairs=50,
+    )
+    assert int(res2.count) > 50
+
+
+def test_join_out_of_grid_points_never_match(rng):
+    """Reference semantics: points outside the grid bbox carry keys no
+    neighbor set contains, so they never join — in every join variant."""
+    from spatialflink_tpu.operators import (
+        PointPointJoinQuery, QueryConfiguration, QueryType,
+    )
+    from spatialflink_tpu.models.objects import Point
+
+    grid = UniformGrid(20, **GRID)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=30, slide_step=30)
+    left = [Point(obj_id="out", timestamp=100, x=-0.05, y=5.0),
+            Point(obj_id="in", timestamp=200, x=0.2, y=5.0)]
+    right = [Point(obj_id="r", timestamp=150, x=0.05, y=5.0)]
+    for cap in (32, 256):  # bucketed path and gather path
+        res = list(PointPointJoinQuery(conf, grid, cap=cap).run(
+            iter(list(left)), iter(list(right)), 0.2))
+        got = {(a.obj_id, b.obj_id) for r in res for a, b, _ in r.pairs}
+        assert got == {("in", "r")}, (cap, got)
