@@ -34,6 +34,7 @@ from spatialflink_tpu.ops.join import (
     join_kernel_compact,
     join_window_bucketed,
     join_window_compact,
+    pallas_join_supported,
     point_geometry_join_kernel,
     sort_by_cell,
 )
@@ -69,17 +70,47 @@ class _TaggedEvent:
 
 
 def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
-                           max_pairs=None, dtype=np.float64):
+                           max_pairs=None, dtype=np.float64, backend=None):
     """Run the grid-hash join kernel over two cell-assigned PointBatches.
 
     Shared by PointPointJoinQuery and TJoinQuery. With ``max_pairs`` set,
     pairs are compacted on device (CompactJoinResult) so only matches cross
     the host boundary — the dense mask path transfers O(N·K·cap) per
-    window."""
+    window. ``backend``: None=auto (Pallas extraction on TPU — hit
+    compaction in time ∝ matches; XLA elsewhere), or one of
+    'xla' | 'pallas' | 'pallas_interpret' (tests)."""
     from spatialflink_tpu.operators.base import center_coords
 
     if max_pairs is not None:
         layers = grid.candidate_layers(radius)
+        if backend is None:
+            # The Pallas kernel keeps its (max_pairs,) outputs VMEM-resident
+            # (12 B/slot); past the budget the XLA compaction path takes
+            # over rather than blowing the ~16 MB VMEM budget.
+            from spatialflink_tpu.ops.pallas_join import PALLAS_JOIN_MAX_PAIRS
+
+            backend = (
+                "pallas"
+                if pallas_join_supported() and max_pairs <= PALLAS_JOIN_MAX_PAIRS
+                else "xla"
+            )
+        if backend in ("pallas", "pallas_interpret"):
+            from spatialflink_tpu.ops.pallas_join import join_window_pallas
+
+            # f32 explicitly: centering must run before any sub-f64 cast
+            # (center_coords skips it when asked for the effective f64), and
+            # the Pallas kernel computes in f32 regardless.
+            return join_window_pallas(
+                jnp.asarray(center_coords(grid, left_batch.xy, np.float32)),
+                jnp.asarray(left_batch.valid),
+                jnp.asarray(left_batch.cell),
+                jnp.asarray(center_coords(grid, right_batch.xy, np.float32)),
+                jnp.asarray(right_batch.valid),
+                jnp.asarray(right_batch.cell),
+                grid_n=grid.n, layers=layers, radius=radius,
+                cap_left=cap, cap_right=cap, max_pairs=max_pairs,
+                interpret=backend == "pallas_interpret",
+            )
         span2 = (2 * layers + 1) ** 2
         lanes = grid.num_cells * cap * cap * span2
         if lanes <= 300_000_000:
@@ -144,9 +175,10 @@ class PointPointJoinQuery(SpatialOperator):
     Out-of-grid points never join, matching the reference's key semantics.
     """
 
-    def __init__(self, conf, grid, cap: int = 64):
+    def __init__(self, conf, grid, cap: int = 64, join_backend: str | None = None):
         super().__init__(conf, grid)
         self.cap = cap
+        self.join_backend = join_backend  # None=auto, 'xla', 'pallas[_interpret]'
         self._max_pairs = 0  # grown budget persists across windows
 
     def run(
@@ -192,11 +224,17 @@ class PointPointJoinQuery(SpatialOperator):
                 # the budget retries once with a doubled power-of-two budget,
                 # and the grown budget persists (dense workloads pay the
                 # retry once, not per window; compile cache stays bounded).
-                self._max_pairs = max(self._max_pairs, 1024, 4 * lb.capacity)
+                # Seed capped so the default (Pallas, VMEM-resident output)
+                # path serves large windows; genuinely denser results grow
+                # the budget via the retry below.
+                self._max_pairs = max(
+                    self._max_pairs, 1024, min(4 * lb.capacity, 262_144)
+                )
                 while True:
                     res = grid_hash_join_batches(
                         self.grid, lb, rb, radius, self.cap, offsets,
                         max_pairs=self._max_pairs, dtype=dtype,
+                        backend=self.join_backend,
                     )
                     count = int(res.count)
                     if count <= self._max_pairs:

@@ -25,7 +25,7 @@ from spatialflink_tpu.operators.base import (
     pack_query_geometries,
 )
 from spatialflink_tpu.ops.knn import (
-    knn_geometry_stream_kernel,
+    knn_geometry_query_kernel,
     knn_points_fused,
     knn_polygon_fused,
     knn_polyline_fused,
@@ -150,11 +150,16 @@ class PointLineStringKNNQuery(_PointStreamKNNQuery):
 
 
 class _GeometryStreamKNNQuery(SpatialOperator):
-    """Polygon/LineString stream; query point (or geometry centroid).
+    """Polygon/LineString stream; query point or geometry.
 
-    Distance per object = min distance from the query to the object's
-    boundary edges, as the reference's Polygon/LineString KNN loops do.
+    Distance per object = ``geometry_pair_distance`` — the JTS
+    ``getDistance`` semantics of the reference's Polygon/LineString KNN
+    loops (DistanceFunctions.java:15-54): 0 on overlap/containment,
+    including a query point inside a polygonal stream object. A Point
+    query packs as a degenerate one-edge boundary.
     """
+
+    stream_polygonal = True  # Polygon* subclasses; LineString* override
 
     def run(
         self,
@@ -165,12 +170,23 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         dtype=np.float64,
     ) -> Iterator[KnnWindowResult]:
         flags = flags_for_queries(self.grid, radius, [query_obj])
-        kg = jitted(knn_geometry_stream_kernel, "k", "num_segments")
+        kg = jitted(
+            knn_geometry_query_kernel,
+            "k", "num_segments", "obj_polygonal", "query_polygonal",
+        )
         if isinstance(query_obj, Point):
-            q = self.device_q([query_obj.x, query_obj.y], dtype)
+            qverts = np.asarray(
+                [[query_obj.x, query_obj.y], [query_obj.x, query_obj.y]],
+                np.float64,
+            )
+            qev = np.asarray([True], bool)
+            query_polygonal = False
         else:
-            b = query_obj.bbox()
-            q = self.device_q([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2], dtype)
+            verts, ev = pack_query_geometries([query_obj], np.float64)
+            qverts, qev = verts[0], ev[0]
+            query_polygonal = isinstance(query_obj, Polygon)
+        qv = self.device_verts(qverts, dtype)
+        qe = jnp.asarray(qev)
 
         from spatialflink_tpu.models.batch import flag_prefix_planes
 
@@ -185,10 +201,13 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 jnp.asarray(batch.valid),
                 jnp.asarray(oflags),
                 jnp.asarray(batch.oid),
-                q,
+                qv,
+                qe,
                 radius,
                 k=k,
                 num_segments=nseg,
+                obj_polygonal=self.stream_polygonal,
+                query_polygonal=query_polygonal,
             )
             nv = int(res.num_valid)
             neighbors = [
@@ -217,10 +236,16 @@ class PolygonLineStringKNNQuery(_GeometryStreamKNNQuery):
 class LineStringPointKNNQuery(_GeometryStreamKNNQuery):
     """knn/LineStringPointKNNQuery.java."""
 
+    stream_polygonal = False
+
 
 class LineStringPolygonKNNQuery(_GeometryStreamKNNQuery):
     """knn/LineStringPolygonKNNQuery.java."""
 
+    stream_polygonal = False
+
 
 class LineStringLineStringKNNQuery(_GeometryStreamKNNQuery):
     """knn/LineStringLineStringKNNQuery.java."""
+
+    stream_polygonal = False

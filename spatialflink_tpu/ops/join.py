@@ -107,7 +107,12 @@ def join_kernel(
     pair = cand_valid & left_valid[:, None, None] & (d <= radius)
 
     right_idx = jnp.where(cand_valid, right_order[pos_c], -1)
-    overflow = jnp.sum(jnp.maximum(span - cap, 0))
+    # Only real (valid) left lanes claim overflow: padding lanes map to an
+    # arbitrary cell (often the grid origin) and would otherwise report
+    # phantom drops, breaking the overflow==0 exactness contract.
+    overflow = jnp.sum(
+        jnp.where(left_valid[:, None], jnp.maximum(span - cap, 0), 0)
+    )
     return JoinResult(
         pair.reshape(n, k * cap),
         right_idx.reshape(n, k * cap),
@@ -193,6 +198,48 @@ def join_window_compact(
     )
 
 
+def pallas_join_supported() -> bool:
+    """True when the Pallas hit-extraction join can run compiled — TPU
+    backends only (incl. the axon PJRT plugin). CPU uses the XLA bucketed
+    kernel (faster there than the Pallas interpreter)."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def bucketize_planes(xy, valid, cells, grid_n: int, cap: int):
+    """Scatter a cell-assigned point batch into dense (grid_n, grid_n, cap)
+    bucket planes: x, y, original-index (-1 = empty slot), plus the count of
+    in-grid points dropped beyond ``cap`` (overflow).
+
+    Rank within a cell comes from a stable argsort, so slot order is
+    deterministic. Invalid/out-of-grid points (cell >= grid_n²) land in a
+    discard slot and are neither stored nor counted as overflow, matching
+    the reference's key semantics (out-of-grid objects never join,
+    HelperClass.assignGridCellID)."""
+    num_cells = grid_n * grid_n
+    f_dtype = xy.dtype
+    n = xy.shape[0]
+    cells = jnp.where(valid, cells, num_cells)
+    order = jnp.argsort(cells).astype(jnp.int32)
+    sorted_cells = cells[order]
+    # Rank within cell = position − first position of that cell.
+    first = jnp.searchsorted(sorted_cells, sorted_cells, side="left")
+    rank = (jnp.arange(n, dtype=jnp.int32) - first).astype(jnp.int32)
+    ok = (sorted_cells < num_cells) & (rank < cap)
+    overflow = jnp.sum((sorted_cells < num_cells) & (rank >= cap))
+    slot = jnp.where(ok, sorted_cells * cap + rank, num_cells * cap)
+    bx = jnp.zeros(num_cells * cap + 1, f_dtype).at[slot].set(xy[order, 0])
+    by = jnp.zeros(num_cells * cap + 1, f_dtype).at[slot].set(xy[order, 1])
+    bidx = jnp.full(num_cells * cap + 1, -1, jnp.int32).at[slot].set(order)
+    shape = (grid_n, grid_n, cap)
+    return (
+        bx[:-1].reshape(shape), by[:-1].reshape(shape),
+        bidx[:-1].reshape(shape), overflow,
+    )
+
+
 def join_window_bucketed(
     left_xy: jnp.ndarray,
     left_valid: jnp.ndarray,
@@ -225,28 +272,12 @@ def join_window_bucketed(
     span = 2 * layers + 1
     f_dtype = left_xy.dtype
 
-    def bucketize(xy, valid, cells, cap):
-        n = xy.shape[0]
-        cells = jnp.where(valid, cells, num_cells)
-        order = jnp.argsort(cells).astype(jnp.int32)
-        sorted_cells = cells[order]
-        # Rank within cell = position − first position of that cell.
-        first = jnp.searchsorted(sorted_cells, sorted_cells, side="left")
-        rank = (jnp.arange(n, dtype=jnp.int32) - first).astype(jnp.int32)
-        ok = (sorted_cells < num_cells) & (rank < cap)
-        overflow = jnp.sum((sorted_cells < num_cells) & (rank >= cap))
-        slot = jnp.where(ok, sorted_cells * cap + rank, num_cells * cap)
-        bx = jnp.zeros(num_cells * cap + 1, f_dtype).at[slot].set(xy[order, 0])
-        by = jnp.zeros(num_cells * cap + 1, f_dtype).at[slot].set(xy[order, 1])
-        bidx = jnp.full(num_cells * cap + 1, -1, jnp.int32).at[slot].set(order)
-        shape = (grid_n, grid_n, cap)
-        return (
-            bx[:-1].reshape(shape), by[:-1].reshape(shape),
-            bidx[:-1].reshape(shape), overflow,
-        )
-
-    lx, ly, lidx, l_over = bucketize(left_xy, left_valid, left_cells, cap_left)
-    rx, ry, ridx, r_over = bucketize(right_xy, right_valid, right_cells, cap_right)
+    lx, ly, lidx, l_over = bucketize_planes(
+        left_xy, left_valid, left_cells, grid_n, cap_left
+    )
+    rx, ry, ridx, r_over = bucketize_planes(
+        right_xy, right_valid, right_cells, grid_n, cap_right
+    )
     lvalid = lidx >= 0
 
     # One pair-mask plane per neighbor shift, stacked: (span², cells, capL,

@@ -170,27 +170,35 @@ def knn_polyline_fused(xy, valid, cell, flags_table, oid, query_verts,
     )
 
 
-def knn_geometry_stream_kernel(
+def knn_geometry_query_kernel(
     obj_verts: jnp.ndarray,
     obj_edge_valid: jnp.ndarray,
     valid: jnp.ndarray,
     flags: jnp.ndarray,
     oid: jnp.ndarray,
-    query_xy: jnp.ndarray,
+    query_verts: jnp.ndarray,
+    query_edge_valid: jnp.ndarray,
     radius,
     k: int,
     num_segments: int,
+    obj_polygonal: bool = False,
+    query_polygonal: bool = False,
 ) -> KnnResult:
-    """Polygon/LineString-stream kNN around a query point.
+    """Geometry-stream kNN with full JTS distance semantics.
 
-    ``obj_verts``: (N, V, 2) per-object packed boundary. Distance per object
-    = min distance from the query point to the object's edges (JTS
-    ``point.distance(geom)`` for exterior points — the case the reference
-    evaluates in Polygon/LineString KNN window loops).
+    Distance per object = ``geometry_pair_distance`` (overlap/containment →
+    0), matching the reference's ``DistanceFunctions.getDistance`` calls in
+    the Polygon/LineString KNN window loops (DistanceFunctions.java:15-54 —
+    JTS returns 0 whenever the geometries intersect, including a query
+    point inside a polygon). A Point query packs as a degenerate one-edge
+    boundary.
     """
+    from spatialflink_tpu.ops.range import geometry_pair_distance
+
     def one_obj(verts, ev):
-        return jnp.min(
-            point_polyline_distance(query_xy[None, :], verts, ev)
+        return geometry_pair_distance(
+            verts, ev, query_verts, query_edge_valid,
+            obj_polygonal, query_polygonal,
         )
 
     dist = jax.vmap(one_obj)(obj_verts, obj_edge_valid)  # (N,)

@@ -146,17 +146,6 @@ class BufferedZone:
     def contains_batch(self, xy_metric: np.ndarray) -> np.ndarray:
         return contains_any_zone([self], xy_metric)
 
-    def bbox_wgs84_cells(self, grid) -> List[int]:
-        from spatialflink_tpu.utils.crs import epsg25831_to_wgs84
-
-        allv = np.concatenate(self.rings_metric, axis=0)
-        pad = self.buffer_m
-        lon, lat = epsg25831_to_wgs84(
-            np.array([allv[:, 0].min() - pad, allv[:, 0].max() + pad]),
-            np.array([allv[:, 1].min() - pad, allv[:, 1].max() + pad]),
-        )
-        return grid.bbox_cells(lon[0], lat[0], lon[1], lat[1]).tolist()
-
 
 def _zone_hit_kernel(pts, verts, evs, bufs):
     import jax

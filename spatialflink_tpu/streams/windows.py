@@ -136,15 +136,21 @@ class WindowAssembler(Generic[T]):
         wm = self.watermark
 
         fired: List[WindowBatch[T]] = []
+        landed = False
         for spec in self.windows.assign(ts):
             if spec.end + self.lateness <= wm:
-                self.dropped_late += 1
                 continue
+            landed = True
             buf = self._buffers.setdefault(spec, [])
             buf.append(event)
             if self._fired.get(spec):
                 # Late-but-allowed: refire immediately with the late event.
                 fired.append(WindowBatch(spec.start, spec.end, list(buf)))
+        if not landed:
+            # Flink's late-side-output semantics: an event counts as dropped
+            # only when every window it belongs to is past the lateness
+            # horizon — not once per expired window assignment.
+            self.dropped_late += 1
 
         fired.extend(self._advance(wm))
         return fired

@@ -217,6 +217,29 @@ def test_join_overflow_counted(rng):
     assert int(res.overflow) > 0
 
 
+def test_join_overflow_ignores_padding_lanes(rng):
+    """Padding (invalid) left lanes map to cell (0,0) — a real grid cell —
+    and must not claim overflow (ADVICE round-1 finding: the overflow==0
+    exactness contract has to be tight)."""
+    grid = UniformGrid(20, **GRID)
+    r = 0.5
+    # Crowd the grid-origin cell on the right side beyond cap.
+    bxy = np.full((80, 2), 0.05) + rng.normal(0, 0.001, (80, 2))
+    b = PointBatch.from_arrays(bxy, bucket=128).with_cells(grid)
+    # One real left point far away; batch padded to 256 lanes whose cell
+    # indices are (0, 0) → the origin cell's crowd is in their span.
+    a = PointBatch.from_arrays(np.array([[9.0, 9.0]]), bucket=256).with_cells(grid)
+    cells_sorted, order = sort_by_cell(jnp.asarray(b.cell), grid.num_cells)
+    xi = np.floor((a.xy[:, 0] - grid.min_x) / grid.cell_length).astype(np.int32)
+    yi = np.floor((a.xy[:, 1] - grid.min_y) / grid.cell_length).astype(np.int32)
+    res = join_kernel(
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(np.stack([xi, yi], 1)),
+        jnp.asarray(b.xy)[order], jnp.asarray(b.valid)[order], cells_sorted, order,
+        jnp.asarray(grid.neighbor_offsets(r)), grid.n, r, cap=16,
+    )
+    assert int(res.overflow) == 0
+
+
 def test_cross_join_matches_brute(rng):
     r = 1.2
     a = make_batch(rng, n=50, bucket=64)

@@ -102,6 +102,23 @@ def test_sliding_event_in_multiple_windows():
     assert (15_000, 25_000) in spans and (20_000, 30_000) in spans
 
 
+def test_late_drop_counted_per_event_not_per_window():
+    """Flink late-side-output semantics: one late event = one drop, even
+    when it maps to several expired sliding windows; an event that still
+    lands in any live window is not dropped (ADVICE round-1 finding)."""
+    asm = WindowAssembler(
+        SlidingEventTimeWindows(10_000, 2_000), timestamp_fn=lambda e: e.ts
+    )
+    asm.feed(Ev(1_000))
+    asm.feed(Ev(40_000))  # watermark far ahead; windows of ts=1000 expired
+    asm.feed(Ev(1_500))   # late: belongs to 5 expired windows → ONE drop
+    assert asm.dropped_late == 1
+    # ts=33_000 has expired windows (e.g. [24000,34000)) AND live ones
+    # ([26000,36000)+) — landing in a live window means NOT dropped.
+    asm.feed(Ev(33_000))
+    assert asm.dropped_late == 1
+
+
 def test_count_windows():
     cw = CountWindows(2, 1)
     buf = []
