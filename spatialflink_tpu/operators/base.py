@@ -58,11 +58,25 @@ def count_window_batches(
 
 class SpatialOperator:
     """Base: holds grid + config (SpatialOperator.java is an empty abstract
-    base; here the base carries the real shared machinery)."""
+    base; here the base carries the real shared machinery).
 
-    def __init__(self, conf: QueryConfiguration, grid: UniformGrid):
+    ``mesh``: optional ``jax.sharding.Mesh`` with a ``data`` axis. When set
+    (or passed to ``run``), window kernels execute shard_mapped over the
+    mesh — the runtime analog of the reference's default parallel execution
+    (env.setParallelism, StreamingJob.java:177; conf default 15 at
+    conf/geoflink-conf.yml:55). Results are bit-identical to single-device:
+    elementwise kernels shard the stream axis with no collective; kNN
+    pmin-reduces per-object minima over ICI (parallel/sharded.py).
+    Point batches pad to power-of-two buckets (min 256), so any
+    power-of-two ``data`` axis up to 256 divides them
+    (``mesh_from_config`` enforces power-of-two); geometry batches raise
+    their bucket floor to the data-axis size in ``geometry_batch``.
+    """
+
+    def __init__(self, conf: QueryConfiguration, grid: UniformGrid, mesh=None):
         self.conf = conf
         self.grid = grid
+        self.mesh = mesh
         self.interner = Interner()
 
     # -- window plumbing ------------------------------------------------------
@@ -107,11 +121,21 @@ class SpatialOperator:
         return self.device_q(batch.xy, dtype)
 
     def geometry_batch(
-        self, events: Sequence[Polygon | LineString]
+        self, events: Sequence[Polygon | LineString], mesh=None
     ) -> GeometryBatch:
         # Host storage is f64; centering/casting happens at the boundary.
+        # The geometry bucket floor is 8; under a mesh the object axis must
+        # divide by the data-axis size, so raise the floor to it (buckets
+        # are floor·2^k, hence always divisible by the floor).
+        mesh = mesh if mesh is not None else self.mesh
+        bucket = None
+        if mesh is not None:
+            from spatialflink_tpu.utils.padding import next_bucket
+
+            data = mesh.shape.get("data", 1)
+            bucket = next_bucket(len(events), minimum=max(8, int(data)))
         return GeometryBatch.from_objects(events, interner=self.interner,
-                                          dtype=np.float64)
+                                          dtype=np.float64, bucket=bucket)
 
     def device_verts(self, verts: np.ndarray, dtype):
         """Device-ready packed boundary vertices ((..., 2) arrays)."""

@@ -70,7 +70,8 @@ class _TaggedEvent:
 
 
 def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
-                           max_pairs=None, dtype=np.float64, backend=None):
+                           max_pairs=None, dtype=np.float64, backend=None,
+                           mesh=None):
     """Run the grid-hash join kernel over two cell-assigned PointBatches.
 
     Shared by PointPointJoinQuery and TJoinQuery. With ``max_pairs`` set,
@@ -83,6 +84,26 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
 
     if max_pairs is not None:
         layers = grid.candidate_layers(radius)
+        if mesh is not None:
+            # Multi-chip: left sharded over data, right replicated, pairs
+            # compacted on device (parallel/sharded.py) — same
+            # CompactJoinResult/retry contract as the single-device paths.
+            from spatialflink_tpu.parallel.sharded import (
+                sharded_join_window_compact,
+            )
+
+            left_in_grid = left_batch.valid & (left_batch.cell < grid.num_cells)
+            return sharded_join_window_compact(
+                mesh,
+                jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
+                jnp.asarray(left_in_grid),
+                jnp.asarray(grid.cell_xy_indices_np(left_batch.xy)),
+                jnp.asarray(center_coords(grid, right_batch.xy, dtype)),
+                jnp.asarray(right_batch.valid),
+                jnp.asarray(right_batch.cell),
+                offsets, grid_n=grid.n, radius=radius, cap=cap,
+                max_pairs=max_pairs,
+            )
         if backend is None:
             # The Pallas kernel keeps its (max_pairs,) outputs VMEM-resident
             # (12 B/slot); past the budget the XLA compaction path takes
@@ -160,6 +181,14 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
         jnp.asarray(right_batch.valid)[order],
         cells_sorted, order, offsets,
     )
+    if mesh is not None:
+        # Multi-chip: left side sharded over the mesh's data axis, the
+        # cell-sorted right side replicated (parallel/sharded.py).
+        from spatialflink_tpu.parallel.sharded import sharded_join
+
+        return sharded_join(
+            mesh, *args, grid_n=grid.n, radius=radius, cap=cap
+        )
     jk = jitted(join_kernel, "grid_n", "cap")
     return jk(*args, grid_n=grid.n, radius=radius, cap=cap)
 
@@ -175,8 +204,9 @@ class PointPointJoinQuery(SpatialOperator):
     Out-of-grid points never join, matching the reference's key semantics.
     """
 
-    def __init__(self, conf, grid, cap: int = 64, join_backend: str | None = None):
-        super().__init__(conf, grid)
+    def __init__(self, conf, grid, cap: int = 64, join_backend: str | None = None,
+                 mesh=None):
+        super().__init__(conf, grid, mesh=mesh)
         self.cap = cap
         self.join_backend = join_backend  # None=auto, 'xla', 'pallas[_interpret]'
         self._max_pairs = 0  # grown budget persists across windows
@@ -187,7 +217,9 @@ class PointPointJoinQuery(SpatialOperator):
         query_stream: Iterable[Point],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[JoinWindowResult]:
+        mesh = mesh if mesh is not None else self.mesh
         merged = (
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
@@ -234,7 +266,7 @@ class PointPointJoinQuery(SpatialOperator):
                     res = grid_hash_join_batches(
                         self.grid, lb, rb, radius, self.cap, offsets,
                         max_pairs=self._max_pairs, dtype=dtype,
-                        backend=self.join_backend,
+                        backend=self.join_backend, mesh=mesh,
                     )
                     count = int(res.count)
                     if count <= self._max_pairs:

@@ -10,6 +10,7 @@ the ordered (objID, dist, representative object) list.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -55,14 +56,41 @@ class _PointStreamKNNQuery(SpatialOperator):
         radius: float,
         k: int,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[KnnWindowResult]:
+        mesh = mesh if mesh is not None else self.mesh
         flags = flags_for_queries(self.grid, radius, [query_obj])
         flags_d = jnp.asarray(flags)
-        kp = jitted(knn_points_fused, "k", "num_segments")
-        kpoly = jitted(
-            knn_polygon_fused if self.query_kind == "polygon" else knn_polyline_fused,
-            "k", "num_segments",
+        geom_kernel = (
+            knn_polygon_fused if self.query_kind == "polygon"
+            else knn_polyline_fused
         )
+
+        def programs(nseg):
+            if mesh is not None:
+                from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+                return (
+                    sharded_window_kernel(
+                        mesh, knn_points_fused, (0, 1, 2, 4), 7,
+                        topk=True, k=k, num_segments=nseg,
+                    ),
+                    sharded_window_kernel(
+                        mesh, geom_kernel, (0, 1, 2, 4), 8,
+                        topk=True, k=k, num_segments=nseg,
+                    ),
+                )
+            return (
+                functools.partial(
+                    jitted(knn_points_fused, "k", "num_segments"),
+                    k=k, num_segments=nseg,
+                ),
+                functools.partial(
+                    jitted(geom_kernel, "k", "num_segments"),
+                    k=k, num_segments=nseg,
+                ),
+            )
+
         if self.query_kind == "point":
             q = self.device_q([query_obj.x, query_obj.y], dtype)
         else:
@@ -72,6 +100,7 @@ class _PointStreamKNNQuery(SpatialOperator):
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            kp, kpoly = programs(nseg)
             args = (
                 self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
@@ -80,9 +109,9 @@ class _PointStreamKNNQuery(SpatialOperator):
                 jnp.asarray(batch.oid),
             )
             if self.query_kind == "point":
-                res = kp(*args, q, radius, k=k, num_segments=nseg)
+                res = kp(*args, q, radius)
             else:
-                res = kpoly(*args, qv, qe, radius, k=k, num_segments=nseg)
+                res = kpoly(*args, qv, qe, radius)
             yield self._decode(win, res, k)
 
     def _decode(self, win, res, k) -> KnnWindowResult:
@@ -168,12 +197,10 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         radius: float,
         k: int,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[KnnWindowResult]:
+        mesh = mesh if mesh is not None else self.mesh
         flags = flags_for_queries(self.grid, radius, [query_obj])
-        kg = jitted(
-            knn_geometry_query_kernel,
-            "k", "num_segments", "obj_polygonal", "query_polygonal",
-        )
         if isinstance(query_obj, Point):
             qverts = np.asarray(
                 [[query_obj.x, query_obj.y], [query_obj.x, query_obj.y]],
@@ -192,8 +219,28 @@ class _GeometryStreamKNNQuery(SpatialOperator):
 
         prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
-            batch = self.geometry_batch(win.events)
+            batch = self.geometry_batch(win.events, mesh=mesh)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            statics = dict(
+                k=k, num_segments=nseg,
+                obj_polygonal=self.stream_polygonal,
+                query_polygonal=query_polygonal,
+            )
+            if mesh is not None:
+                from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+                kg = sharded_window_kernel(
+                    mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
+                    topk=True, **statics,
+                )
+            else:
+                kg = functools.partial(
+                    jitted(
+                        knn_geometry_query_kernel,
+                        "k", "num_segments", "obj_polygonal", "query_polygonal",
+                    ),
+                    **statics,
+                )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             res = kg(
                 self.device_verts(batch.verts, dtype),
@@ -204,10 +251,6 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 qv,
                 qe,
                 radius,
-                k=k,
-                num_segments=nseg,
-                obj_polygonal=self.stream_polygonal,
-                query_polygonal=query_polygonal,
             )
             nv = int(res.num_valid)
             neighbors = [

@@ -15,6 +15,7 @@ The GeoFlink pruning semantics are preserved per class:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Sequence
 
@@ -62,14 +63,36 @@ class _PointStreamRangeQuery(SpatialOperator):
         query_set: Sequence[SpatialObject],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[RangeResult]:
+        mesh = mesh if mesh is not None else self.mesh
         if not isinstance(query_set, (list, tuple)):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
-        pk = jitted(range_points_fused, "approximate")
-        polyk = jitted(range_polygons_fused, "approximate")
-        lk = jitted(range_polylines_fused, "approximate")
+        approx = self.conf.approximate_query
+        if mesh is not None:
+            from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+            pk = sharded_window_kernel(
+                mesh, range_points_fused, (0, 1, 2), 6, approximate=approx
+            )
+            polyk = sharded_window_kernel(
+                mesh, range_polygons_fused, (0, 1, 2), 7, approximate=approx
+            )
+            lk = sharded_window_kernel(
+                mesh, range_polylines_fused, (0, 1, 2), 7, approximate=approx
+            )
+        else:
+            pk = functools.partial(
+                jitted(range_points_fused, "approximate"), approximate=approx
+            )
+            polyk = functools.partial(
+                jitted(range_polygons_fused, "approximate"), approximate=approx
+            )
+            lk = functools.partial(
+                jitted(range_polylines_fused, "approximate"), approximate=approx
+            )
         if self.query_kind == "point":
             q = self.device_q(pack_query_points(query_set, np.float64), dtype)
         else:
@@ -85,11 +108,11 @@ class _PointStreamRangeQuery(SpatialOperator):
                 flags_d,
             )
             if self.query_kind == "point":
-                keep, dist = pk(*common, q, radius, approximate=self.conf.approximate_query)
+                keep, dist = pk(*common, q, radius)
             elif self.query_kind == "polygon":
-                keep, dist = polyk(*common, qv, qe, radius, approximate=self.conf.approximate_query)
+                keep, dist = polyk(*common, qv, qe, radius)
             else:
-                keep, dist = lk(*common, qv, qe, radius, approximate=self.conf.approximate_query)
+                keep, dist = lk(*common, qv, qe, radius)
             keep = np.asarray(keep)
             dist = np.asarray(dist)
             idx = np.nonzero(keep)[0]
@@ -229,14 +252,31 @@ class _GeometryStreamRangeQuery(SpatialOperator):
         query_set: Sequence[SpatialObject],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[RangeResult]:
+        mesh = mesh if mesh is not None else self.mesh
         if not isinstance(query_set, (list, tuple)):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
-        gk = jitted(
-            geometry_range_query_kernel,
-            "approximate", "obj_polygonal", "query_polygonal",
+        statics = dict(
+            approximate=self.conf.approximate_query,
+            obj_polygonal=self.stream_polygonal,
+            query_polygonal=self.query_kind == "polygon",
         )
+        if mesh is not None:
+            from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+            gk = sharded_window_kernel(
+                mesh, geometry_range_query_kernel, (0, 1, 2, 3), 7, **statics
+            )
+        else:
+            gk = functools.partial(
+                jitted(
+                    geometry_range_query_kernel,
+                    "approximate", "obj_polygonal", "query_polygonal",
+                ),
+                **statics,
+            )
         if self.query_kind == "point":
             # Points as degenerate 2-vertex polylines.
             q = pack_query_points(query_set, np.float64)
@@ -250,7 +290,7 @@ class _GeometryStreamRangeQuery(SpatialOperator):
 
         prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
-            batch = self.geometry_batch(win.events)
+            batch = self.geometry_batch(win.events, mesh=mesh)
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             keep, dist = gk(
                 self.device_verts(batch.verts, dtype),
@@ -260,9 +300,6 @@ class _GeometryStreamRangeQuery(SpatialOperator):
                 qv,
                 qe,
                 radius,
-                approximate=self.conf.approximate_query,
-                obj_polygonal=self.stream_polygonal,
-                query_polygonal=self.query_kind == "polygon",
             )
             keep = np.asarray(keep)
             dist = np.asarray(dist)

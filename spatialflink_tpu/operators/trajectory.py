@@ -376,14 +376,16 @@ class TStatsQuery(SpatialOperator):
     strictly greater than the last seen advance the state).
     """
 
-    def __init__(self, conf, grid):
-        super().__init__(conf, grid)
+    def __init__(self, conf, grid, mesh=None):
+        super().__init__(conf, grid, mesh=mesh)
         self._running: Dict[str, Tuple[float, int, int, float, float]] = {}
         # oid → (spatial, temporal, last_ts, last_x, last_y)
 
-    def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TStatsResult]:
+    def run(self, stream: Iterable[Point], dtype=np.float64,
+            mesh=None) -> Iterator[TStatsResult]:
         from spatialflink_tpu.operators.query_config import QueryType
 
+        mesh = mesh if mesh is not None else self.mesh
         realtime = self.conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive)
         kern = jax.jit(traj_stats_kernel, static_argnames=("num_segments",))
 
@@ -397,6 +399,24 @@ class TStatsQuery(SpatialOperator):
             batch = PointBatch.from_points(events, interner=self.interner,
                                            dtype=np.float64)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            if mesh is not None:
+                # Sequence-parallel: (oid, ts)-sorted points sharded over
+                # the data axis, shard-boundary pairs recovered by the
+                # ppermute halo (parallel/sharded.py:sharded_traj_stats).
+                from spatialflink_tpu.parallel.sharded import sharded_traj_stats
+
+                sp, tp, cnt, _speed = sharded_traj_stats(
+                    mesh,
+                    self.device_q(batch.xy, dtype),
+                    jnp.asarray(batch.ts),
+                    jnp.asarray(batch.oid), jnp.asarray(batch.valid),
+                    num_segments=nseg,
+                )
+                spatial = np.asarray(sp)
+                temporal = np.asarray(tp)
+                count = np.asarray(cnt)
+                yield self._decode_window(win, events, spatial, temporal, count)
+                continue
             res = kern(
                 self.device_q(batch.xy, dtype),
                 jnp.asarray(batch.ts),
@@ -406,16 +426,19 @@ class TStatsQuery(SpatialOperator):
             spatial = np.asarray(res.spatial_length)
             temporal = np.asarray(res.temporal_length)
             count = np.asarray(res.count)
-            stats = {}
-            for oid_str in {p.obj_id for p in events}:
-                i = self.interner.intern(oid_str)
-                if count[i] > 0:
-                    t = int(temporal[i])
-                    stats[oid_str] = (
-                        float(spatial[i]), t,
-                        float(spatial[i] / t) if t > 0 else 0.0,
-                    )
-            yield TStatsResult(win.start, win.end, stats, len(win.events))
+            yield self._decode_window(win, events, spatial, temporal, count)
+
+    def _decode_window(self, win, events, spatial, temporal, count) -> TStatsResult:
+        stats = {}
+        for oid_str in {p.obj_id for p in events}:
+            i = self.interner.intern(oid_str)
+            if count[i] > 0:
+                t = int(temporal[i])
+                stats[oid_str] = (
+                    float(spatial[i]), t,
+                    float(spatial[i] / t) if t > 0 else 0.0,
+                )
+        return TStatsResult(win.start, win.end, stats, len(win.events))
 
     def _realtime_update(self, win, events) -> TStatsResult:
         stats = {}

@@ -85,6 +85,8 @@ def knn_kernel(
     radius,
     k: int,
     num_segments: int,
+    axis_name=None,
+    index_base=None,
 ) -> KnnResult:
     """Point-stream kNN around a single query point.
 
@@ -95,7 +97,10 @@ def knn_kernel(
     KNNQuery.kNNWinAllEvaluation (KNNQuery.java:204-308).
     """
     dist = point_point_distance(xy, query_xy[None, :])
-    return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
+    return _topk_from_point_dists(
+        dist, valid, flags, oid, radius, k, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )
 
 
 def knn_polygon_query_kernel(
@@ -108,6 +113,8 @@ def knn_polygon_query_kernel(
     radius,
     k: int,
     num_segments: int,
+    axis_name=None,
+    index_base=None,
 ) -> KnnResult:
     """Point-stream kNN around a polygon query (JTS distance: 0 inside).
 
@@ -116,7 +123,10 @@ def knn_polygon_query_kernel(
     edge_d = point_polyline_distance(xy, query_verts, query_edge_valid)
     inside = points_in_polygon(xy, query_verts, query_edge_valid)
     dist = jnp.where(inside, jnp.zeros((), edge_d.dtype), edge_d)
-    return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
+    return _topk_from_point_dists(
+        dist, valid, flags, oid, radius, k, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )
 
 
 def knn_polyline_query_kernel(
@@ -129,44 +139,57 @@ def knn_polyline_query_kernel(
     radius,
     k: int,
     num_segments: int,
+    axis_name=None,
+    index_base=None,
 ) -> KnnResult:
     """Point-stream kNN around an open linestring query: min edge distance,
     NO containment (an open polyline encloses nothing) — the kNN analog of
     range_query_polylines_kernel (knn/PointLineStringKNNQuery.java)."""
     dist = point_polyline_distance(xy, query_verts, query_edge_valid)
-    return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
+    return _topk_from_point_dists(
+        dist, valid, flags, oid, radius, k, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )
 
 
 def knn_points_fused(xy, valid, cell, flags_table, oid, query_xy, radius,
-                     k: int, num_segments: int) -> KnnResult:
-    """Cell-flag gather + kNN in one jitted program (per-window fast path)."""
+                     k: int, num_segments: int,
+                     axis_name=None, index_base=None) -> KnnResult:
+    """Cell-flag gather + kNN in one jitted program (per-window fast path).
+
+    ``axis_name``/``index_base`` thread through to the top-k core so the
+    multi-chip path (shard_map over a mesh's ``data`` axis) runs this SAME
+    program per shard — parity with single-device by construction."""
     from spatialflink_tpu.ops.cells import gather_cell_flags
 
     return knn_kernel(
         xy, valid, gather_cell_flags(cell, flags_table), oid, query_xy,
         radius, k=k, num_segments=num_segments,
+        axis_name=axis_name, index_base=index_base,
     )
 
 
 def knn_polygon_fused(xy, valid, cell, flags_table, oid, query_verts,
-                      query_edge_valid, radius, k: int,
-                      num_segments: int) -> KnnResult:
+                      query_edge_valid, radius, k: int, num_segments: int,
+                      axis_name=None, index_base=None) -> KnnResult:
     from spatialflink_tpu.ops.cells import gather_cell_flags
 
     return knn_polygon_query_kernel(
         xy, valid, gather_cell_flags(cell, flags_table), oid, query_verts,
         query_edge_valid, radius, k=k, num_segments=num_segments,
+        axis_name=axis_name, index_base=index_base,
     )
 
 
 def knn_polyline_fused(xy, valid, cell, flags_table, oid, query_verts,
-                       query_edge_valid, radius, k: int,
-                       num_segments: int) -> KnnResult:
+                       query_edge_valid, radius, k: int, num_segments: int,
+                       axis_name=None, index_base=None) -> KnnResult:
     from spatialflink_tpu.ops.cells import gather_cell_flags
 
     return knn_polyline_query_kernel(
         xy, valid, gather_cell_flags(cell, flags_table), oid, query_verts,
         query_edge_valid, radius, k=k, num_segments=num_segments,
+        axis_name=axis_name, index_base=index_base,
     )
 
 
@@ -183,6 +206,8 @@ def knn_geometry_query_kernel(
     num_segments: int,
     obj_polygonal: bool = False,
     query_polygonal: bool = False,
+    axis_name=None,
+    index_base=None,
 ) -> KnnResult:
     """Geometry-stream kNN with full JTS distance semantics.
 
@@ -202,4 +227,7 @@ def knn_geometry_query_kernel(
         )
 
     dist = jax.vmap(one_obj)(obj_verts, obj_edge_valid)  # (N,)
-    return _topk_from_point_dists(dist, valid, flags, oid, radius, k, num_segments)
+    return _topk_from_point_dists(
+        dist, valid, flags, oid, radius, k, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )

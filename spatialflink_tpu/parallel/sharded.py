@@ -20,6 +20,8 @@ let XLA insert collectives):
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -33,6 +35,82 @@ from spatialflink_tpu.ops.distances import point_point_distance
 from spatialflink_tpu.ops.join import JoinResult, join_kernel
 from spatialflink_tpu.ops.knn import KnnResult
 from spatialflink_tpu.ops.range import _emit_mask
+
+
+def mesh_from_config(shape):
+    """Build the runtime mesh from the config's ``deviceMesh`` list
+    (config.py: Params.device_mesh — the ``parallelism`` analog of
+    conf/geoflink-conf.yml:55). 1-D → ("data",); 2-D → ("data", "query").
+    A product of 1 means single-device: returns None.
+
+    The data axis must be a power of two: window batches are padded to
+    power-of-two buckets (utils/padding.py), so only power-of-two axes
+    divide every batch.
+    """
+    import numpy as np
+
+    from spatialflink_tpu.parallel.mesh import make_mesh
+
+    shape = [int(s) for s in shape]
+    total = int(np.prod(shape)) if shape else 1
+    if total <= 1:
+        return None
+    if shape[0] & (shape[0] - 1):
+        raise ValueError(
+            f"deviceMesh data axis must be a power of two (window batches "
+            f"are padded to power-of-two buckets); got {shape[0]}"
+        )
+    names = ("data",) if len(shape) == 1 else ("data", "query")
+    return make_mesh(tuple(shape), names[: len(shape)])
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_window(mesh, kernel, data_idx, n_args, statics, topk):
+    skw = dict(statics)
+    in_specs = tuple(
+        P("data") if i in data_idx else P() for i in range(n_args)
+    )
+    if topk:
+        def local(*args):
+            base = jax.lax.axis_index("data") * args[data_idx[0]].shape[0]
+            return kernel(*args, axis_name="data", index_base=base, **skw)
+
+        out_specs = KnnResult(P(), P(), P(), P())
+    else:
+        def local(*args):
+            return kernel(*args, **skw)
+
+        out_specs = (P("data"), P("data"))
+    fn = shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_window_kernel(mesh, kernel, data_idx, n_args, topk=False, **statics):
+    """jit + shard_map a fused window kernel over a mesh's ``data`` axis.
+
+    This is how the operator layer executes on a mesh: the SAME fused
+    per-window program the single-device path jits is shard_mapped with the
+    stream-axis arguments (positions ``data_idx``) split over ``data`` and
+    everything else replicated — the moral equivalent of the reference's
+    keyBy partitioning (StreamingJob.java:177, parallelism default 15 at
+    conf/geoflink-conf.yml:55) without the shuffle.
+
+    ``topk=False``: elementwise kernels, outputs (keep, dist) stay sharded.
+    ``topk=True``: kNN kernels — the kernel's ``axis_name``/``index_base``
+    hooks pmin-reduce per-object minima across shards (one ICI all-reduce
+    replacing the reference's single-subtask windowAll merge,
+    KNNQuery.java:204-308); outputs are replicated.
+
+    Wrappers are cached per (mesh, kernel, statics) so repeated windows
+    reuse the compiled program.
+    """
+    return _cached_sharded_window(
+        mesh, kernel, tuple(data_idx), n_args,
+        tuple(sorted(statics.items())), topk,
+    )
 
 
 def sharded_range_query(
@@ -200,6 +278,95 @@ def sharded_traj_stats(
         check_vma=False,
     )
     return fn(xy, ts, oid, valid)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_join_compact(mesh, grid_n, cap, max_pairs):
+    n_shards = int(mesh.shape["data"])
+    local_budget = max_pairs // n_shards
+
+    def fn(left_xy, left_valid, left_ci,
+           right_xy, right_valid, right_cells, offsets, radius):
+        # Cell-sort the right side INSIDE the jitted program (an eager
+        # argsort per window would pay a dispatch round trip — CLAUDE.md
+        # hot-path rule).
+        order = jnp.argsort(right_cells).astype(jnp.int32)
+
+        def local(lxy, lvalid, lci, rxy, rvalid, rcells, rorder, offs, r):
+            res = join_kernel(
+                lxy, lvalid, lci, rxy, rvalid, rcells, rorder, offs,
+                grid_n=grid_n, radius=r, cap=cap,
+            )
+            # Compact PER SHARD: jnp.nonzero over a sharded value hangs the
+            # SPMD partitioner (cross-shard cumsum), so each shard extracts
+            # its own hits into a local budget of max_pairs / n_shards.
+            n_loc, kc = res.pair_mask.shape
+            flat = res.pair_mask.reshape(-1)
+            (hit,) = jnp.nonzero(flat, size=local_budget, fill_value=-1)
+            found = hit >= 0
+            hit_c = jnp.maximum(hit, 0)
+            base = jax.lax.axis_index("data") * n_loc
+            left_idx = jnp.where(
+                found, (hit_c // kc).astype(jnp.int32) + base, -1
+            )
+            right_idx = jnp.where(
+                found, res.right_index.reshape(-1)[hit_c], -1
+            )
+            dist = jnp.where(found, res.dist.reshape(-1)[hit_c], jnp.inf)
+            local_count = jnp.sum(flat.astype(jnp.int32))
+            total = jax.lax.psum(local_count, "data")
+            max_local = jax.lax.pmax(local_count, "data")
+            over = jax.lax.psum(res.overflow, "data")
+            return left_idx, right_idx, dist, total, max_local, over
+
+        left_idx, right_idx, dist, total, max_local, over = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                P("data"), P("data"), P("data"),
+                P(), P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P("data"), P("data"), P("data"), P(), P(), P()),
+            check_vma=False,
+        )(
+            left_xy, left_valid, left_ci,
+            right_xy[order], right_valid[order], right_cells[order], order,
+            offsets, radius,
+        )
+        # Shard outputs concatenate with per-shard padding tails; compact
+        # valid pairs to the front so the caller's [:count] slice works.
+        perm = jnp.argsort(left_idx < 0, stable=True)
+        # A shard whose hits exceeded its local budget dropped pairs even
+        # if the global total fits; inflating the reported count past
+        # max_pairs makes the caller's retry-with-doubled-budget kick in.
+        count = jnp.maximum(total, max_local * n_shards)
+        from spatialflink_tpu.ops.join import CompactJoinResult
+
+        return CompactJoinResult(
+            left_idx[perm], right_idx[perm], dist[perm], count, over
+        )
+
+    return jax.jit(fn)
+
+
+def sharded_join_window_compact(
+    mesh: Mesh,
+    left_xy, left_valid, left_cell_xy_idx,
+    right_xy, right_valid, right_cells,
+    neighbor_offsets, grid_n: int, radius, cap: int, max_pairs: int,
+):
+    """Multi-chip grid-hash join for the operator layer: left side sharded
+    over ``data``, right side replicated, pairs compacted per shard on
+    device (O(max_pairs) egress, same CompactJoinResult/retry contract as
+    the single-device compact and Pallas paths). One cached jitted program
+    per (mesh, grid_n, cap, max_pairs); ``max_pairs`` is rounded up to a
+    multiple of the data-axis size."""
+    n_shards = int(mesh.shape["data"])
+    max_pairs = int(max_pairs) + (-int(max_pairs)) % n_shards
+    return _cached_sharded_join_compact(mesh, grid_n, cap, max_pairs)(
+        left_xy, left_valid, left_cell_xy_idx,
+        right_xy, right_valid, right_cells, neighbor_offsets, radius,
+    )
 
 
 def sharded_join(

@@ -108,23 +108,29 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
     q_points = [Point(x=p[0], y=p[1]) for p in q.query_points]
     n = 0
     option = q.option
+    # The yml's deviceMesh (parallelism analog, conf/geoflink-conf.yml:55):
+    # a product > 1 executes every windowed kernel shard_mapped over the
+    # mesh's data axis, with results identical to single-device.
+    from spatialflink_tpu.parallel.sharded import mesh_from_config
+
+    mesh = mesh_from_config(params.device_mesh)
 
     if option in (1, 2):
         conf = window_conf if option == 1 else realtime_conf
-        op = PointPointRangeQuery(conf, grid)
+        op = PointPointRangeQuery(conf, grid, mesh=mesh)
         for res in op.run(source, q_points, q.radius):
             for p, d in zip(res.objects, res.dists):
                 sink(f"{res.start},{res.end},{p.obj_id},{float(p.x)!r},{float(p.y)!r},{float(d)!r}")
                 n += 1
     elif option in (3, 4):
         conf = window_conf if option == 3 else realtime_conf
-        op = PointPointKNNQuery(conf, grid)
+        op = PointPointKNNQuery(conf, grid, mesh=mesh)
         for res in op.run(source, q_points[0], q.radius, q.k):
             for oid, d, p in res.neighbors:
                 sink(f"{res.start},{res.end},{oid},{float(d)!r}")
                 n += 1
     elif option == 5:
-        op = PointPointJoinQuery(window_conf, grid)
+        op = PointPointJoinQuery(window_conf, grid, mesh=mesh)
         events = list(source)
         half = len(events) // 2
         for res in op.run(iter(events[:half]), iter(events[half:]), q.radius):
@@ -132,7 +138,7 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
                 sink(f"{res.start},{res.end},{a.obj_id},{b.obj_id},{float(d)!r}")
                 n += 1
     elif option == 6:
-        op = TStatsQuery(window_conf, grid)
+        op = TStatsQuery(window_conf, grid, mesh=mesh)
         for res in op.run(source):
             for oid, (sp, tp, ratio) in sorted(res.stats.items()):
                 sink(f"{res.start},{res.end},{oid},{float(sp)!r},{tp},{float(ratio)!r}")
