@@ -92,24 +92,39 @@ def main() -> None:
     res = jstep(xy_a, xy_b, oid_a, oid_b, valid_d, flags_d, q_d)
     jax.block_until_ready(res)
 
+    # Kernel-level tracing hook (the SURVEY §5 "jax.profiler traces"
+    # analog of the reference's Flink metric operators): set
+    # SFT_PROFILE_DIR=<dir> to capture an XLA/runtime trace of the
+    # measured loop (view with tensorboard or xprof).
+    import contextlib
+    import os as _os
+
+    profile_dir = _os.environ.get("SFT_PROFILE_DIR")
+    trace_ctx = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+
     latencies = []
     results = []
     slides = [(xy_a, oid_a), (xy_b, oid_b)]
     t_total0 = time.perf_counter()
-    for w in range(N_WINDOWS):
-        t0 = time.perf_counter()
-        if w + 2 <= N_WINDOWS:
-            # The slide after next starts transferring now (async
-            # device_put) and overlaps this window's compute + result
-            # fetch — streaming double-buffering.
-            slides.append(slide_arrays(w + 2))
-        (xy_a, oid_a), (xy_b, oid_b) = slides[w], slides[w + 1]
-        res = jstep(xy_a, xy_b, oid_a, oid_b, valid_d, flags_d, q_d)
-        nv = int(res.num_valid)  # result fetch = end-to-end window answer
-        latencies.append(time.perf_counter() - t0)
-        results.append(nv)
-        if w >= 1:
-            slides[w - 1] = None  # free the pane that left the window
+    with trace_ctx:
+        for w in range(N_WINDOWS):
+            t0 = time.perf_counter()
+            if w + 2 <= N_WINDOWS:
+                # The slide after next starts transferring now (async
+                # device_put) and overlaps this window's compute + result
+                # fetch — streaming double-buffering.
+                slides.append(slide_arrays(w + 2))
+            (xy_a, oid_a), (xy_b, oid_b) = slides[w], slides[w + 1]
+            res = jstep(xy_a, xy_b, oid_a, oid_b, valid_d, flags_d, q_d)
+            nv = int(res.num_valid)  # result fetch = end-to-end window answer
+            latencies.append(time.perf_counter() - t0)
+            results.append(nv)
+            if w >= 1:
+                slides[w - 1] = None  # free the pane that left the window
     t_total = time.perf_counter() - t_total0
 
     # Ingest rate: distinct stream points consumed per second (each point
@@ -121,20 +136,33 @@ def main() -> None:
     p50_ms = float(np.percentile(latencies, 50) * 1000)
     assert all(r == K for r in results), f"kNN underfilled: {results[:3]}"
 
-    print(
-        json.dumps(
-            {
-                "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
-                "value": round(points_per_sec, 1),
-                "unit": "points/s",
-                "vs_baseline": round(points_per_sec / BASELINE_EPS, 2),
-                "p50_window_latency_ms": round(p50_ms, 3),
-                "device": str(dev),
-                "windows": N_WINDOWS,
-                "k": K,
-            }
-        )
-    )
+    out = {
+        "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+        "value": round(points_per_sec, 1),
+        "unit": "points/s",
+        "vs_baseline": round(points_per_sec / BASELINE_EPS, 2),
+        "p50_window_latency_ms": round(p50_ms, 3),
+        "device": str(dev),
+        "windows": N_WINDOWS,
+        "k": K,
+    }
+    # Measured CPU-backend throughput of the same fused program on this
+    # host (bench_suite.py --cpu-baseline) — the measured counterpart to
+    # the reference's configured 20k EPS target.
+    try:
+        from bench_suite import load_cpu_baseline
+
+        cpu = load_cpu_baseline().get("continuous_knn_k50_1M_window")
+        if cpu:
+            out["vs_measured_cpu"] = round(points_per_sec / cpu, 2)
+            # The CPU figure is the SAME fused kernel on XLA:CPU with data
+            # already in RAM (no ingest); the chip path here is bound by the
+            # ~28 MB/s measurement tunnel, not TPU silicon. See BASELINE.md
+            # "Measured CPU baseline" for the full interpretation.
+            out["measured_cpu_is"] = "same-kernel XLA:CPU in-RAM upper bound"
+    except Exception:
+        pass
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -22,6 +22,8 @@ import os
 import pickle
 from typing import Any, Dict
 
+import numpy as np
+
 from spatialflink_tpu.streams.windows import WindowAssembler, WindowSpec
 from spatialflink_tpu.utils.interning import Interner
 
@@ -61,8 +63,12 @@ def restore_interner(interner: Interner, state: Dict[str, Any]) -> None:
 def operator_state(op) -> Dict[str, Any]:
     """Snapshot the known stateful fields of an operator instance."""
     out: Dict[str, Any] = {"interner": interner_state(op.interner)}
-    if hasattr(op, "_state"):  # TAggregateQuery MapState
-        out["agg_state"] = dict(op._state)
+    if hasattr(op, "_skeys"):  # TAggregateQuery MapState (sorted arrays)
+        out["agg_state"] = {
+            "keys": op._skeys.copy(),
+            "min": op._smin.copy(),
+            "max": op._smax.copy(),
+        }
     if hasattr(op, "_running"):  # TStatsQuery ValueState
         out["running"] = dict(op._running)
     return out
@@ -70,8 +76,11 @@ def operator_state(op) -> Dict[str, Any]:
 
 def restore_operator(op, state: Dict[str, Any]) -> None:
     restore_interner(op.interner, state["interner"])
-    if "agg_state" in state and hasattr(op, "_state"):
-        op._state = dict(state["agg_state"])
+    if "agg_state" in state and hasattr(op, "_skeys"):
+        agg = state["agg_state"]
+        op._skeys = np.asarray(agg["keys"], np.int64)
+        op._smin = np.asarray(agg["min"], np.int64)
+        op._smax = np.asarray(agg["max"], np.int64)
     if "running" in state and hasattr(op, "_running"):
         op._running = dict(state["running"])
 

@@ -74,6 +74,15 @@ class NESFileReporter:
             f"METRICS ts={ts} eps_in_avg={eps_in:.2f} eps_out_avg={eps_out:.2f} "
             f"selectivity_e2e={sel:.4f} throughput_mb_s={mbps:.4f}"
         )
+        # Kernel-level counters (Point.java:220-235 distance-computation
+        # analog) append when the global registry is enabled.
+        from spatialflink_tpu.ops.counters import counters as opcounters
+
+        if opcounters.enabled:
+            line += (
+                f" dist_comp_total={opcounters.dist_computations}"
+                f" candidate_lanes_total={opcounters.candidate_lanes}"
+            )
         with open(self.stats_path, "a") as f:
             f.write(line + "\n")
         return line

@@ -219,11 +219,17 @@ def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
     from spatialflink_tpu.streams.soa import SoaWindowAssembler
     from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
 
+    from spatialflink_tpu.ops.counters import counters
+
     asm = SoaWindowAssembler(
         conf.window_size_ms, conf.slide_step_ms,
         ooo_ms=conf.allowed_lateness_ms,
     )
     for win in asm.stream(chunks):
+        if counters.enabled:
+            # Throughput meter for the SoA path (Point.java:237-253 analog);
+            # candidate tallies come from the operator (it owns the flags).
+            counters.record_window(win.count, 0, 0)
         xy64 = np.stack(
             [np.asarray(win.arrays["x"], np.float64),
              np.asarray(win.arrays["y"], np.float64)],

@@ -224,6 +224,11 @@ class PointPointJoinQuery(SpatialOperator):
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
         )
+        from spatialflink_tpu.ops.counters import (
+            count_join_candidates,
+            counters as opcounters,
+        )
+
         ck = jitted(cross_join_kernel)
         offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
         naive = self.conf.query_type == QueryType.RealTimeNaive
@@ -236,6 +241,15 @@ class PointPointJoinQuery(SpatialOperator):
                 continue
             lb = self.point_batch(left_ev)
             rb = self.point_batch(right_ev)
+            if opcounters.enabled:
+                if naive:
+                    cand = len(left_ev) * len(right_ev)
+                else:
+                    cand = count_join_candidates(
+                        self.grid, lb.cell, len(left_ev), rb.cell,
+                        len(right_ev), self.grid.candidate_layers(radius),
+                    )
+                opcounters.record_window(len(win.events), cand, cand)
             if naive:
                 res = ck(
                     self.device_xy(lb, dtype), jnp.asarray(lb.valid),
@@ -287,6 +301,87 @@ class PointPointJoinQuery(SpatialOperator):
             yield JoinWindowResult(
                 win.start, win.end, pairs, overflow, len(win.events)
             )
+
+
+    def run_soa(
+        self,
+        left_chunks,
+        right_chunks,
+        radius: float,
+        max_pairs: int = 262_144,
+        dtype=np.float64,
+    ):
+        """High-rate SoA path: two chunk streams of {"ts","x","y",...}
+        arrays → per-window (start, end, left_index, right_index, dist,
+        count, overflow) raw compact-join arrays (indices into each side's
+        window arrays; -1 padding past ``count``). Windows of the two sides
+        align on their shared slide grid; a window present on only one side
+        yields zero pairs. The kernels receive the assembler's pre-centered
+        coordinates directly (Pallas extraction on TPU)."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+        from spatialflink_tpu.ops.counters import (
+            count_join_candidates,
+            counters as opcounters,
+        )
+        from spatialflink_tpu.ops.pallas_join import (
+            PALLAS_JOIN_MAX_PAIRS,
+            join_window_pallas,
+        )
+
+        def kernel_for(budget):
+            # Same backend policy as grid_hash_join_batches: Pallas only
+            # within its VMEM-resident output budget, XLA beyond.
+            if pallas_join_supported() and budget <= PALLAS_JOIN_MAX_PAIRS:
+                return join_window_pallas
+            return jitted(
+                join_window_bucketed,
+                "grid_n", "layers", "cap_left", "cap_right", "max_pairs",
+            )
+
+        layers = self.grid.candidate_layers(radius)
+        gen_l = soa_point_batches(self.grid, left_chunks, self.conf, dtype)
+        gen_r = soa_point_batches(self.grid, right_chunks, self.conf, dtype)
+        budget = max_pairs  # grown budget persists across windows
+        wl = next(gen_l, None)
+        wr = next(gen_r, None)
+        while wl is not None or wr is not None:
+            if wr is None or (wl is not None and wl[0].start < wr[0].start):
+                yield (wl[0].start, wl[0].end, np.empty(0, np.int32),
+                       np.empty(0, np.int32), np.empty(0), 0, 0)
+                wl = next(gen_l, None)
+                continue
+            if wl is None or wr[0].start < wl[0].start:
+                yield (wr[0].start, wr[0].end, np.empty(0, np.int32),
+                       np.empty(0, np.int32), np.empty(0), 0, 0)
+                wr = next(gen_r, None)
+                continue
+            win, lxy, lvalid, lcell, _ = wl
+            _, rxy, rvalid, rcell, _ = wr
+            if opcounters.enabled:
+                cand = count_join_candidates(
+                    self.grid, lcell, int(lvalid.sum()), rcell,
+                    int(rvalid.sum()), layers,
+                )
+                opcounters.record_candidates(cand, cand)
+            while True:
+                fn = kernel_for(budget)
+                res = fn(
+                    jnp.asarray(lxy), jnp.asarray(lvalid), jnp.asarray(lcell),
+                    jnp.asarray(rxy), jnp.asarray(rvalid), jnp.asarray(rcell),
+                    grid_n=self.grid.n, layers=layers, radius=radius,
+                    cap_left=self.cap, cap_right=self.cap, max_pairs=budget,
+                )
+                count = int(res.count)
+                if count <= budget:
+                    break
+                budget = int(2 ** np.ceil(np.log2(count)))
+            yield (
+                win.start, win.end,
+                np.asarray(res.left_index), np.asarray(res.right_index),
+                np.asarray(res.dist), count, int(res.overflow),
+            )
+            wl = next(gen_l, None)
+            wr = next(gen_r, None)
 
 
 class _PointGeometryJoinQuery(SpatialOperator):

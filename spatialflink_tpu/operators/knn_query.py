@@ -97,8 +97,13 @@ class _PointStreamKNNQuery(SpatialOperator):
             verts, ev = pack_query_geometries([query_obj], np.float64)
             qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
 
+        from spatialflink_tpu.ops.counters import count_candidates, counters
+
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
+            if counters.enabled:
+                cand = count_candidates(flags, batch.cell, len(win.events))
+                counters.record_window(len(win.events), cand, cand)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             kp, kpoly = programs(nseg)
             args = (
@@ -145,6 +150,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         num_valid). ``oid`` must already be dense int32 in
         [0, num_segments) — e.g. the native parser's interned device ids."""
         from spatialflink_tpu.operators.base import soa_point_batches
+        from spatialflink_tpu.ops.counters import count_candidates, counters
 
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
@@ -153,6 +159,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
+            if counters.enabled:
+                cand = count_candidates(flags, cell, win.count)
+                counters.record_candidates(cand, cand)
             res = kp(
                 jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
                 flags_d, jnp.asarray(oid),

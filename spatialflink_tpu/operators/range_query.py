@@ -99,8 +99,15 @@ class _PointStreamRangeQuery(SpatialOperator):
             verts, ev = pack_query_geometries(query_set, np.float64)
             qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
 
+        from spatialflink_tpu.ops.counters import count_candidates, counters
+
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
+            if counters.enabled:
+                cand = count_candidates(flags, batch.cell, len(win.events))
+                counters.record_window(
+                    len(win.events), cand, cand * len(query_set)
+                )
             common = (
                 self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
@@ -212,9 +219,14 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
         flags_d = jnp.asarray(flags)
         pk = jitted(range_points_fused, "approximate")
         q = self.device_q(pack_query_points(query_set, np.float64), dtype)
+        from spatialflink_tpu.ops.counters import count_candidates, counters
+
         for win, xy, valid, cell, _ in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
+            if counters.enabled:
+                cand = count_candidates(flags, cell, win.count)
+                counters.record_candidates(cand, cand * len(query_set))
             keep, dist = pk(
                 jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
                 flags_d, q, radius,

@@ -31,7 +31,9 @@ from spatialflink_tpu.ops.polygon import points_in_polygon
 from spatialflink_tpu.ops.trajectory import (
     traj_cell_spans_kernel,
     traj_hits_kernel,
+    traj_pair_dedup_kernel,
     traj_stats_kernel,
+    traj_stats_sorted_fused,
 )
 from spatialflink_tpu.streams.windows import WindowBatch
 from spatialflink_tpu.utils.padding import next_bucket
@@ -161,6 +163,34 @@ class TKNNQuery(SpatialOperator):
             yield TKnnResult(win.start, win.end, out, len(win.events))
 
 
+    def run_soa(self, chunks, query_point: Point, radius: float, k: int,
+                num_segments: int, dtype=np.float64):
+        """High-rate SoA path: per window, the k nearest trajectories as
+        (start, end, oids, min_dists, num_valid) arrays — the kNN kernel's
+        per-objID segment-min IS the per-trajectory min distance
+        (tKnn/PointPointTKNNQuery.java:181-310's deepest hot path), no
+        object materialization."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+
+        flags = flags_for_queries(self.grid, radius, [query_point])
+        flags_d = jnp.asarray(flags)
+        q = self.device_q([query_point.x, query_point.y], dtype)
+        kern = jitted(knn_points_fused, "k", "num_segments")
+        for win, xy, valid, cell, oid in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            res = kern(
+                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+                flags_d, jnp.asarray(oid), q, radius,
+                k=k, num_segments=num_segments,
+            )
+            nv = int(res.num_valid)
+            yield (
+                win.start, win.end,
+                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
+            )
+
+
 class PointPointTKNNQuery(TKNNQuery):
     """tKnn/PointPointTKNNQuery.java."""
 
@@ -192,6 +222,8 @@ class TJoinQuery(SpatialOperator):
     def __init__(self, conf, grid, cap: int = 64):
         super().__init__(conf, grid)
         self.cap = cap
+        self._max_pairs = 0
+        self._max_tpairs = 256
 
     def run(
         self,
@@ -207,6 +239,9 @@ class TJoinQuery(SpatialOperator):
             for tag, ev in merge_by_timestamp(stream, query_stream)
         )
         offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
+        dedup = jitted(
+            traj_pair_dedup_kernel, "num_left", "num_right", "max_tpairs"
+        )
 
         for win in self.windows(merged):
             left_ev = [t.event for t in win.events if t.tag == 0]
@@ -216,27 +251,60 @@ class TJoinQuery(SpatialOperator):
                 continue
             lb = self.point_batch(left_ev)
             rb = self.point_batch(right_ev)
-            res = grid_hash_join_batches(
-                self.grid, lb, rb, radius, self.cap, offsets, dtype=dtype
+            # Device-compacted point-pair join (Pallas extraction on TPU),
+            # with the same grown-budget retry as PointPointJoinQuery.
+            self._max_pairs = max(
+                self._max_pairs, 1024, min(4 * lb.capacity, 262_144)
             )
-            pm = np.asarray(res.pair_mask)
-            ri = np.asarray(res.right_index)
-            dd = np.asarray(res.dist)
-            best: Dict[Tuple[str, str], float] = {}
-            for i in np.nonzero(pm.any(axis=1))[0]:
-                a_id = left_ev[i].obj_id
-                for s in np.nonzero(pm[i])[0]:
-                    b_id = right_ev[int(ri[i, s])].obj_id
-                    d = float(dd[i, s])
-                    key = (a_id, b_id)
-                    if key not in best or d < best[key]:
-                        best[key] = d
+            while True:
+                res = grid_hash_join_batches(
+                    self.grid, lb, rb, radius, self.cap, offsets,
+                    max_pairs=self._max_pairs, dtype=dtype,
+                )
+                if int(res.count) <= self._max_pairs:
+                    break
+                self._max_pairs = int(2 ** np.ceil(np.log2(int(res.count))))
+            # Window-local dense trajectory ranks (vectorized host relabel).
+            l_uniq, l_local = np.unique(
+                lb.oid[: len(left_ev)], return_inverse=True
+            )
+            r_uniq, r_local = np.unique(
+                rb.oid[: len(right_ev)], return_inverse=True
+            )
+            l_loc = np.zeros(lb.capacity, np.int32)
+            l_loc[: len(left_ev)] = l_local
+            r_loc = np.zeros(rb.capacity, np.int32)
+            r_loc[: len(right_ev)] = r_local
+            num_l = int(next_bucket(len(l_uniq), minimum=16))
+            num_r = int(next_bucket(len(r_uniq), minimum=16))
+            # Per-(traj, traj) min distance + compaction on device — the
+            # reference's dedup map (TJoinQuery.java:60-154) without the
+            # per-matching-point host loop.
+            while True:
+                tp = dedup(
+                    res.left_index, res.right_index, res.dist,
+                    jnp.asarray(l_loc), jnp.asarray(r_loc),
+                    num_left=num_l, num_right=num_r,
+                    max_tpairs=self._max_tpairs,
+                )
+                if int(tp.count) <= self._max_tpairs:
+                    break
+                self._max_tpairs = int(2 ** np.ceil(np.log2(int(tp.count))))
             lgroups = group_by_oid(left_ev)
             rgroups = group_by_oid(right_ev)
+            keys = np.asarray(tp.pair_key)
+            dists = np.asarray(tp.dist)
+            found: List[Tuple[str, str, float]] = []
+            for pk, d in zip(keys, dists):
+                if pk < 0:
+                    continue
+                a = self.interner.lookup(int(l_uniq[pk // num_r]))
+                b = self.interner.lookup(int(r_uniq[pk % num_r]))
+                found.append((a, b, float(d)))
             pairs = [
                 (sub_trajectory(lgroups[a], a, win.start),
                  sub_trajectory(rgroups[b], b, win.start), d)
-                for (a, b), d in sorted(best.items())
+                for a, b, d in sorted(found)
             ]
             yield TJoinResult(win.start, win.end, pairs, len(win.events))
 
@@ -288,61 +356,91 @@ class TAggregateQuery(SpatialOperator):
             raise ValueError(f"bad aggregate {aggregate!r}")
         self.aggregate = aggregate.upper()
         self.inactive_threshold_ms = inactive_threshold_ms
-        self._state: Dict[Tuple[int, str], Tuple[int, int]] = {}  # (cell, oid) → (min, max)
+        # MapState analog as parallel sorted arrays keyed by
+        # cell << 32 | interned objID — merged per window with vectorized
+        # numpy (round 1's per-pair Python dict merge capped throughput).
+        self._skeys = np.empty(0, np.int64)
+        self._smin = np.empty(0, np.int64)
+        self._smax = np.empty(0, np.int64)
 
     def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TAggregateResult]:
         kern = jax.jit(traj_cell_spans_kernel, static_argnames=("num_pairs",))
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
-            oid_strs = [p.obj_id for p in win.events]
-            cells = batch.cell[: len(win.events)]
-            keys = [(int(c), o) for c, o in zip(cells, oid_strs)]
-            uniq = sorted(set(keys))
-            pair_index = {kv: i for i, kv in enumerate(uniq)}
+            n = len(win.events)
+            key64 = (
+                batch.cell[:n].astype(np.int64) << 32
+            ) | batch.oid[:n].astype(np.int64)
+            uniq_keys, inverse = np.unique(key64, return_inverse=True)
             pair_id = np.zeros(batch.capacity, np.int32)
-            pair_id[: len(keys)] = [pair_index[kv] for kv in keys]
-            num_pairs = next_bucket(len(uniq), minimum=64)
+            pair_id[:n] = inverse.astype(np.int32)
+            num_pairs = next_bucket(len(uniq_keys), minimum=64)
             spans = kern(
                 jnp.asarray(batch.ts), jnp.asarray(pair_id),
                 jnp.asarray(batch.valid), num_pairs=num_pairs,
             )
-            mn = np.asarray(spans.min_ts)
-            mx = np.asarray(spans.max_ts)
-            # Merge into continuous state (MapState semantics).
-            for kv, i in pair_index.items():
-                old = self._state.get(kv)
-                if old is None:
-                    self._state[kv] = (int(mn[i]), int(mx[i]))
-                else:
-                    self._state[kv] = (min(old[0], int(mn[i])), max(old[1], int(mx[i])))
+            mn = np.asarray(spans.min_ts)[: len(uniq_keys)]
+            mx = np.asarray(spans.max_ts)[: len(uniq_keys)]
+            self._merge_state(uniq_keys, mn, mx)
             # Inactive-trajectory deletion (TAggregateQuery.deleteHalted…).
-            if self.inactive_threshold_ms > 0:
-                horizon = max(mx[: len(uniq)].max(initial=0), 0) - self.inactive_threshold_ms
-                self._state = {
-                    kv: v for kv, v in self._state.items() if v[1] >= horizon
-                }
+            if self.inactive_threshold_ms > 0 and len(mx):
+                horizon = max(int(mx.max()), 0) - self.inactive_threshold_ms
+                keep = self._smax >= horizon
+                self._skeys = self._skeys[keep]
+                self._smin = self._smin[keep]
+                self._smax = self._smax[keep]
             yield self._aggregate_state(win)
 
+    def _merge_state(self, keys: np.ndarray, mn: np.ndarray, mx: np.ndarray):
+        """min/max-merge the window's (key, span) table into the sorted
+        state arrays — all vectorized (searchsorted + boolean masks)."""
+        pos = np.searchsorted(self._skeys, keys)
+        in_range = pos < len(self._skeys)
+        hit = np.zeros(len(keys), bool)
+        hit[in_range] = self._skeys[pos[in_range]] == keys[in_range]
+        hp = pos[hit]
+        np.minimum.at(self._smin, hp, mn[hit])
+        np.maximum.at(self._smax, hp, mx[hit])
+        if (~hit).any():
+            order_keys = np.concatenate([self._skeys, keys[~hit]])
+            order = np.argsort(order_keys, kind="stable")
+            self._skeys = order_keys[order]
+            self._smin = np.concatenate([self._smin, mn[~hit]])[order]
+            self._smax = np.concatenate([self._smax, mx[~hit]])[order]
+
     def _aggregate_state(self, win: WindowBatch) -> TAggregateResult:
-        per_cell: Dict[int, Dict[str, int]] = {}
-        for (cell, oid), (mn, mx) in self._state.items():
-            per_cell.setdefault(cell, {})[oid] = mx - mn
         out: Dict[str, Tuple[int, Dict[str, int]]] = {}
-        for cell, lens in per_cell.items():
-            name = self.grid.cell_name(cell) if cell < self.grid.num_cells else "out"
-            n = len(lens)
+        if not len(self._skeys):
+            return TAggregateResult(win.start, win.end, out, len(win.events))
+        cells = (self._skeys >> 32).astype(np.int64)
+        oids = (self._skeys & 0xFFFFFFFF).astype(np.int64)
+        lens = self._smax - self._smin
+        # State is key-sorted, so cells are grouped: reduce per contiguous run.
+        starts = np.flatnonzero(np.r_[True, cells[1:] != cells[:-1]])
+        ends = np.r_[starts[1:], len(cells)]
+        for s, e in zip(starts, ends):
+            cell = int(cells[s])
+            name = (
+                self.grid.cell_name(cell)
+                if cell < self.grid.num_cells else "out"
+            )
+            cnt = int(e - s)
+            seg = lens[s:e]
             if self.aggregate == "ALL":
-                out[name] = (n, dict(lens))
+                out[name] = (cnt, {
+                    self.interner.lookup(int(o)): int(v)
+                    for o, v in zip(oids[s:e], seg)
+                })
             elif self.aggregate == "SUM":
-                out[name] = (n, {"": sum(lens.values())})
+                out[name] = (cnt, {"": int(seg.sum())})
             elif self.aggregate == "AVG":
-                out[name] = (n, {"": round(sum(lens.values()) / n)})
+                out[name] = (cnt, {"": round(float(seg.sum()) / cnt)})
             elif self.aggregate == "MIN":
-                oid, v = min(lens.items(), key=lambda kv: kv[1])
-                out[name] = (n, {oid: v})
+                i = int(np.argmin(seg))
+                out[name] = (cnt, {self.interner.lookup(int(oids[s + i])): int(seg[i])})
             else:  # MAX
-                oid, v = max(lens.items(), key=lambda kv: kv[1])
-                out[name] = (n, {oid: v})
+                i = int(np.argmax(seg))
+                out[name] = (cnt, {self.interner.lookup(int(oids[s + i])): int(seg[i])})
         return TAggregateResult(win.start, win.end, out, len(win.events))
 
 
@@ -439,6 +537,37 @@ class TStatsQuery(SpatialOperator):
                     float(spatial[i] / t) if t > 0 else 0.0,
                 )
         return TStatsResult(win.start, win.end, stats, len(win.events))
+
+    def run_soa(self, chunks, num_segments: int, dtype=np.float64):
+        """High-rate SoA path: chunks of {"ts","x","y","oid"} arrays →
+        per-window (start, end, spatial, temporal, count) arrays indexed by
+        dense oid. The (oid, ts) sort happens ON DEVICE
+        (traj_stats_sorted_fused) — no per-event Python objects or host
+        sorting anywhere (the round-1 throughput cap)."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+        from spatialflink_tpu.ops.counters import counters
+
+        kern = jitted(traj_stats_sorted_fused, "num_segments")
+        for win, xy, valid, cell, oid in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            n = win.count
+            if counters.enabled and n > 1:
+                # The sorted kernel evaluates one candidate distance per
+                # adjacent lane pair (masked off across trajectory breaks).
+                counters.record_candidates(n - 1, n - 1)
+            ts = np.zeros(len(valid), np.int64)
+            ts[:n] = np.asarray(win.arrays["ts"], np.int64)
+            res = kern(
+                jnp.asarray(xy), jnp.asarray(ts), jnp.asarray(oid),
+                jnp.asarray(valid), num_segments=num_segments,
+            )
+            yield (
+                win.start, win.end,
+                np.asarray(res.spatial_length),
+                np.asarray(res.temporal_length),
+                np.asarray(res.count),
+            )
 
     def _realtime_update(self, win, events) -> TStatsResult:
         stats = {}

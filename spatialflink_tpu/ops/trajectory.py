@@ -60,6 +60,82 @@ def traj_stats_kernel(
     return TrajStats(spatial, temporal, count, speed)
 
 
+def traj_stats_sorted_fused(
+    xy: jnp.ndarray,
+    ts: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_segments: int,
+) -> TrajStats:
+    """traj_stats over an UNsorted batch: the (oid, ts) sort happens on
+    device (lexsort) so SoA windows go straight from the assembler into one
+    fused program — no host-side Python sort of event objects
+    (the round-1 throughput cap, TStatsQuery.java:148-189's window walk).
+    Invalid lanes sort to the end (oid forced past every real id)."""
+    oid_sort = jnp.where(valid, oid, num_segments)
+    order = jnp.lexsort((ts, oid_sort))
+    return traj_stats_kernel(
+        xy[order], ts[order], oid[order], valid[order],
+        num_segments=num_segments,
+    )
+
+
+class TrajPairs(NamedTuple):
+    """Deduped trajectory-pair join output (device-compacted).
+
+    ``pair_key``: (max_tpairs,) int32 — left_local * num_right + right_local,
+    -1 padding; ``dist``: (max_tpairs,) min point distance of the pair;
+    ``count``: () number of distinct qualifying pairs (> max_tpairs means
+    the budget must grow).
+    """
+
+    pair_key: jnp.ndarray
+    dist: jnp.ndarray
+    count: jnp.ndarray
+
+
+def traj_pair_dedup_kernel(
+    left_index: jnp.ndarray,
+    right_index: jnp.ndarray,
+    dist: jnp.ndarray,
+    left_local: jnp.ndarray,
+    right_local: jnp.ndarray,
+    num_left: int,
+    num_right: int,
+    max_tpairs: int,
+) -> TrajPairs:
+    """Compact join pairs → distinct (trajectory, trajectory) pairs with
+    min distance, entirely on device.
+
+    Replaces the reference's per-record dedup map (latest pair per
+    (traj, queryTraj), tJoin/TJoinQuery.java:60-154) — and round 1's host
+    Python dict loop over every matching point pair — with a segment-min
+    over window-local trajectory-pair keys + one small compaction.
+
+    ``left_index``/``right_index``/``dist``: a CompactJoinResult's arrays
+    (-1 padding); ``left_local``/``right_local``: (N,)/(M,) window-local
+    dense trajectory ranks of each batch lane.
+    """
+    ok = left_index >= 0
+    key = (
+        left_local[jnp.maximum(left_index, 0)] * num_right
+        + right_local[jnp.maximum(right_index, 0)]
+    )
+    n_keys = num_left * num_right
+    key = jnp.where(ok, key, n_keys)
+    big = jnp.asarray(jnp.finfo(dist.dtype).max, dist.dtype)
+    best = jax.ops.segment_min(
+        jnp.where(ok, dist, big), key, num_segments=n_keys + 1
+    )[:n_keys]
+    hit_mask = best < big
+    (hit,) = jnp.nonzero(hit_mask, size=max_tpairs, fill_value=-1)
+    found = hit >= 0
+    pair_key = jnp.where(found, hit.astype(jnp.int32), -1)
+    pair_dist = jnp.where(found, best[jnp.maximum(hit, 0)], big)
+    count = jnp.sum(hit_mask.astype(jnp.int32))
+    return TrajPairs(pair_key, pair_dist, count)
+
+
 class TrajAggregate(NamedTuple):
     """Per-(cell, objID) temporal lengths for the heatmap aggregate."""
 

@@ -2,6 +2,10 @@
 
 Rows: ``seconds,count,bytesMB,eps,throughputMBps,avgLatencyMs`` per
 reporting interval, where latency = now − event/window timestamp.
+``include_opcounters=True`` appends a ``distComp`` column fed by the
+kernel-level counter registry (ops/counters.py — the Point.java:220-235
+distance-computation analog); off by default to preserve the reference's
+exact column set.
 """
 
 from __future__ import annotations
@@ -22,10 +26,20 @@ class MetricsSink:
         path: Optional[str] = None,
         interval_s: float = 1.0,
         bytes_per_record: int = 128,
+        include_opcounters: bool = False,
     ):
         self.name = name
         self.interval_s = interval_s
         self.bytes_per_record = bytes_per_record
+        self.include_opcounters = include_opcounters
+        self._last_dist_comp = 0
+        if include_opcounters:
+            self.HEADER = self.HEADER + ",distComp"
+            # Baseline at construction: earlier runs' tallies must not leak
+            # into this sink's first interval.
+            from spatialflink_tpu.ops.counters import counters as opcounters
+
+            self._last_dist_comp = opcounters.dist_computations
         self._t0 = time.time()
         self._interval_start = self._t0
         self._count = 0
@@ -56,6 +70,12 @@ class MetricsSink:
             f"{now - self._t0:.1f},{self._count},{mb:.3f},{eps:.1f},"
             f"{mb / dt:.3f},{avg_lat:.2f}"
         )
+        if self.include_opcounters:
+            from spatialflink_tpu.ops.counters import counters as opcounters
+
+            total = opcounters.dist_computations
+            row += f",{total - self._last_dist_comp}"
+            self._last_dist_comp = total
         self.rows.append(row)
         if self._f:
             self._f.write(row + "\n")

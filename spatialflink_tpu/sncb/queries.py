@@ -68,6 +68,12 @@ def _zone_filter(events: Sequence[GpsEvent], zones, keep_inside: bool) -> List[G
     """Batched zone containment filter over metric coordinates."""
     if not events:
         return []
+    from spatialflink_tpu.ops.counters import counters
+
+    if counters.enabled:
+        # Each event is distance/containment-tested against every zone —
+        # the distCompCounter analog for the SNCB zone kernels.
+        counters.record_candidates(len(events), len(events) * len(zones))
     xy = CRSUtils.enrich_batch(events)
     inside = contains_any_zone(zones, xy)
     keep = inside if keep_inside else ~inside

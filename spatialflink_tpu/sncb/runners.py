@@ -31,35 +31,37 @@ BRUSSELS_BBOX = (4.25, 4.50, 50.75, 50.95)
 
 
 def sample_gps_events() -> List[GpsEvent]:
-    """Fixture in the spirit of LocalTestRunner.sampleData
-    (LocalTestRunner.java:86-115): events crafted to trip each query.
-    Zones are this package's bundled resources."""
+    """The reference's own golden fixture, verbatim data
+    (LocalTestRunner.sampleData, LocalTestRunner.java:86-115), against the
+    reference's own bundled zones (src/main/resources). The Java comments
+    encode the expectations asserted in tests/test_sncb.py:
+
+      A — inside the high-risk zone (Q1 hits);
+      B — outside the maintenance area, varFA 0.7 > 0.6, varFF 0.2 ≤ 0.5
+          (Q2 alert; A's FA/FF spreads qualify too);
+      C/D — simple two-device trajectories (Q3/Q4);
+      E — inside the Q5 fence, avg speed 51.7 > 50, min 40 > 20.
+
+    t0 is fixed (the reference uses wall-clock currentTimeMillis).
+    """
     t0 = 1_700_000_000_000
-    evs = [
-        # Inside high_risk "Schaerbeek yard approach" polygon (Q1 hits).
-        GpsEvent("trainA", 4.375, 50.865, t0 + 0, 30.0, 5.0, 5.0),
-        GpsEvent("trainA", 4.378, 50.867, t0 + 1000, 31.0, 5.1, 5.0),
-        # Far from any zone.
-        GpsEvent("trainB", 4.50, 50.90, t0 + 1500, 40.0, 5.0, 5.0),
-        # Q2: trainC has FA variation 0.8 (>0.6) and FF variation 0.3 (<=0.5).
-        GpsEvent("trainC", 4.45, 50.90, t0 + 2000, 20.0, 4.0, 5.0),
-        GpsEvent("trainC", 4.45, 50.90, t0 + 2500, 21.0, 4.8, 5.3),
-        # Q2 negative: trainD varies FF too much (0.9 > 0.5).
-        GpsEvent("trainD", 4.46, 50.91, t0 + 2000, 20.0, 4.0, 5.0),
-        GpsEvent("trainD", 4.46, 50.91, t0 + 2500, 21.0, 4.8, 5.9),
-        # Inside maintenance zone (excluded from Q2).
-        GpsEvent("trainE", 4.315, 50.810, t0 + 3000, 10.0, 1.0, 9.0),
-        GpsEvent("trainE", 4.316, 50.811, t0 + 3500, 11.0, 9.0, 1.0),
-        # Q5: inside fence with high speeds (avg>50, min>20).
-        GpsEvent("trainF", 4.410, 50.850, t0 + 4000, 80.0, 5.0, 5.0),
-        GpsEvent("trainF", 4.412, 50.852, t0 + 5000, 90.0, 5.0, 5.0),
-        # Q5 negative: inside fence but slow.
-        GpsEvent("trainG", 4.410, 50.855, t0 + 4000, 5.0, 5.0, 5.0),
-        GpsEvent("trainG", 4.411, 50.856, t0 + 5000, 6.0, 5.0, 5.0),
-        # Late straggler advancing watermarks past all windows.
-        GpsEvent("trainB", 4.50, 50.90, t0 + 70_000, 40.0, 5.0, 5.0),
+    return [
+        GpsEvent("A", 4.352, 50.852, t0 + 1000, 10.0, 0.1, 0.1),
+        GpsEvent("A", 4.355, 50.855, t0 + 2000, 11.0, 0.2, 0.2),
+        GpsEvent("A", 4.358, 50.858, t0 + 3000, 12.0, 0.8, 0.4),
+        GpsEvent("B", 4.370, 50.852, t0 + 1100, 8.0, 0.1, 0.5),
+        GpsEvent("B", 4.372, 50.853, t0 + 2100, 8.5, 0.8, 0.4),
+        GpsEvent("B", 4.374, 50.854, t0 + 3100, 9.0, 0.7, 0.3),
+        GpsEvent("C", 4.40, 50.10, t0 + 1200, 15.0, None, None),
+        GpsEvent("C", 4.41, 50.11, t0 + 2200, 15.5, None, None),
+        GpsEvent("C", 4.42, 50.12, t0 + 3200, 16.0, None, None),
+        GpsEvent("D", 4.31, 50.20, t0 + 1300, 17.0, None, None),
+        GpsEvent("D", 4.33, 50.22, t0 + 2300, 18.0, None, None),
+        GpsEvent("D", 4.35, 50.24, t0 + 3300, 19.0, None, None),
+        GpsEvent("E", 4.405, 50.855, t0 + 1400, 60.0, None, None),
+        GpsEvent("E", 4.406, 50.856, t0 + 2400, 55.0, None, None),
+        GpsEvent("E", 4.407, 50.857, t0 + 3400, 40.0, None, None),
     ]
-    return evs
 
 
 def local_test_runner(verbose: bool = False) -> Dict[str, list]:
@@ -114,11 +116,16 @@ def benchmark_runner(
             device_id, x, y, timestamp, speed, 5.0, 5.0
         ),
     )
+    from spatialflink_tpu.ops.counters import counters as opcounters
+
     source_sink = MetricsSink(
         "source", f"{out_dir}/source.csv" if out_dir else None
     )
+    # The sink CSV gains a distComp column when the kernel counter registry
+    # is on (ops/counters.enable()) — the distCompCounter analog.
     result_sink = MetricsSink(
-        f"sink-{query}", f"{out_dir}/sink-{query}.csv" if out_dir else None
+        f"sink-{query}", f"{out_dir}/sink-{query}.csv" if out_dir else None,
+        include_opcounters=opcounters.enabled,
     )
 
     def counted(it):

@@ -1,0 +1,116 @@
+"""Kernel-level op counters (ops/counters.py) — the distance-computation
+counter and throughput-meter analogs (Point.java:220-235, :237-253)."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import Point
+from spatialflink_tpu.operators import (
+    PointPointJoinQuery,
+    PointPointKNNQuery,
+    PointPointRangeQuery,
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.ops import counters as oc
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+W = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+
+
+@pytest.fixture(autouse=True)
+def _counters_off():
+    yield
+    oc.disable()
+
+
+def _pts(rng, n, prefix="d"):
+    return [
+        Point(obj_id=f"{prefix}{i % 5}", timestamp=int(i * 10_000 / n),
+              x=float(rng.uniform(0, 10)), y=float(rng.uniform(0, 10)))
+        for i in range(n)
+    ]
+
+
+def test_disabled_counts_nothing(rng):
+    oc.counters.reset()
+    list(PointPointRangeQuery(W, GRID).run(iter(_pts(rng, 200)), [Point(x=5, y=5)], 0.5))
+    assert oc.counters.windows == 0 and oc.counters.dist_computations == 0
+
+
+def test_range_counts_candidates(rng):
+    oc.enable()
+    pts = _pts(rng, 400)
+    q = [Point(x=5.0, y=5.0), Point(x=2.0, y=2.0)]
+    r = 0.5
+    list(PointPointRangeQuery(W, GRID).run(iter(list(pts)), q, r))
+    snap = oc.counters.snapshot()
+    assert snap["windows"] >= 1
+    assert snap["points_in"] == 400
+    # Candidates = points in flagged cells; brute-check against the grid.
+    from spatialflink_tpu.operators.base import flags_for_queries
+
+    flags = flags_for_queries(GRID, r, q)
+    want = sum(
+        1 for p in pts if flags[GRID.flat_cell(p.x, p.y)] > 0
+    )
+    assert snap["candidate_lanes"] == want
+    assert snap["dist_computations"] == want * 2  # × query points
+    assert snap["throughput_eps"] > 0
+
+
+def test_knn_and_join_count(rng):
+    oc.enable()
+    pts = _pts(rng, 300)
+    list(PointPointKNNQuery(W, GRID).run(iter(list(pts)), Point(x=5, y=5), 2.0, 5))
+    knn_windows = oc.counters.windows
+    assert knn_windows >= 1 and oc.counters.dist_computations > 0
+
+    oc.enable()  # reset
+    left = _pts(rng, 300)
+    right = _pts(rng, 200, prefix="q")
+    list(PointPointJoinQuery(W, GRID).run(iter(left), iter(right), 0.4))
+    snap = oc.counters.snapshot()
+    # Exact candidate pairs: brute-count right points in each left point's
+    # neighbor cell square.
+    layers = GRID.candidate_layers(0.4)
+    want = 0
+    for a in left:
+        ax, ay = GRID.cell_indices(a.x, a.y)
+        for b in right:
+            bx, by = GRID.cell_indices(b.x, b.y)
+            if abs(ax - bx) <= layers and abs(ay - by) <= layers:
+                want += 1
+    assert snap["dist_computations"] == want
+
+
+def test_nes_reporter_appends_counters(tmp_path, rng):
+    from spatialflink_tpu.mn.metrics import MetricRegistry
+    from spatialflink_tpu.mn.reporter import NESFileReporter
+
+    oc.enable()
+    list(PointPointRangeQuery(W, GRID).run(
+        iter(_pts(rng, 100)), [Point(x=5, y=5)], 0.5))
+    reg = MetricRegistry()
+    rep = NESFileReporter(reg, query_id="t", out_dir=str(tmp_path))
+    line = rep.report()
+    assert "dist_comp_total=" in line and "candidate_lanes_total=" in line
+    oc.disable()
+    line2 = rep.report()
+    assert "dist_comp_total" not in line2
+
+
+def test_metrics_sink_opcounter_column(tmp_path, rng):
+    from spatialflink_tpu.sncb.metrics import MetricsSink
+
+    oc.enable()
+    sink = MetricsSink("t", path=str(tmp_path / "m.csv"),
+                       interval_s=0.0, include_opcounters=True)
+    assert sink.HEADER.endswith(",distComp")
+    oc.counters.record_candidates(10, 42)
+    sink.record(event_ts_ms=0)
+    sink.close()
+    rows = (tmp_path / "m.csv").read_text().strip().splitlines()
+    assert rows[0].endswith(",distComp")
+    assert rows[1].split(",")[-1] == "42"

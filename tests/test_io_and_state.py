@@ -166,7 +166,9 @@ def test_checkpoint_roundtrip_taggregate(tmp_path):
 
     op2 = TAggregateQuery(conf, GRID, aggregate="ALL")
     restore_operator(op2, load_checkpoint(path)["op"])
-    assert op2._state == op._state
+    np.testing.assert_array_equal(op2._skeys, op._skeys)
+    np.testing.assert_array_equal(op2._smin, op._smin)
+    np.testing.assert_array_equal(op2._smax, op._smax)
     assert op2.interner._to_key == op.interner._to_key
     # Continue the stream on the restored operator: same final aggregate.
     more = [Point(obj_id="tr0", timestamp=30_000, x=5.0, y=5.0)]

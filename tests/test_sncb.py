@@ -54,16 +54,17 @@ def test_csv_schema_14_columns():
 
 
 def test_zone_loading_and_containment():
+    # The reference's own high_risk_zones.geojson: one zone, 4.35–4.36 ×
+    # 50.85–50.86 (src/main/resources/high_risk_zones.geojson).
     zones = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
-    assert len(zones) == 2
+    assert len(zones) == 1
     assert zones[0].buffer_m == 20.0
-    # Point inside the Schaerbeek zone vs far away.
-    inside = CRSUtils.enrich_batch([GpsEvent("a", 4.377, 50.867, 0)])
+    inside = CRSUtils.enrich_batch([GpsEvent("a", 4.355, 50.855, 0)])
     outside = CRSUtils.enrich_batch([GpsEvent("b", 4.5, 50.5, 0)])
     assert contains_any_zone(zones, inside)[0]
     assert not contains_any_zone(zones, outside)[0]
     # Buffer semantics: ~15 m outside the edge must still hit (buffer 20 m).
-    edge = CRSUtils.enrich_batch([GpsEvent("c", 4.372, 50.867, 0)])
+    edge = CRSUtils.enrich_batch([GpsEvent("c", 4.350, 50.855, 0)])
     edge_shift = edge.copy()
     edge_shift[0, 0] -= 15.0  # 15 m west of the western edge
     assert contains_any_zone(zones, edge_shift)[0]
@@ -72,10 +73,13 @@ def test_zone_loading_and_containment():
 
 
 def test_wkt_fence_loading():
+    # Reference fence: 4.40–4.41 × 50.85–50.86 (q5_fence.wkt).
     fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
     assert len(fence) == 1
-    c = CRSUtils.enrich_batch([GpsEvent("a", 4.41, 50.85, 0)])
+    c = CRSUtils.enrich_batch([GpsEvent("a", 4.405, 50.855, 0)])
     assert contains_any_zone(fence, c)[0]
+    far = CRSUtils.enrich_batch([GpsEvent("b", 4.45, 50.855, 0)])
+    assert not contains_any_zone(fence, far)[0]
 
 
 def test_ops_aggregations():
@@ -100,31 +104,40 @@ def test_ops_aggregations():
 
 
 def test_q1_high_risk_fixture():
+    """Golden expectation from LocalTestRunner.java:91-94: device A's three
+    points lie inside the high-risk zone — Q1 flags exactly those."""
     risk = PolygonLoader.load_geojson_buffered("high_risk_zones.geojson", 20.0)
     hits = list(q1_high_risk(iter(sample_gps_events()), risk))
     ids = {h.raw.device_id for h in hits}
-    assert ids == {"trainA"}
-    assert len(hits) == 2
+    assert ids == {"A"}
+    assert len(hits) == 3
     # Enrichment carries metric coordinates.
     assert 5_600_000 < hits[0].y_metric < 5_700_000
 
 
 def test_q2_brake_monitor_fixture():
+    """LocalTestRunner.java:96-99: B sits outside the maintenance area with
+    varFA 0.7 > 0.6 and varFF 0.2 ≤ 0.5 → alert. A's spreads (0.7 / 0.3)
+    qualify too; C/D/E carry null FA/FF and can never alert."""
     maint = PolygonLoader.load_geojson_buffered("maintenance_areas.geojson", 0.0)
     out = list(q2_brake_monitor(iter(sample_gps_events()), maint, slide_ms=500))
     devs = {o.device_id for o in out}
-    # trainC: varFA 0.8 > 0.6, varFF 0.3 <= 0.5 → hit.
-    # trainD: varFF 0.9 > 0.5 → excluded. trainE: in maintenance → excluded.
-    assert "trainC" in devs
-    assert "trainD" not in devs and "trainE" not in devs
+    assert "B" in devs
+    assert "A" in devs
+    assert devs <= {"A", "B"}
 
 
 def test_q3_trajectory_fixture():
+    """LocalTestRunner.java:101-108: C and D build simple trajectories."""
     out = list(q3_trajectory(iter(sample_gps_events()), slide_ms=1000))
-    a_trajs = [o for o in out if o.device_id == "trainA" and "LINESTRING" in o.wkt]
-    assert a_trajs
+    c_full = [
+        o for o in out
+        if o.device_id == "C" and "LINESTRING" in o.wkt and "4.42" in o.wkt
+    ]
+    assert c_full  # some window holds C's whole 3-point trajectory
     # Coordinates ordered by timestamp.
-    assert a_trajs[0].wkt.index("4.375") < a_trajs[0].wkt.index("4.378")
+    assert c_full[0].wkt.index("4.4 ") < c_full[0].wkt.index("4.42")
+    assert any(o.device_id == "D" for o in out)
 
 
 def test_q4_restriction():
@@ -135,23 +148,27 @@ def test_q4_restriction():
         )
     )
     devs = {o.device_id for o in out}
-    assert devs == {"trainA"}  # only trainA is inside bbox+time range
+    # Inside bbox 4.3–4.4 × 50.8–50.9 and t ≤ t0+2000: A and B only
+    # (C/D fail the latitude band, E the longitude band).
+    assert devs == {"A", "B"}
 
 
 def test_q5_fence_fixture():
+    """LocalTestRunner.java:110-113: E is inside the fence with avg speed
+    51.7 > 50 and min 40 > 20 → qualifies; every other device is outside
+    the fence."""
     fence = PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0)
     out = list(q5_traj_speed_fence(iter(sample_gps_events()), fence))
     devs = {o.device_id for o in out}
-    assert "trainF" in devs  # fast train in fence
-    assert "trainG" not in devs  # slow train filtered
+    assert devs == {"E"}
 
 
 def test_local_test_runner_end_to_end():
     out = local_test_runner()
-    assert {r.raw.device_id for r in out["q1"]} == {"trainA"}
-    assert all(o.device_id != "trainE" for o in out["q2"])
+    assert {r.raw.device_id for r in out["q1"]} == {"A"}
+    assert {o.device_id for o in out["q2"]} <= {"A", "B"}
     assert out["q3"]
-    assert {o.device_id for o in out["q5"]} == {"trainF"}
+    assert {o.device_id for o in out["q5"]} == {"E"}
 
 
 def _mk_events(n=50, lon=4.3658, lat=50.6456, dev="d0", t0=0, dt=100):
