@@ -100,33 +100,86 @@ def bench_range_window(jax, jnp, grid, quick):
 
 
 def bench_knn_k(jax, jnp, grid, k, quick):
-    """Config 2: continuous kNN, k ∈ {10, 50, 500}, 5s sliding windows."""
-    from spatialflink_tpu.ops.knn import knn_points_fused
+    """Config 2: continuous kNN, k ∈ {10, 50, 500}, 5s/1s sliding windows.
 
-    n_win = 4 if quick else 10
-    win_pts = 500_000
-    xy, oid, ts = _stream(win_pts * n_win)
-    q = jnp.asarray(np.array([116.40, 40.19], np.float32))
+    Measures the pane-digest-carry sliding path (ops/knn.py:
+    knn_pane_digest + knn_merge_digests, the operator's query_panes/
+    run_soa_panes): each 1s pane (200k points at the 200k EPS event rate)
+    is digested ONCE, each window fire min-merges the 5 live digests and
+    top-ks. Ingest is streamed: every point crosses host→device exactly
+    once (int16 oid wire format), double-buffered so the next pane's
+    transfer overlaps this window's compute — the same dispatch model as
+    bench.py's headline loop. Rate = distinct ingested points / wall time.
+    """
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.knn import knn_merge_digests, knn_pane_digest
+
+    ppw = 5
+    pane_pts = 100_000 if quick else 200_000
+    n_panes = 8 if quick else 25
+    nseg = 16_384
+    total = pane_pts * n_panes
+    xy, oid, ts = _stream(total)
+    oid16 = oid.astype(np.int16)
+    dev = jax.devices()[0]
+    q = jax.device_put(jnp.asarray(np.array([116.40, 40.19], np.float32)), dev)
     flags = grid.neighbor_flags(0.05, [grid.flat_cell(116.40, 40.19)])
-    flags_d = jnp.asarray(flags)
-    fn = jax.jit(knn_points_fused, static_argnames=("k", "num_segments"))
+    flags_d = jax.device_put(jnp.asarray(flags), dev)
+    valid_d = jax.device_put(jnp.asarray(np.ones(pane_pts, bool)), dev)
 
-    def one(i):
-        sl = slice(i * win_pts, (i + 1) * win_pts)
-        cell = grid.assign_cells_np(xy[sl])
-        res = fn(
-            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
-            jnp.asarray(cell), flags_d, jnp.asarray(oid[sl]),
-            q, np.float32(0.05), k=k, num_segments=16_384,
+    def pane_step(xy_p, oid16_p, valid, flags_table, query_xy):
+        cell = assign_cells(
+            xy_p, grid.min_x, grid.min_y, grid.cell_length, grid.n
         )
-        return int(res.num_valid)
+        return knn_pane_digest(
+            xy_p, valid, cell, flags_table, oid16_p.astype(jnp.int32),
+            query_xy, np.float32(0.05), jnp.int32(0), num_segments=nseg,
+        )
 
-    one(0)
+    jpane = jax.jit(pane_step)
+    jmerge = jax.jit(knn_merge_digests, static_argnames="k")
+
+    def pane_arrays(i):
+        lo, hi = i * pane_pts, (i + 1) * pane_pts
+        return (
+            jax.device_put(xy[lo:hi], dev),
+            jax.device_put(oid16[lo:hi], dev),
+        )
+
+    # Warm-up: compile both programs. NB: on the axon tunnel,
+    # block_until_ready returns without waiting — a real device→host fetch
+    # is the only true synchronization point (device_get below, ditto in
+    # the timed loop).
+    xa, oa = pane_arrays(0)
+    d0 = jpane(xa, oa, valid_d, flags_d, q)
+    warm = jmerge(
+        jnp.stack([d0.seg_min] * ppw), jnp.stack([d0.rep] * ppw), k=k
+    )
+    jax.device_get(warm)
+
+    digests = [(d0.seg_min, d0.rep)]
+    fired = []  # per-window result handles; egress pipelines like ingest
+    # Timed region covers panes 1..n_panes-1 end to end, including their
+    # host→device transfers (warm-up pane 0 is excluded from the numerator).
     t0 = time.perf_counter()
-    nv = [one(i) for i in range(n_win)]
+    staged = [pane_arrays(1), pane_arrays(2)]
+    for p in range(1, n_panes):
+        if p + 2 < n_panes:
+            staged.append(pane_arrays(p + 2))  # overlaps this pane's compute
+        xa, oa = staged.pop(0)
+        d = jpane(xa, oa, valid_d, flags_d, q)
+        digests.append((d.seg_min, d.rep))
+        digests = digests[-ppw:]
+        if len(digests) == ppw:  # window [p-4, p] complete → fire
+            fired.append(jmerge(
+                jnp.stack([s for s, _ in digests]),
+                jnp.stack([r for _, r in digests]), k=k,
+            ))
+    out = jax.device_get(fired)  # all window results on host (true sync)
     dt = time.perf_counter() - t0
-    return _result(f"continuous_knn_k{k}_5s_sliding", n_win * win_pts, dt,
-                   {"num_valid_last": nv[-1]})
+    return _result(f"continuous_knn_k{k}_5s_sliding",
+                   pane_pts * (n_panes - 1), dt,
+                   {"num_valid_last": int(out[-1].num_valid)})
 
 
 def bench_polygon_range(jax, jnp, grid, quick):

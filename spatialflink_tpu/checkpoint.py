@@ -78,6 +78,20 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
     restore_interner(op.interner, state["interner"])
     if "agg_state" in state and hasattr(op, "_skeys"):
         agg = state["agg_state"]
+        if "keys" not in agg:
+            # Round-1 checkpoint format: {(cell, oid_str): (min, max)}.
+            # Convert to the sorted cell<<32|interned-oid key arrays (the
+            # interner is already restored above, so interning an oid seen
+            # at snapshot time returns its original dense id).
+            rows = sorted(
+                ((int(c) << 32) | op.interner.intern(o), int(mn), int(mx))
+                for (c, o), (mn, mx) in agg.items()
+            )
+            agg = {
+                "keys": [r[0] for r in rows],
+                "min": [r[1] for r in rows],
+                "max": [r[2] for r in rows],
+            }
         op._skeys = np.asarray(agg["keys"], np.int64)
         op._smin = np.asarray(agg["min"], np.int64)
         op._smax = np.asarray(agg["max"], np.int64)

@@ -130,6 +130,140 @@ class _PointStreamKNNQuery(SpatialOperator):
         ]
         return KnnWindowResult(win.start, win.end, neighbors, len(win.events))
 
+    def query_panes(
+        self,
+        stream: Iterable[Point],
+        query_obj: SpatialObject,
+        radius: float,
+        k: int,
+        dtype=np.float64,
+    ) -> Iterator[KnnWindowResult]:
+        """Incremental sliding-window kNN via pane-digest carry.
+
+        The kNN analog of the reference's ListState carry-over
+        (range/PointPointRangeQuery.java:195-296): each ``slide``-wide pane
+        is digested ONCE into per-object (min-dist, representative) arrays
+        (ops/knn.py:knn_pane_digest); every window's result is a device-side
+        min-merge + top-k over its ``size/slide`` carried digests. Per-slide
+        device work drops from O(window) to O(pane) + O(panes × segments).
+
+        Bit-identical to ``run()`` for in-order streams (parity test);
+        the same caveats as ``query_incremental`` apply: events out of
+        order by more than one slide pane would miss their pane's digest,
+        and allowed-lateness refires would double-count — so a non-zero
+        ``allowed_lateness`` is rejected and in-order delivery is assumed.
+        """
+        from spatialflink_tpu.operators.query_config import QueryType
+        from spatialflink_tpu.ops.knn import (
+            knn_merge_digests,
+            knn_pane_digest,
+            knn_pane_digest_geometry,
+        )
+
+        conf = self.conf
+        if conf.query_type == QueryType.CountBased:
+            raise ValueError("query_panes requires time-based sliding windows")
+        if conf.allowed_lateness_ms > 0:
+            raise ValueError(
+                "query_panes does not support allowed_lateness (late-window "
+                "refires would double-count carried panes); use run()"
+            )
+        size, slide = conf.window_size_ms, conf.slide_step_ms
+        if conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive):
+            size = slide = conf.realtime_batch_ms
+        if size % slide != 0:
+            raise ValueError("query_panes requires size % slide == 0")
+
+        flags_d = jnp.asarray(flags_for_queries(self.grid, radius, [query_obj]))
+        if self.query_kind == "point":
+            q = self.device_q([query_obj.x, query_obj.y], dtype)
+            digest_fn = jitted(knn_pane_digest, "num_segments")
+        else:
+            verts, ev = pack_query_geometries([query_obj], np.float64)
+            qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
+            digest_fn = functools.partial(
+                jitted(knn_pane_digest_geometry, "num_segments", "query_polygonal"),
+                query_polygonal=self.query_kind == "polygon",
+            )
+        merge = jitted(knn_merge_digests, "k")
+
+        # pane start → (nseg, seg_min_dev, rep_dev, base, events) | None(empty)
+        panes: dict = {}
+        next_base = 0
+        int_big = np.iinfo(np.int32).max
+
+        def empty_digest(nseg):
+            fbig = np.finfo(np.float64 if jax.config.jax_enable_x64
+                            and np.dtype(dtype) == np.float64
+                            else np.float32).max
+            return (jnp.full((nseg,), fbig), jnp.full((nseg,), int_big, jnp.int32))
+
+        def padded(entry, nseg):
+            e_nseg, sm, rp = entry[0], entry[1], entry[2]
+            if e_nseg == nseg:
+                return sm, rp
+            pad = nseg - e_nseg
+            fbig = jnp.asarray(jnp.finfo(sm.dtype).max, sm.dtype)
+            return (
+                jnp.concatenate([sm, jnp.full((pad,), fbig, sm.dtype)]),
+                jnp.concatenate([rp, jnp.full((pad,), int_big, jnp.int32)]),
+            )
+
+        for win in self.windows(stream):
+            starts = range(win.start, win.end, slide)
+            for ps in starts:
+                if ps in panes:
+                    continue
+                evs = [e for e in win.events if ps <= e.timestamp < ps + slide]
+                if not evs:
+                    panes[ps] = None
+                    continue
+                batch = self.point_batch(evs)
+                nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+                args = (
+                    self.device_xy(batch, dtype),
+                    jnp.asarray(batch.valid),
+                    jnp.asarray(batch.cell),
+                    flags_d,
+                    jnp.asarray(batch.oid),
+                )
+                base32 = np.int32(next_base)  # keep rep arrays int32 under x64
+                if self.query_kind == "point":
+                    d = digest_fn(*args, q, radius, base32, num_segments=nseg)
+                else:
+                    d = digest_fn(*args, qv, qe, radius, base32,
+                                  num_segments=nseg)
+                panes[ps] = (nseg, d.seg_min, d.rep, next_base, evs)
+                next_base += len(evs)
+            for ps in [p for p in panes if p < win.start]:
+                del panes[ps]
+
+            nseg = max((p[0] for p in panes.values() if p is not None),
+                       default=64)
+            live = [panes[ps] for ps in starts]
+            emt = empty_digest(nseg)
+            sms, rps = zip(*[
+                emt if p is None else padded(p, nseg) for p in live
+            ])
+            res = merge(jnp.stack(sms), jnp.stack(rps), k=k)
+
+            bases = [(p[3], p[4]) for p in live if p is not None]
+            nv = int(res.num_valid)
+            segs = np.asarray(res.segment[:nv])  # bulk fetches, no per-
+            dists = np.asarray(res.dist[:nv])  # element tunnel round trips
+            idxs = np.asarray(res.index[:nv])
+            neighbors = []
+            for s, d, gi in zip(segs, dists, idxs):
+                ev = None
+                for base, evs in bases:
+                    if base <= gi < base + len(evs):
+                        ev = evs[gi - base]
+                        break
+                neighbors.append(
+                    (self.interner.lookup(int(s)), float(d), ev)
+                )
+            yield KnnWindowResult(win.start, win.end, neighbors, len(win.events))
+
 
 class PointPointKNNQuery(_PointStreamKNNQuery):
     """knn/PointPointKNNQuery.java:132-201 (+ KNNQuery.java merge)."""
@@ -167,6 +301,90 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 flags_d, jnp.asarray(oid),
                 q, radius, k=k, num_segments=num_segments,
             )
+            nv = int(res.num_valid)
+            yield (
+                win.start, win.end,
+                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
+            )
+
+
+    def run_soa_panes(
+        self,
+        chunks,
+        query_point: Point,
+        radius: float,
+        k: int,
+        num_segments: int,
+        dtype=np.float64,
+    ):
+        """SoA pane-digest carry: ``run_soa``'s contract (yields
+        (start, end, oids, dists, num_valid) per window) at O(pane) device
+        work per slide instead of O(window). Same in-order/no-lateness
+        caveats as ``query_panes``."""
+        from spatialflink_tpu.operators.base import center_coords
+        from spatialflink_tpu.ops.knn import knn_merge_digests, knn_pane_digest
+        from spatialflink_tpu.streams.soa import SoaWindowAssembler
+        from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
+
+        conf = self.conf
+        if conf.allowed_lateness_ms > 0:
+            raise ValueError(
+                "run_soa_panes does not support allowed_lateness; use run_soa"
+            )
+        size, slide = conf.window_size_ms, conf.slide_step_ms
+        if size % slide != 0:
+            raise ValueError("run_soa_panes requires size % slide == 0")
+
+        flags_d = jnp.asarray(flags_for_queries(self.grid, radius, [query_point]))
+        q = self.device_q([query_point.x, query_point.y], dtype)
+        digest = jitted(knn_pane_digest, "num_segments")
+        merge = jitted(knn_merge_digests, "k")
+
+        panes: dict = {}  # pane start → (seg_min, rep) | None (empty pane)
+        emt = None
+        asm = SoaWindowAssembler(size, slide, ooo_ms=0)
+        for win in asm.stream(chunks):
+            ts = np.asarray(win.arrays["ts"], np.int64)
+            for ps in range(win.start, win.end, slide):
+                if ps in panes:
+                    continue
+                lo = int(np.searchsorted(ts, ps, side="left"))
+                hi = int(np.searchsorted(ts, ps + slide, side="left"))
+                if hi <= lo:
+                    panes[ps] = None
+                    continue
+                xy64 = np.stack(
+                    [np.asarray(win.arrays["x"][lo:hi], np.float64),
+                     np.asarray(win.arrays["y"][lo:hi], np.float64)],
+                    axis=1,
+                )
+                n = hi - lo
+                b = next_bucket(n)
+                cell = self.grid.assign_cells_np(xy64)
+                d = digest(
+                    jnp.asarray(pad_to_bucket(
+                        center_coords(self.grid, xy64, dtype), b)),
+                    jnp.asarray(pad_to_bucket(np.ones(n, bool), b, fill=False)),
+                    jnp.asarray(pad_to_bucket(cell, b, fill=self.grid.num_cells)),
+                    flags_d,
+                    jnp.asarray(pad_to_bucket(
+                        np.asarray(win.arrays["oid"][lo:hi], np.int32), b,
+                        fill=0)),
+                    q, radius, np.int32(0), num_segments=num_segments,
+                )
+                panes[ps] = (d.seg_min, d.rep)
+            for ps in [p for p in panes if p < win.start]:
+                del panes[ps]
+
+            live = [panes[ps] for ps in range(win.start, win.end, slide)]
+            if emt is None:
+                ref = next(p for p in live if p is not None)
+                emt = (
+                    jnp.full_like(ref[0], jnp.finfo(ref[0].dtype).max),
+                    jnp.full_like(ref[1], jnp.iinfo(jnp.int32).max),
+                )
+            sms, rps = zip(*[emt if p is None else p for p in live])
+            res = merge(jnp.stack(sms), jnp.stack(rps), k=k)
             nv = int(res.num_valid)
             yield (
                 win.start, win.end,

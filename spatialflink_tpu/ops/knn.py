@@ -33,14 +33,26 @@ class KnnResult(NamedTuple):
     num_valid: jnp.ndarray  # () number of distinct objects within radius
 
 
-def _topk_from_point_dists(
-    dist, valid, flags, oid, radius, k, num_segments,
+class KnnPaneDigest(NamedTuple):
+    """Per-object minima for one slide pane — the carryable unit of the
+    incremental sliding-window kNN (the ListState-carry idea of
+    range/PointPointRangeQuery.java:195-296 applied to the kNN merge)."""
+
+    seg_min: jnp.ndarray  # (num_segments,) min dist per object; +big absent
+    rep: jnp.ndarray  # (num_segments,) lowest global index at the min; int32-max absent
+
+
+def _digest_from_point_dists(
+    dist, valid, flags, oid, radius, num_segments,
     axis_name=None, index_base=None,
-):
-    """Shared top-k core. With ``axis_name`` set (inside shard_map), the
-    per-object minima and representative indices are pmin-reduced across the
-    named mesh axis, and ``index_base`` offsets local indices to global ones
-    — the single- and multi-chip paths share one tie-break contract.
+) -> KnnPaneDigest:
+    """Masked distances → per-object (min distance, representative index).
+
+    The representative is the lowest index achieving the object's min
+    distance (deterministic tie-break; the reference's PQ keeps the
+    first-seen of equal distances, KNNQuery.java:221-268). ``index_base``
+    offsets batch-local indices to stream/global ones so digests from
+    different panes (or shards) share one tie-break contract.
     """
     big = jnp.asarray(jnp.finfo(dist.dtype).max, dist.dtype)
     mask = valid & (flags > 0) & (dist <= radius)
@@ -52,9 +64,6 @@ def _topk_from_point_dists(
     if axis_name is not None:
         seg_min = jax.lax.pmin(seg_min, axis_name=axis_name)
 
-    # Representative point per winning object: lowest batch index achieving
-    # the object's min distance (deterministic tie-break; the reference's PQ
-    # keeps the first-seen of equal distances, KNNQuery.java:221-268).
     n = dist.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     if index_base is not None:
@@ -66,7 +75,11 @@ def _topk_from_point_dists(
     )
     if axis_name is not None:
         rep = jax.lax.pmin(rep, axis_name=axis_name)
+    return KnnPaneDigest(seg_min, rep)
 
+
+def _finish_topk(seg_min, rep, k) -> KnnResult:
+    big = jnp.asarray(jnp.finfo(seg_min.dtype).max, seg_min.dtype)
     neg_top, seg_ids = jax.lax.top_k(-seg_min, k)  # smallest distances
     top_dist = -neg_top
     found = top_dist < big
@@ -74,6 +87,76 @@ def _topk_from_point_dists(
     idx_out = jnp.where(found, rep[seg_ids], -1)
     num_valid = jnp.sum((seg_min < big).astype(jnp.int32))
     return KnnResult(top_dist, seg_out, idx_out, jnp.minimum(num_valid, k))
+
+
+def _topk_from_point_dists(
+    dist, valid, flags, oid, radius, k, num_segments,
+    axis_name=None, index_base=None,
+):
+    """Shared top-k core. With ``axis_name`` set (inside shard_map), the
+    per-object minima and representative indices are pmin-reduced across the
+    named mesh axis, and ``index_base`` offsets local indices to global ones
+    — the single- and multi-chip paths share one tie-break contract.
+    """
+    d = _digest_from_point_dists(
+        dist, valid, flags, oid, radius, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )
+    return _finish_topk(d.seg_min, d.rep, k)
+
+
+def knn_pane_digest(
+    xy, valid, cell, flags_table, oid, query_xy, radius, index_base,
+    num_segments: int,
+) -> KnnPaneDigest:
+    """One slide pane → carryable per-object minima (point query).
+
+    Fused cell-flag gather + distance + segment-min. A sliding window's
+    result is ``knn_merge_digests`` over its ``size/slide`` pane digests —
+    per-slide device work shrinks from O(window) to O(pane) + an
+    O(panes × num_segments) merge.
+    """
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    dist = point_point_distance(xy, query_xy[None, :])
+    return _digest_from_point_dists(
+        dist, valid, gather_cell_flags(cell, flags_table), oid, radius,
+        num_segments, index_base=index_base,
+    )
+
+
+def knn_pane_digest_geometry(
+    xy, valid, cell, flags_table, oid, query_verts, query_edge_valid,
+    radius, index_base, num_segments: int, query_polygonal: bool,
+) -> KnnPaneDigest:
+    """Pane digest for a polygon (containment → 0) or open-polyline query."""
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    edge_d = point_polyline_distance(xy, query_verts, query_edge_valid)
+    if query_polygonal:
+        inside = points_in_polygon(xy, query_verts, query_edge_valid)
+        dist = jnp.where(inside, jnp.zeros((), edge_d.dtype), edge_d)
+    else:
+        dist = edge_d
+    return _digest_from_point_dists(
+        dist, valid, gather_cell_flags(cell, flags_table), oid, radius,
+        num_segments, index_base=index_base,
+    )
+
+
+def knn_merge_digests(seg_min_stack, rep_stack, k: int) -> KnnResult:
+    """(P, num_segments) stacked pane digests → window top-k.
+
+    Per-object window minimum = min over panes; the representative is the
+    lowest global index among panes achieving that minimum — identical
+    tie-breaking to the fused single-program kernel over the whole window
+    (parity-tested), and to the reference's PQ merge (KNNQuery.java:204-308).
+    """
+    gmin = jnp.min(seg_min_stack, axis=0)
+    int_big = jnp.iinfo(jnp.int32).max
+    qual = seg_min_stack <= gmin[None, :]
+    rep = jnp.min(jnp.where(qual, rep_stack, int_big), axis=0)
+    return _finish_topk(gmin, rep, k)
 
 
 def knn_kernel(

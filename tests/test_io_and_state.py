@@ -208,3 +208,31 @@ def test_shapefile_hole_winding_roundtrip(tmp_path):
     inside = np.asarray(points_in_polygon(
         jnp.asarray([[1.5, 1.5], [3.0, 3.0]]), jnp.asarray(verts), jnp.asarray(ev)))
     assert not inside[0] and inside[1]
+
+
+def test_checkpoint_restores_round1_agg_format(tmp_path):
+    """A round-1 checkpoint stored TAggregate MapState as a plain
+    {(cell, oid_str): (min, max)} dict; restore must convert it to the
+    sorted key-array form."""
+    from spatialflink_tpu.operators import QueryConfiguration, QueryType, TAggregateQuery
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    op = TAggregateQuery(conf, GRID, aggregate="ALL")
+    pts = [Point(obj_id=f"tr{i%2}", timestamp=i * 1000, x=1.0 + i * 0.1, y=1.0)
+           for i in range(20)]
+    list(op.run(iter(pts)))
+
+    # Re-encode the modern state in the legacy dict format.
+    legacy = dict(operator_state(op))
+    legacy["agg_state"] = {
+        (int(k) >> 32, op.interner.lookup(int(k) & 0xFFFFFFFF)): (int(mn), int(mx))
+        for k, mn, mx in zip(op._skeys, op._smin, op._smax)
+    }
+    path = str(tmp_path / "agg_legacy.pkl")
+    save_checkpoint(path, op=legacy)
+
+    op2 = TAggregateQuery(conf, GRID, aggregate="ALL")
+    restore_operator(op2, load_checkpoint(path)["op"])
+    np.testing.assert_array_equal(op2._skeys, op._skeys)
+    np.testing.assert_array_equal(op2._smin, op._smin)
+    np.testing.assert_array_equal(op2._smax, op._smax)

@@ -328,3 +328,76 @@ def test_f32_centering_preserves_radius_boundary():
         if np.hypot(a.x - b.x, a.y - b.y) <= r
     }
     assert got == expect
+
+
+def _knn_result_key(results):
+    return {
+        (res.start, res.end): [
+            (oid, round(d, 12), id(ev)) for oid, d, ev in res.neighbors
+        ]
+        for res in results
+    }
+
+
+def test_pane_knn_matches_windowed(rng):
+    """query_panes (pane-digest carry) must equal full recomputation per
+    window: same spans, same ordered (objID, dist) lists, same
+    representative event objects (tie-break contract)."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=500)
+    q = Point(x=5.0, y=5.0)
+    r, k = 4.0, 7
+    full = _knn_result_key(PointPointKNNQuery(conf, GRID).run(iter(pts), q, r, k))
+    pane = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).query_panes(iter(pts), q, r, k)
+    )
+    assert full == pane
+
+
+def test_pane_knn_with_empty_panes(rng):
+    """A time gap in the stream leaves whole panes empty; merged windows
+    must still match full recomputation."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=20, slide_step=5)
+    early = synth_points(rng, n=60, t_span=9_000)
+    late = [
+        Point(obj_id=f"late{i % 5}", timestamp=31_000 + i * 150,
+              x=float(rng.uniform(0, 10)), y=float(rng.uniform(0, 10)))
+        for i in range(40)
+    ]
+    pts = early + late
+    q = Point(x=5.0, y=5.0)
+    r, k = 5.0, 4
+    full = _knn_result_key(PointPointKNNQuery(conf, GRID).run(iter(pts), q, r, k))
+    pane = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).query_panes(iter(pts), q, r, k)
+    )
+    assert full == pane
+
+
+def test_pane_knn_polygon_query(rng):
+    """Pane carry through the polygon-query digest (containment → 0)."""
+    from spatialflink_tpu.operators import PointPolygonKNNQuery
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=300)
+    poly = Polygon(
+        obj_id="qp",
+        rings=[np.array([[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]], float)],
+    )
+    r, k = 4.0, 6
+    full = _knn_result_key(
+        PointPolygonKNNQuery(conf, GRID).run(iter(pts), poly, r, k)
+    )
+    pane = _knn_result_key(
+        PointPolygonKNNQuery(conf, GRID).query_panes(iter(pts), poly, r, k)
+    )
+    assert full == pane
+
+
+def test_pane_knn_rejects_lateness(rng):
+    conf = QueryConfiguration(
+        QueryType.WindowBased, window_size=10, slide_step=5, allowed_lateness=3
+    )
+    q = Point(x=5.0, y=5.0)
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        list(PointPointKNNQuery(conf, GRID).query_panes(iter([]), q, 1.0, 3))

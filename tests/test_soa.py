@@ -173,3 +173,28 @@ def test_soa_assembler_ooo_before_first_event():
     assert spans[(0, 10_000)] == 1
     assert spans[(5_000, 15_000)] == 2  # 9_500 + 10_000
     assert asm.dropped_late == 0
+
+
+def test_soa_knn_panes_matches_run_soa(rng):
+    """run_soa_panes (pane-digest carry) must yield identical per-window
+    (oids, dists) to run_soa full recomputation on sliding windows."""
+    n = 3000
+    ts = np.sort(rng.integers(0, 40_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 9, n).astype(np.int32)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=2)
+    q = Point(x=5.0, y=5.0)
+    r, k = 4.0, 6
+
+    def collect(gen):
+        return {
+            (s, e): ([int(o) for o in oo], [round(float(d), 12) for d in dd])
+            for s, e, oo, dd, nv in gen
+        }
+
+    full = collect(PointPointKNNQuery(conf, GRID).run_soa(
+        _chunks(ts, xs, ys, oids), q, r, k, num_segments=64))
+    pane = collect(PointPointKNNQuery(conf, GRID).run_soa_panes(
+        _chunks(ts, xs, ys, oids), q, r, k, num_segments=64))
+    assert full == pane
