@@ -112,7 +112,7 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     bench.py's headline loop. Rate = distinct ingested points / wall time.
     """
     from spatialflink_tpu.ops.cells import assign_cells
-    from spatialflink_tpu.ops.knn import knn_merge_digests, knn_pane_digest
+    from spatialflink_tpu.ops.knn import knn_merge_digest_list, knn_pane_digest
 
     ppw = 5
     pane_pts = 100_000 if quick else 200_000
@@ -137,7 +137,8 @@ def bench_knn_k(jax, jnp, grid, k, quick):
         )
 
     jpane = jax.jit(pane_step)
-    jmerge = jax.jit(knn_merge_digests, static_argnames="k")
+    jmerge = jax.jit(knn_merge_digest_list, static_argnames="k")
+    no_bases = np.zeros(ppw, np.int32)  # rep indices unread by this bench
 
     def pane_arrays(i):
         lo, hi = i * pane_pts, (i + 1) * pane_pts
@@ -153,7 +154,7 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     xa, oa = pane_arrays(0)
     d0 = jpane(xa, oa, valid_d, flags_d, q)
     warm = jmerge(
-        jnp.stack([d0.seg_min] * ppw), jnp.stack([d0.rep] * ppw), k=k
+        (d0.seg_min,) * ppw, (d0.rep,) * ppw, no_bases, k=k
     )
     jax.device_get(warm)
 
@@ -172,8 +173,8 @@ def bench_knn_k(jax, jnp, grid, k, quick):
         digests = digests[-ppw:]
         if len(digests) == ppw:  # window [p-4, p] complete → fire
             fired.append(jmerge(
-                jnp.stack([s for s, _ in digests]),
-                jnp.stack([r for _, r in digests]), k=k,
+                tuple(s for s, _ in digests),
+                tuple(r for _, r in digests), no_bases, k=k,
             ))
     out = jax.device_get(fired)  # all window results on host (true sync)
     dt = time.perf_counter() - t0

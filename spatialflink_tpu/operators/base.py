@@ -209,15 +209,34 @@ def center_coords(grid: UniformGrid, xy: np.ndarray, dtype) -> np.ndarray:
     return (np.asarray(xy, np.float64) - np.array([cx, cy])).astype(out_dtype)
 
 
+def device_point_args(grid: UniformGrid, xy64: np.ndarray, oid, dtype):
+    """One SoA point-slice → device-ready padded (xy, valid, cell, oid).
+
+    The shared batch contract of every SoA fast path: bucket padding,
+    origin-centering before sub-f64 casts, invalid lanes carrying
+    cell=grid.num_cells (the out-of-grid slot whose flag is always 0) —
+    identical to PointBatch.from_arrays(...).with_cells(grid).
+    """
+    from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
+
+    n = len(xy64)
+    b = next_bucket(n)
+    cell = grid.assign_cells_np(xy64)
+    return (
+        pad_to_bucket(center_coords(grid, xy64, dtype), b),
+        pad_to_bucket(np.ones(n, bool), b, fill=False),
+        pad_to_bucket(cell, b, fill=grid.num_cells),
+        None if oid is None else pad_to_bucket(np.asarray(oid, np.int32), b, fill=0),
+    )
+
+
 def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
                       dtype=np.float64):
     """SoA windows → (window, padded arrays) for the run_soa fast paths.
 
-    Yields (win, xy, valid, cell, oid) with bucket padding and invalid-lane
-    cell masking identical to PointBatch.from_arrays(...).with_cells(grid).
+    Yields (win, xy, valid, cell, oid) per the device_point_args contract.
     """
     from spatialflink_tpu.streams.soa import SoaWindowAssembler
-    from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
 
     from spatialflink_tpu.ops.counters import counters
 
@@ -235,17 +254,7 @@ def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
              np.asarray(win.arrays["y"], np.float64)],
             axis=1,
         )
-        n = len(xy64)
-        b = next_bucket(n)
-        cell = grid.assign_cells_np(xy64)
-        oid = win.arrays.get("oid")
-        yield (
-            win,
-            pad_to_bucket(center_coords(grid, xy64, dtype), b),
-            pad_to_bucket(np.ones(n, bool), b, fill=False),
-            pad_to_bucket(cell, b, fill=grid.num_cells),
-            None if oid is None else pad_to_bucket(np.asarray(oid, np.int32), b, fill=0),
-        )
+        yield (win, *device_point_args(grid, xy64, win.arrays.get("oid"), dtype))
 
 
 @functools.lru_cache(maxsize=None)

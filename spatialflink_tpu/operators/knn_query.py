@@ -44,6 +44,16 @@ class KnnWindowResult:
     window_count: int
 
 
+@dataclass
+class MultiKnnWindowResult:
+    """One window's top-k for every query point of a batched query set."""
+
+    start: int
+    end: int
+    results: List[KnnWindowResult]  # index-aligned with the query batch
+    window_count: int
+
+
 class _PointStreamKNNQuery(SpatialOperator):
     """Point stream; query = point / polygon / linestring."""
 
@@ -155,7 +165,7 @@ class _PointStreamKNNQuery(SpatialOperator):
         """
         from spatialflink_tpu.operators.query_config import QueryType
         from spatialflink_tpu.ops.knn import (
-            knn_merge_digests,
+            knn_merge_digest_list,
             knn_pane_digest,
             knn_pane_digest_geometry,
         )
@@ -185,28 +195,39 @@ class _PointStreamKNNQuery(SpatialOperator):
                 jitted(knn_pane_digest_geometry, "num_segments", "query_polygonal"),
                 query_polygonal=self.query_kind == "polygon",
             )
-        merge = jitted(knn_merge_digests, "k")
-
-        # pane start → (nseg, seg_min_dev, rep_dev, base, events) | None(empty)
-        panes: dict = {}
-        next_base = 0
+        merge = jitted(knn_merge_digest_list, "k")
         int_big = np.iinfo(np.int32).max
+        zero = np.int32(0)
+
+        # pane start → (nseg, seg_min_dev, rep_dev, events) | None (empty).
+        # Digests hold pane-LOCAL representative indices; window-local base
+        # offsets are applied inside the jitted merge, so carried indices
+        # never grow with the stream (unbounded-stream-safe).
+        panes: dict = {}
+        empties: dict = {}  # nseg → cached empty digest (one-time device op)
 
         def empty_digest(nseg):
-            fbig = np.finfo(np.float64 if jax.config.jax_enable_x64
-                            and np.dtype(dtype) == np.float64
-                            else np.float32).max
-            return (jnp.full((nseg,), fbig), jnp.full((nseg,), int_big, jnp.int32))
+            if nseg not in empties:
+                fbig = np.finfo(np.float64 if jax.config.jax_enable_x64
+                                and np.dtype(dtype) == np.float64
+                                else np.float32).max
+                empties[nseg] = (
+                    jnp.full((nseg,), fbig),
+                    jnp.full((nseg,), int_big, jnp.int32),
+                )
+            return empties[nseg]
 
-        def padded(entry, nseg):
-            e_nseg, sm, rp = entry[0], entry[1], entry[2]
-            if e_nseg == nseg:
-                return sm, rp
+        def grow(entry, nseg):
+            # One-time re-pad when the interned-id bucket grows (log2 many
+            # times total — not a per-window device op).
+            e_nseg, sm, rp, evs = entry
             pad = nseg - e_nseg
             fbig = jnp.asarray(jnp.finfo(sm.dtype).max, sm.dtype)
             return (
+                nseg,
                 jnp.concatenate([sm, jnp.full((pad,), fbig, sm.dtype)]),
                 jnp.concatenate([rp, jnp.full((pad,), int_big, jnp.int32)]),
+                evs,
             )
 
         for win in self.windows(stream):
@@ -227,27 +248,30 @@ class _PointStreamKNNQuery(SpatialOperator):
                     flags_d,
                     jnp.asarray(batch.oid),
                 )
-                base32 = np.int32(next_base)  # keep rep arrays int32 under x64
                 if self.query_kind == "point":
-                    d = digest_fn(*args, q, radius, base32, num_segments=nseg)
+                    d = digest_fn(*args, q, radius, zero, num_segments=nseg)
                 else:
-                    d = digest_fn(*args, qv, qe, radius, base32,
+                    d = digest_fn(*args, qv, qe, radius, zero,
                                   num_segments=nseg)
-                panes[ps] = (nseg, d.seg_min, d.rep, next_base, evs)
-                next_base += len(evs)
+                panes[ps] = (nseg, d.seg_min, d.rep, evs)
             for ps in [p for p in panes if p < win.start]:
                 del panes[ps]
 
-            nseg = max((p[0] for p in panes.values() if p is not None),
-                       default=64)
+            nseg = max(p[0] for p in panes.values() if p is not None)
+            for ps in starts:
+                if panes[ps] is not None and panes[ps][0] < nseg:
+                    panes[ps] = grow(panes[ps], nseg)
             live = [panes[ps] for ps in starts]
             emt = empty_digest(nseg)
-            sms, rps = zip(*[
-                emt if p is None else padded(p, nseg) for p in live
-            ])
-            res = merge(jnp.stack(sms), jnp.stack(rps), k=k)
+            sms = tuple(emt[0] if p is None else p[1] for p in live)
+            rps = tuple(emt[1] if p is None else p[2] for p in live)
+            bases, acc = [], 0
+            for p in live:
+                bases.append(acc)
+                acc += 0 if p is None else len(p[3])
+            res = merge(sms, rps, np.asarray(bases, np.int32), k=k)
 
-            bases = [(p[3], p[4]) for p in live if p is not None]
+            spans = [(b, p[3]) for b, p in zip(bases, live) if p is not None]
             nv = int(res.num_valid)
             segs = np.asarray(res.segment[:nv])  # bulk fetches, no per-
             dists = np.asarray(res.dist[:nv])  # element tunnel round trips
@@ -255,7 +279,7 @@ class _PointStreamKNNQuery(SpatialOperator):
             neighbors = []
             for s, d, gi in zip(segs, dists, idxs):
                 ev = None
-                for base, evs in bases:
+                for base, evs in spans:
                     if base <= gi < base + len(evs):
                         ev = evs[gi - base]
                         break
@@ -308,6 +332,75 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             )
 
 
+    def run_multi(
+        self,
+        stream: Iterable[Point],
+        query_points: Sequence[Point],
+        radius: float,
+        k: int,
+        dtype=np.float64,
+    ) -> Iterator[MultiKnnWindowResult]:
+        """Batched multi-query kNN: ONE fused program per window answers
+        the whole query-point set (ops/knn.py:knn_multi_query_kernel),
+        instead of one program per query point — the kNN analog of the
+        range family's query-set batching. Each query prunes by its own
+        neighbor-cell flag table, so per-query results are identical to
+        ``run()`` with that single query (parity test)."""
+        from spatialflink_tpu.ops.knn import knn_multi_query_kernel
+
+        nq = len(query_points)
+        if nq == 0:
+            return
+        tables = np.stack(
+            [flags_for_queries(self.grid, radius, [q]) for q in query_points]
+        )
+        qb = next_bucket(nq, minimum=8)
+        block = min(qb, 32)
+        if qb > nq:  # padded query lanes: zero flag tables → empty results
+            tables = np.concatenate(
+                [tables, np.zeros((qb - nq,) + tables.shape[1:], tables.dtype)]
+            )
+        qxy = np.zeros((qb, 2), np.float64)
+        qxy[:nq] = [[q.x, q.y] for q in query_points]
+        tables_d = jnp.asarray(tables)
+        q_d = self.device_q(qxy, dtype)
+        kernel = jitted(
+            knn_multi_query_kernel, "k", "num_segments", "query_block"
+        )
+
+        for win in self.windows(stream):
+            batch = self.point_batch(win.events)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            res = kernel(
+                self.device_xy(batch, dtype),
+                jnp.asarray(batch.valid),
+                jnp.asarray(batch.cell),
+                tables_d,
+                jnp.asarray(batch.oid),
+                q_d,
+                radius,
+                k=k, num_segments=nseg, query_block=block,
+            )
+            segs = np.asarray(res.segment)  # (Q, k) bulk fetches
+            dists = np.asarray(res.dist)
+            idxs = np.asarray(res.index)
+            nvs = np.asarray(res.num_valid)
+            per_query = []
+            for qi in range(nq):
+                nv = int(nvs[qi])
+                neighbors = [
+                    (self.interner.lookup(int(segs[qi, i])),
+                     float(dists[qi, i]), win.events[int(idxs[qi, i])])
+                    for i in range(nv)
+                ]
+                per_query.append(
+                    KnnWindowResult(win.start, win.end, neighbors,
+                                    len(win.events))
+                )
+            yield MultiKnnWindowResult(
+                win.start, win.end, per_query, len(win.events)
+            )
+
     def run_soa_panes(
         self,
         chunks,
@@ -321,10 +414,12 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         (start, end, oids, dists, num_valid) per window) at O(pane) device
         work per slide instead of O(window). Same in-order/no-lateness
         caveats as ``query_panes``."""
-        from spatialflink_tpu.operators.base import center_coords
-        from spatialflink_tpu.ops.knn import knn_merge_digests, knn_pane_digest
+        from spatialflink_tpu.operators.base import device_point_args
+        from spatialflink_tpu.ops.knn import (
+            knn_merge_digest_list,
+            knn_pane_digest,
+        )
         from spatialflink_tpu.streams.soa import SoaWindowAssembler
-        from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
 
         conf = self.conf
         if conf.allowed_lateness_ms > 0:
@@ -338,7 +433,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         flags_d = jnp.asarray(flags_for_queries(self.grid, radius, [query_point]))
         q = self.device_q([query_point.x, query_point.y], dtype)
         digest = jitted(knn_pane_digest, "num_segments")
-        merge = jitted(knn_merge_digests, "k")
+        merge = jitted(knn_merge_digest_list, "k")
+        ppw = size // slide
+        no_bases = np.zeros(ppw, np.int32)  # indices unused by this yield
 
         panes: dict = {}  # pane start → (seg_min, rep) | None (empty pane)
         emt = None
@@ -358,18 +455,12 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                      np.asarray(win.arrays["y"][lo:hi], np.float64)],
                     axis=1,
                 )
-                n = hi - lo
-                b = next_bucket(n)
-                cell = self.grid.assign_cells_np(xy64)
+                xy_p, valid_p, cell_p, oid_p = device_point_args(
+                    self.grid, xy64, win.arrays["oid"][lo:hi], dtype
+                )
                 d = digest(
-                    jnp.asarray(pad_to_bucket(
-                        center_coords(self.grid, xy64, dtype), b)),
-                    jnp.asarray(pad_to_bucket(np.ones(n, bool), b, fill=False)),
-                    jnp.asarray(pad_to_bucket(cell, b, fill=self.grid.num_cells)),
-                    flags_d,
-                    jnp.asarray(pad_to_bucket(
-                        np.asarray(win.arrays["oid"][lo:hi], np.int32), b,
-                        fill=0)),
+                    jnp.asarray(xy_p), jnp.asarray(valid_p),
+                    jnp.asarray(cell_p), flags_d, jnp.asarray(oid_p),
                     q, radius, np.int32(0), num_segments=num_segments,
                 )
                 panes[ps] = (d.seg_min, d.rep)
@@ -383,8 +474,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                     jnp.full_like(ref[0], jnp.finfo(ref[0].dtype).max),
                     jnp.full_like(ref[1], jnp.iinfo(jnp.int32).max),
                 )
-            sms, rps = zip(*[emt if p is None else p for p in live])
-            res = merge(jnp.stack(sms), jnp.stack(rps), k=k)
+            sms = tuple(emt[0] if p is None else p[0] for p in live)
+            rps = tuple(emt[1] if p is None else p[1] for p in live)
+            res = merge(sms, rps, no_bases, k=k)
             nv = int(res.num_valid)
             yield (
                 win.start, win.end,

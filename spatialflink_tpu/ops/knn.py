@@ -144,19 +144,38 @@ def knn_pane_digest_geometry(
     )
 
 
-def knn_merge_digests(seg_min_stack, rep_stack, k: int) -> KnnResult:
+def knn_merge_digests(seg_min_stack, rep_stack, k: int, bases=None) -> KnnResult:
     """(P, num_segments) stacked pane digests → window top-k.
 
     Per-object window minimum = min over panes; the representative is the
-    lowest global index among panes achieving that minimum — identical
+    lowest index among panes achieving that minimum — identical
     tie-breaking to the fused single-program kernel over the whole window
     (parity-tested), and to the reference's PQ merge (KNNQuery.java:204-308).
+
+    ``bases``: optional (P,) int32 window-local offsets added to each
+    pane's LOCAL representative indices (digests produced with
+    index_base=0). Offsetting inside the merge keeps carried digests
+    unbounded-stream-safe: indices never exceed the window's event count.
+    Absent objects (rep == int32-max sentinel) stay at the sentinel.
     """
-    gmin = jnp.min(seg_min_stack, axis=0)
     int_big = jnp.iinfo(jnp.int32).max
+    if bases is not None:
+        rep_stack = jnp.where(
+            rep_stack == int_big, int_big, rep_stack + bases[:, None]
+        )
+    gmin = jnp.min(seg_min_stack, axis=0)
     qual = seg_min_stack <= gmin[None, :]
     rep = jnp.min(jnp.where(qual, rep_stack, int_big), axis=0)
     return _finish_topk(gmin, rep, k)
+
+
+def knn_merge_digest_list(seg_mins, reps, bases, k: int) -> KnnResult:
+    """Tuple-of-digests form of ``knn_merge_digests`` — stacking happens
+    INSIDE the jitted program, so a per-window merge is one dispatch with
+    no eager device ops (the tuple length is static per window config)."""
+    return knn_merge_digests(
+        jnp.stack(seg_mins), jnp.stack(reps), k, bases=jnp.asarray(bases)
+    )
 
 
 def knn_kernel(
@@ -274,6 +293,58 @@ def knn_polyline_fused(xy, valid, cell, flags_table, oid, query_verts,
         query_edge_valid, radius, k=k, num_segments=num_segments,
         axis_name=axis_name, index_base=index_base,
     )
+
+
+def knn_multi_query_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    flags_tables: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    radius,
+    k: int,
+    num_segments: int,
+    query_block: int = 32,
+) -> KnnResult:
+    """kNN for a BATCH of query points in one program — the multi-query
+    vmap surface (one windowAll merge per query in the reference,
+    KNNQuery.java:204-308; here one fused program for all of them).
+
+    ``query_xy``: (Q, 2); ``flags_tables``: (Q, num_cells+1) per-query
+    neighbor-cell flag tables (each query prunes by its own candidate
+    cells, PointPointKNNQuery.java:134-150). Returns a KnnResult whose
+    fields carry a leading Q axis. Queries are processed in
+    ``query_block``-sized vmapped chunks under ``lax.map`` so peak memory
+    is O(query_block × N) rather than O(Q × N); Q must divide into
+    blocks (pad queries to a multiple of ``query_block``, extra lanes are
+    cheap and discarded by the caller).
+    """
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    q_total = query_xy.shape[0]
+    if q_total % query_block != 0:
+        raise ValueError("pad query batch to a multiple of query_block")
+
+    def one(q_xy, flags_table):
+        dist = point_point_distance(xy, q_xy[None, :])
+        return _topk_from_point_dists(
+            dist, valid, gather_cell_flags(cell, flags_table), oid,
+            radius, k, num_segments,
+        )
+
+    def block(args):
+        q_blk, f_blk = args
+        return jax.vmap(one)(q_blk, f_blk)
+
+    res = jax.lax.map(
+        block,
+        (
+            query_xy.reshape(-1, query_block, 2),
+            flags_tables.reshape(q_total // query_block, query_block, -1),
+        ),
+    )
+    return KnnResult(*[x.reshape((q_total,) + x.shape[2:]) for x in res])
 
 
 def knn_geometry_query_kernel(

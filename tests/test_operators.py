@@ -401,3 +401,78 @@ def test_pane_knn_rejects_lateness(rng):
     q = Point(x=5.0, y=5.0)
     with pytest.raises(ValueError, match="allowed_lateness"):
         list(PointPointKNNQuery(conf, GRID).query_panes(iter([]), q, 1.0, 3))
+
+
+def test_multi_query_knn_matches_per_query_runs(rng):
+    """run_multi (one fused program for the whole query set) must equal
+    run() executed per query point — including tie-break/representative
+    identity and empty-result queries (a query in a far corner)."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=400)
+    queries = [
+        Point(x=2.0, y=2.0), Point(x=5.0, y=5.0), Point(x=8.0, y=3.0),
+        Point(x=9.9, y=9.9), Point(x=0.05, y=9.95),
+    ]
+    r, k = 1.5, 6
+    multi = list(
+        PointPointKNNQuery(conf, GRID).run_multi(iter(pts), queries, r, k)
+    )
+    assert multi
+    for qi, q in enumerate(queries):
+        single = list(PointPointKNNQuery(conf, GRID).run(iter(pts), q, r, k))
+        assert len(single) == len(multi)
+        for sres, mres in zip(single, multi):
+            got = mres.results[qi]
+            assert (got.start, got.end) == (sres.start, sres.end)
+            assert [(o, round(d, 12), id(ev)) for o, d, ev in got.neighbors] \
+                == [(o, round(d, 12), id(ev)) for o, d, ev in sres.neighbors]
+
+
+def test_multi_query_knn_kernel_parity(rng):
+    """Kernel-level: knn_multi_query_kernel row == knn_points_fused per
+    query, across a query count that needs block padding."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.knn import knn_multi_query_kernel, knn_points_fused
+
+    n, nq, k = 512, 11, 5
+    xy = rng.uniform(0, 10, (n, 2))
+    oid = rng.integers(0, 31, n).astype(np.int32)
+    cell = GRID.assign_cells_np(xy)
+    valid = np.ones(n, bool)
+    qxy = rng.uniform(0, 10, (nq, 2))
+    tables = np.stack([
+        GRID.neighbor_flags(2.0, [GRID.flat_cell(*q)]) for q in qxy
+    ])
+    qb = 16
+    tables_p = np.concatenate(
+        [tables, np.zeros((qb - nq,) + tables.shape[1:], tables.dtype)])
+    qxy_p = np.concatenate([qxy, np.zeros((qb - nq, 2))])
+
+    multi = jax.jit(
+        knn_multi_query_kernel,
+        static_argnames=("k", "num_segments", "query_block"),
+    )(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        jnp.asarray(tables_p), jnp.asarray(oid), jnp.asarray(qxy_p),
+        2.0, k=k, num_segments=32, query_block=8,
+    )
+    single = jax.jit(
+        knn_points_fused, static_argnames=("k", "num_segments"))
+    for qi in range(nq):
+        res = single(
+            jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+            jnp.asarray(tables[qi]), jnp.asarray(oid),
+            jnp.asarray(qxy[qi]), 2.0, k=k, num_segments=32,
+        )
+        np.testing.assert_array_equal(np.asarray(multi.segment[qi]),
+                                      np.asarray(res.segment))
+        np.testing.assert_allclose(np.asarray(multi.dist[qi]),
+                                   np.asarray(res.dist), rtol=1e-12)
+        np.testing.assert_array_equal(np.asarray(multi.index[qi]),
+                                      np.asarray(res.index))
+        assert int(multi.num_valid[qi]) == int(res.num_valid)
+    # padded query lanes: zero flags -> nothing found
+    for qi in range(nq, qb):
+        assert int(multi.num_valid[qi]) == 0
