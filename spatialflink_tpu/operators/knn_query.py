@@ -348,6 +348,8 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         ``run()`` with that single query (parity test)."""
         from spatialflink_tpu.ops.knn import knn_multi_query_kernel
 
+        from spatialflink_tpu.utils.padding import pad_to_bucket
+
         nq = len(query_points)
         if nq == 0:
             return
@@ -356,12 +358,11 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         )
         qb = next_bucket(nq, minimum=8)
         block = min(qb, 32)
-        if qb > nq:  # padded query lanes: zero flag tables → empty results
-            tables = np.concatenate(
-                [tables, np.zeros((qb - nq,) + tables.shape[1:], tables.dtype)]
-            )
-        qxy = np.zeros((qb, 2), np.float64)
-        qxy[:nq] = [[q.x, q.y] for q in query_points]
+        # Padded query lanes carry zero flag tables → empty results.
+        tables = pad_to_bucket(tables, qb)
+        qxy = pad_to_bucket(
+            np.asarray([[q.x, q.y] for q in query_points], np.float64), qb
+        )
         tables_d = jnp.asarray(tables)
         q_d = self.device_q(qxy, dtype)
         kernel = jitted(

@@ -29,11 +29,13 @@ from spatialflink_tpu.ops.polygon import points_in_polygon
 __all__ = [
     "range_query_kernel",
     "range_query_polygons_kernel",
+    "range_query_polygons_pruned_kernel",
     "range_query_polylines_kernel",
     "geometry_range_query_kernel",
     "geometry_pair_distance",
     "range_points_fused",
     "range_polygons_fused",
+    "range_polygons_pruned_fused",
     "range_polylines_fused",
 ]
 
@@ -99,6 +101,112 @@ def range_query_polygons_kernel(
         one_poly, poly_verts, poly_edge_valid, poly_chunk
     )
     return _emit_mask(valid, flags, min_dist, radius, approximate), min_dist
+
+
+def range_query_polygons_pruned_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    flags: jnp.ndarray,
+    poly_verts: jnp.ndarray,
+    poly_edge_valid: jnp.ndarray,
+    radius,
+    cand: int = 8,
+    point_chunk: int = 8192,
+    approximate: bool = False,
+):
+    """Large-query-set point–polygon range via bbox-candidate pruning.
+
+    The dense kernel evaluates every (point, polygon, edge) triple — P·E
+    edge distances per point. For big query sets (the 1000-polygon config)
+    almost all pairs are far apart, so this kernel does a cheap
+    (N, P) bbox-distance pass, takes each point's ``cand`` nearest polygons
+    by bbox distance (lax.top_k), and computes exact edge distances ONLY
+    for those candidates — O(P + cand·E) per point instead of O(P·E).
+
+    Exactness contract (mirrors the bucketed join's overflow/retry):
+    bbox distance lower-bounds exact distance, so every polygon within
+    ``radius`` of a point is among its bbox-candidates UNLESS more than
+    ``cand`` polygon bboxes fall within radius — counted per point into
+    ``overflow``. With overflow == 0, keep/min_dist are bit-exact for all
+    kept lanes (dropped lanes report the min over their candidates only);
+    otherwise retry with a larger ``cand``.
+
+    Points stream through ``point_chunk``-sized lax.map blocks so the
+    (chunk, P) bbox matrix stays bounded. Returns (keep, min_dist, overflow).
+    """
+    n = xy.shape[0]
+    p = poly_verts.shape[0]
+    cand = min(cand, p)
+    vmask = _vert_valid(poly_edge_valid)  # (P, V)
+    vx, vy = poly_verts[..., 0], poly_verts[..., 1]
+    big = jnp.asarray(jnp.finfo(xy.dtype).max, xy.dtype)
+    minx = jnp.min(jnp.where(vmask, vx, big), axis=1)
+    maxx = jnp.max(jnp.where(vmask, vx, -big), axis=1)
+    miny = jnp.min(jnp.where(vmask, vy, big), axis=1)
+    maxy = jnp.max(jnp.where(vmask, vy, -big), axis=1)
+    # All-invalid (padding) polygons: minx > maxx → clamped dx below stays
+    # positive-huge, so they are never candidates within radius.
+    dead = ~jnp.any(vmask, axis=1)
+
+    def chunk_fn(args):
+        xy_c, valid_c, flags_c = args
+        x, y = xy_c[:, 0:1], xy_c[:, 1:2]  # (C, 1)
+        dx = jnp.maximum(jnp.maximum(minx[None, :] - x, x - maxx[None, :]), 0.0)
+        dy = jnp.maximum(jnp.maximum(miny[None, :] - y, y - maxy[None, :]), 0.0)
+        bbox_d = jnp.where(dead[None, :], big, jnp.hypot(dx, dy))  # (C, P)
+        neg_top, idx = jax.lax.top_k(-bbox_d, cand)  # nearest by bbox
+        within = jnp.sum((bbox_d <= radius).astype(jnp.int32), axis=1)
+        lanes = valid_c & (flags_c > 0)
+        over = jnp.sum(
+            jnp.where(lanes, jnp.maximum(within - cand, 0), 0)
+        )
+        cverts = poly_verts[idx]  # (C, cand, V, 2)
+        cev = poly_edge_valid[idx]  # (C, cand, V-1)
+
+        def one(p_xy, cv, ce):
+            def per_cand(verts, ev):
+                ed = point_polyline_distance(p_xy[None, :], verts, ev)[0]
+                ins = points_in_polygon(p_xy[None, :], verts, ev)[0]
+                return jnp.where(ins, jnp.zeros((), ed.dtype), ed)
+
+            return jnp.min(jax.vmap(per_cand)(cv, ce))
+
+        min_d = jax.vmap(one)(xy_c, cverts, cev)  # (C,)
+        keep = _emit_mask(valid_c, flags_c, min_d, radius, approximate)
+        return keep, min_d, over
+
+    pad = (-n) % point_chunk
+    if pad:
+        xy = jnp.concatenate([xy, jnp.zeros((pad, 2), xy.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+        flags = jnp.concatenate([flags, jnp.zeros((pad,), flags.dtype)])
+    n_blocks = (n + pad) // point_chunk
+    keep_b, dist_b, over_b = jax.lax.map(
+        chunk_fn,
+        (
+            xy.reshape(n_blocks, point_chunk, 2),
+            valid.reshape(n_blocks, point_chunk),
+            flags.reshape(n_blocks, point_chunk),
+        ),
+    )
+    return (
+        keep_b.reshape(-1)[:n],
+        dist_b.reshape(-1)[:n],
+        jnp.sum(over_b),
+    )
+
+
+def range_polygons_pruned_fused(xy, valid, cell, flags_table, poly_verts,
+                                poly_edge_valid, radius, cand: int = 8,
+                                point_chunk: int = 8192,
+                                approximate: bool = False):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return range_query_polygons_pruned_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), poly_verts,
+        poly_edge_valid, radius, cand=cand, point_chunk=point_chunk,
+        approximate=approximate,
+    )
 
 
 def _chunked_min_over_geoms(one_fn, verts, edge_valid, chunk):
@@ -185,10 +293,13 @@ def range_polylines_fused(xy, valid, cell, flags_table, line_verts,
 
 
 def _vert_valid(edge_valid: jnp.ndarray) -> jnp.ndarray:
-    """(V-1,) edge mask → (V,) vertex mask (a vertex is real if it bounds a
-    real edge)."""
-    z = jnp.zeros((1,), bool)
-    return jnp.concatenate([edge_valid, z]) | jnp.concatenate([z, edge_valid])
+    """(..., V-1) edge mask → (..., V) vertex mask (a vertex is real if it
+    bounds a real edge)."""
+    z = jnp.zeros(edge_valid.shape[:-1] + (1,), bool)
+    return (
+        jnp.concatenate([edge_valid, z], axis=-1)
+        | jnp.concatenate([z, edge_valid], axis=-1)
+    )
 
 
 def geometry_pair_distance(

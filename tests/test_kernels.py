@@ -374,3 +374,71 @@ def test_join_out_of_grid_points_never_match(rng):
             iter(list(left)), iter(list(right)), 0.2))
         got = {(a.obj_id, b.obj_id) for r in res for a, b, _ in r.pairs}
         assert got == {("in", "r")}, (cap, got)
+
+
+def test_pruned_polygon_range_matches_dense(rng):
+    """range_query_polygons_pruned_kernel must keep exactly the dense
+    kernel's lanes (and equal min_dist on kept lanes) when overflow == 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import pack_query_geometries
+    from spatialflink_tpu.ops.range import (
+        range_query_polygons_kernel,
+        range_query_polygons_pruned_kernel,
+    )
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+
+    polys = generate_query_polygons(60, 0.0, 0.0, 10.0, 10.0, grid_size=20,
+                                    seed=5)
+    verts, ev = pack_query_geometries(polys, np.float64)
+    n = 3000
+    xy = rng.uniform(0, 10, (n, 2))
+    valid = np.ones(n, bool)
+    flags = np.ones(n, np.uint8)  # all candidate lanes: distances decide
+    r = 0.4
+
+    keep_d, dist_d = jax.jit(range_query_polygons_kernel,
+                             static_argnames="approximate")(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(flags),
+        jnp.asarray(verts), jnp.asarray(ev), r)
+    keep_p, dist_p, over = jax.jit(
+        range_query_polygons_pruned_kernel,
+        static_argnames=("cand", "point_chunk", "approximate"))(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(flags),
+        jnp.asarray(verts), jnp.asarray(ev), r,
+        cand=8, point_chunk=512)
+    assert int(over) == 0
+    np.testing.assert_array_equal(np.asarray(keep_p), np.asarray(keep_d))
+    kept = np.asarray(keep_d)
+    np.testing.assert_allclose(np.asarray(dist_p)[kept],
+                               np.asarray(dist_d)[kept], rtol=0, atol=0)
+
+
+def test_pruned_polygon_range_overflow_detects_undercount(rng):
+    """With cand smaller than the number of in-radius polygon bboxes at
+    some point, overflow must be nonzero (the retry signal)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import pack_query_geometries
+    from spatialflink_tpu.models.objects import Polygon
+    from spatialflink_tpu.ops.range import range_query_polygons_pruned_kernel
+
+    # 6 concentric small squares around (5,5): any nearby point has 6
+    # bbox-candidates within r.
+    polys = []
+    for i in range(6):
+        s = 0.1 + 0.05 * i
+        polys.append(Polygon(rings=[np.array(
+            [[5 - s, 5 - s], [5 + s, 5 - s], [5 + s, 5 + s], [5 - s, 5 + s],
+             [5 - s, 5 - s]])]))
+    verts, ev = pack_query_geometries(polys, np.float64)
+    xy = np.array([[5.05, 5.0], [9.0, 9.0]])
+    keep, dist, over = jax.jit(
+        range_query_polygons_pruned_kernel,
+        static_argnames=("cand", "point_chunk", "approximate"))(
+        jnp.asarray(xy), jnp.asarray(np.ones(2, bool)),
+        jnp.asarray(np.ones(2, np.uint8)), jnp.asarray(verts),
+        jnp.asarray(ev), 1.0, cand=4, point_chunk=2)
+    assert int(over) > 0

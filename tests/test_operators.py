@@ -476,3 +476,30 @@ def test_multi_query_knn_kernel_parity(rng):
     # padded query lanes: zero flags -> nothing found
     for qi in range(nq, qb):
         assert int(multi.num_valid[qi]) == 0
+
+
+def test_point_polygon_range_pruned_path_matches_dense(rng):
+    """With >=64 query polygons the operator auto-selects the pruned
+    kernel; results must match the dense path exactly."""
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=600)
+    polys = generate_query_polygons(80, 0.0, 0.0, 10.0, 10.0, grid_size=20,
+                                    seed=11)
+    op_pruned = PointPolygonRangeQuery(conf, GRID)
+    got = {
+        (res.start, res.end): sorted(id(p) for p in res.objects)
+        for res in op_pruned.run(iter(pts), polys, 0.3)
+    }
+    # Force the dense path by running per-polygon-chunk under the
+    # threshold and unioning.
+    dense = {}
+    for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys[:63], 0.3):
+        dense.setdefault((res.start, res.end), set()).update(
+            id(p) for p in res.objects)
+    for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys[63:], 0.3):
+        dense.setdefault((res.start, res.end), set()).update(
+            id(p) for p in res.objects)
+    dense_sorted = {k: sorted(v) for k, v in dense.items()}
+    assert got == dense_sorted
