@@ -69,32 +69,68 @@ def _result(name, n_points, seconds, extra=None):
     return out
 
 
+def _pipelined(jax, n_win, make_arrays, dispatch, depth: int = 2):
+    """Shared double-buffered dispatch loop: stage ``depth`` windows of
+    host→device transfers ahead, dispatch each window's program, collect
+    result handles, and materialize them ALL with one device_get (the only
+    true sync on the axon tunnel — block_until_ready returns early).
+    Returns (fetched results, elapsed seconds); the timed region covers
+    every transfer, dispatch and the final fetch. ``dispatch`` may return
+    None for iterations that fire no window (e.g. kNN pane warm-up)."""
+    import time as _time
+
+    fired = []
+    t0 = _time.perf_counter()
+    staged = [make_arrays(i) for i in range(min(depth, n_win))]
+    for i in range(n_win):
+        if i + depth < n_win:
+            staged.append(make_arrays(i + depth))
+        res = dispatch(staged.pop(0))
+        if res is not None:
+            fired.append(res)
+    out = jax.device_get(fired)
+    return out, _time.perf_counter() - t0
+
+
 def bench_range_window(jax, jnp, grid, quick):
     """Config 1: Point-Point range, r≈500m (0.005°), 100×100 grid, 10s
-    tumbling windows."""
-    from spatialflink_tpu.ops.range import range_points_fused
+    tumbling windows. Device-side cell assignment, double-buffered
+    streamed ingest, pipelined egress (hit counts fetched once at the
+    end — device_get is the only true sync on this tunnel)."""
+    from spatialflink_tpu.ops.cells import assign_cells, gather_cell_flags
+    from spatialflink_tpu.ops.range import range_query_kernel
 
     n_win = 4 if quick else 10
     win_pts = 500_000
     xy, oid, ts = _stream(win_pts * n_win)
-    q = jnp.asarray(np.array([[116.40, 40.19]], np.float32))
+    dev = jax.devices()[0]
+    q = jax.device_put(jnp.asarray(np.array([[116.40, 40.19]], np.float32)), dev)
     flags = grid.neighbor_flags(0.005, [grid.flat_cell(116.40, 40.19)])
-    flags_d = jnp.asarray(flags)
-    fn = jax.jit(range_points_fused, static_argnames=("approximate",))
+    flags_d = jax.device_put(jnp.asarray(flags), dev)
+    valid_d = jax.device_put(jnp.asarray(np.ones(win_pts, bool)), dev)
 
-    def one(i):
-        sl = slice(i * win_pts, (i + 1) * win_pts)
-        cell = grid.assign_cells_np(xy[sl])
-        keep, dist = fn(
-            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
-            jnp.asarray(cell), flags_d, q, np.float32(0.005),
+    def step(xy_w, valid, flags_table, query_xy):
+        cell = assign_cells(
+            xy_w, grid.min_x, grid.min_y, grid.cell_length, grid.n
         )
-        return int(np.asarray(keep).sum())
+        keep, _ = range_query_kernel(
+            xy_w, valid, gather_cell_flags(cell, flags_table), query_xy,
+            np.float32(0.005),
+        )
+        return jnp.sum(keep)
 
-    one(0)  # warm
-    t0 = time.perf_counter()
-    hits = sum(one(i) for i in range(n_win))
-    dt = time.perf_counter() - t0
+    jstep = jax.jit(step)
+
+    def win_xy(i):
+        return jax.device_put(xy[i * win_pts:(i + 1) * win_pts], dev)
+
+    jax.device_get(jstep(win_xy(0), valid_d, flags_d, q))  # compile
+
+    out, dt = _pipelined(
+        jax, n_win, win_xy,
+        lambda xy_w: jstep(xy_w, valid_d, flags_d, q),
+    )
+    hits = sum(int(h) for h in out)
     return _result("range_pp_r500m_10s_tumbling", n_win * win_pts, dt,
                    {"hits": hits})
 
@@ -158,26 +194,25 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     )
     jax.device_get(warm)
 
-    digests = [(d0.seg_min, d0.rep)]
-    fired = []  # per-window result handles; egress pipelines like ingest
     # Timed region covers panes 1..n_panes-1 end to end, including their
     # host→device transfers (warm-up pane 0 is excluded from the numerator).
-    t0 = time.perf_counter()
-    staged = [pane_arrays(1), pane_arrays(2)]
-    for p in range(1, n_panes):
-        if p + 2 < n_panes:
-            staged.append(pane_arrays(p + 2))  # overlaps this pane's compute
-        xa, oa = staged.pop(0)
+    digests = [(d0.seg_min, d0.rep)]
+
+    def dispatch(args):
+        xa, oa = args
         d = jpane(xa, oa, valid_d, flags_d, q)
         digests.append((d.seg_min, d.rep))
-        digests = digests[-ppw:]
-        if len(digests) == ppw:  # window [p-4, p] complete → fire
-            fired.append(jmerge(
-                tuple(s for s, _ in digests),
-                tuple(r for _, r in digests), no_bases, k=k,
-            ))
-    out = jax.device_get(fired)  # all window results on host (true sync)
-    dt = time.perf_counter() - t0
+        del digests[:-ppw]
+        if len(digests) < ppw:
+            return None  # window incomplete — no fire yet
+        return jmerge(
+            tuple(s for s, _ in digests),
+            tuple(r for _, r in digests), no_bases, k=k,
+        )
+
+    out, dt = _pipelined(
+        jax, n_panes - 1, lambda i: pane_arrays(i + 1), dispatch
+    )
     return _result(f"continuous_knn_k{k}_5s_sliding",
                    pane_pts * (n_panes - 1), dt,
                    {"num_valid_last": int(out[-1].num_valid)})
@@ -231,15 +266,10 @@ def bench_polygon_range(jax, jnp, grid, quick):
 
     jax.device_get(jstep(win_xy(0), valid_d, flags_d, qv, qe))  # compile
 
-    fired = []
-    t0 = time.perf_counter()
-    staged = [win_xy(0), win_xy(1)]
-    for i in range(n_win):
-        if i + 2 < n_win:
-            staged.append(win_xy(i + 2))
-        fired.append(jstep(staged.pop(0), valid_d, flags_d, qv, qe))
-    out = jax.device_get(fired)
-    dt = time.perf_counter() - t0
+    out, dt = _pipelined(
+        jax, n_win, win_xy,
+        lambda xy_w: jstep(xy_w, valid_d, flags_d, qv, qe),
+    )
     hits = sum(int(h) for h, _ in out)
     assert sum(int(o) for _, o in out) == 0, "candidate overflow: raise cand"
     return _result(f"range_point_{n_polys}polygons", n_win * win_pts, dt,
@@ -254,6 +284,7 @@ def bench_join(jax, jnp, grid, quick):
     lag-1 (fetch window i−1 after dispatching i) so the tunnel round trip
     overlaps compute — the same double-buffering bench.py uses.
     """
+    from spatialflink_tpu.ops.cells import assign_cells
     from spatialflink_tpu.ops.join import join_window_bucketed, pallas_join_supported
 
     win_pts = 131_072
@@ -262,38 +293,44 @@ def bench_join(jax, jnp, grid, quick):
     xy_b, _, _ = _stream(win_pts * n_win, seed=2)
     r = np.float32(0.002)
     layers = grid.candidate_layers(float(r))
-    ones = jnp.asarray(np.ones(win_pts, bool))
+    dev = jax.devices()[0]
+    ones = jax.device_put(jnp.asarray(np.ones(win_pts, bool)), dev)
     if pallas_join_supported():
         from spatialflink_tpu.ops.pallas_join import join_window_pallas as fn
     else:
-        fn = jax.jit(
-            join_window_bucketed,
-            static_argnames=("grid_n", "layers", "cap_left", "cap_right", "max_pairs"),
-        )
+        fn = join_window_bucketed
 
-    def dispatch(i):
-        sl = slice(i * win_pts, (i + 1) * win_pts)
-        a, b = xy_a[sl], xy_b[sl]
+    def step(a_xy, b_xy):
+        ca = assign_cells(a_xy, grid.min_x, grid.min_y, grid.cell_length, grid.n)
+        cb = assign_cells(b_xy, grid.min_x, grid.min_y, grid.cell_length, grid.n)
         return fn(
-            jnp.asarray(a), ones, jnp.asarray(grid.assign_cells_np(a)),
-            jnp.asarray(b), ones, jnp.asarray(grid.assign_cells_np(b)),
+            a_xy, ones, ca, b_xy, ones, cb,
             grid_n=grid.n, layers=layers, radius=r,
             cap_left=48, cap_right=48, max_pairs=262_144,
         )
 
-    int(dispatch(0).count)  # warm
-    stats = []
-    t0 = time.perf_counter()
-    prev = dispatch(0)
-    for i in range(1, n_win):
-        cur = dispatch(i)
-        stats.append((int(prev.count), int(prev.overflow)))
-        prev = cur
-    stats.append((int(prev.count), int(prev.overflow)))
-    dt = time.perf_counter() - t0
+    jstep = jax.jit(step)
+
+    def win_arrays(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        return (
+            jax.device_put(xy_a[sl], dev),
+            jax.device_put(xy_b[sl], dev),
+        )
+
+    a0, b0 = win_arrays(0)
+    warm = jstep(a0, b0)
+    jax.device_get((warm.count, warm.overflow))  # compile
+
+    def dispatch(args):
+        res = jstep(*args)
+        return (res.count, res.overflow)
+
+    stats, dt = _pipelined(jax, n_win, win_arrays, dispatch)
     return _result(
         "join_two_streams_r200m", 2 * n_win * win_pts, dt,
-        {"pairs": sum(s[0] for s in stats), "overflow": sum(s[1] for s in stats)},
+        {"pairs": sum(int(c) for c, _ in stats),
+         "overflow": sum(int(o) for _, o in stats)},
     )
 
 
@@ -353,33 +390,51 @@ def bench_headline_knn_1m(jax, jnp, grid):
 
 
 def bench_tknn(jax, jnp, grid, quick):
-    """Config 5: trajectory kNN, per-objID grouped, k=20."""
-    from spatialflink_tpu.ops.knn import knn_points_fused
+    """Config 5: trajectory kNN, per-objID grouped, k=20. Same streamed
+    double-buffered dispatch model as the other configs (int16 oid wire,
+    device-side cells, pipelined egress)."""
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.knn import knn_kernel
+    from spatialflink_tpu.ops.cells import gather_cell_flags
 
     win_pts = 262_144
     n_win = 3 if quick else 6
     xy, oid, ts = _stream(win_pts * n_win, seed=11)
-    q = jnp.asarray(np.array([116.40, 40.19], np.float32))
+    oid16 = oid.astype(np.int16)
+    dev = jax.devices()[0]
+    q = jax.device_put(jnp.asarray(np.array([116.40, 40.19], np.float32)), dev)
     flags = grid.neighbor_flags(0.1, [grid.flat_cell(116.40, 40.19)])
-    flags_d = jnp.asarray(flags)
-    fn = jax.jit(knn_points_fused, static_argnames=("k", "num_segments"))
+    flags_d = jax.device_put(jnp.asarray(flags), dev)
+    valid_d = jax.device_put(jnp.asarray(np.ones(win_pts, bool)), dev)
 
-    def one(i):
-        sl = slice(i * win_pts, (i + 1) * win_pts)
-        cell = grid.assign_cells_np(xy[sl])
-        res = fn(
-            jnp.asarray(xy[sl]), jnp.asarray(np.ones(win_pts, bool)),
-            jnp.asarray(cell), flags_d, jnp.asarray(oid[sl]),
-            q, np.float32(0.1), k=20, num_segments=16_384,
+    def step(xy_w, oid16_w, valid, flags_table, query_xy):
+        cell = assign_cells(
+            xy_w, grid.min_x, grid.min_y, grid.cell_length, grid.n
         )
-        return int(res.num_valid)
+        return knn_kernel(
+            xy_w, valid, gather_cell_flags(cell, flags_table),
+            oid16_w.astype(jnp.int32), query_xy, np.float32(0.1),
+            k=20, num_segments=16_384,
+        )
 
-    one(0)
-    t0 = time.perf_counter()
-    for i in range(n_win):
-        one(i)
-    dt = time.perf_counter() - t0
-    return _result("trajectory_knn_k20_per_objid", n_win * win_pts, dt)
+    jstep = jax.jit(step)
+
+    def win_arrays(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        return (
+            jax.device_put(xy[sl], dev),
+            jax.device_put(oid16[sl], dev),
+        )
+
+    xa, oa = win_arrays(0)
+    jax.device_get(jstep(xa, oa, valid_d, flags_d, q))  # compile
+
+    out, dt = _pipelined(
+        jax, n_win, win_arrays,
+        lambda args: jstep(*args, valid_d, flags_d, q),
+    )
+    return _result("trajectory_knn_k20_per_objid", n_win * win_pts, dt,
+                   {"num_valid_last": int(out[-1].num_valid)})
 
 
 def main():

@@ -266,42 +266,144 @@ class PointPointJoinQuery(SpatialOperator):
                         )
                 overflow = int(res.overflow)
             else:
-                # Device-compacted pairs; a window whose match count exceeds
-                # the budget retries once with a doubled power-of-two budget,
-                # and the grown budget persists (dense workloads pay the
-                # retry once, not per window; compile cache stays bounded).
-                # Seed capped so the default (Pallas, VMEM-resident output)
-                # path serves large windows; genuinely denser results grow
-                # the budget via the retry below.
-                self._max_pairs = max(
-                    self._max_pairs, 1024, min(4 * lb.capacity, 262_144)
+                # Device-compacted pairs with the persistent-budget retry
+                # contract (_compact_block): a window whose match count
+                # exceeds the budget retries once with a doubled
+                # power-of-two budget that persists across windows.
+                li, ri, dd, overflow = self._compact_block(
+                    lb, rb, radius, offsets, dtype, mesh
                 )
-                while True:
-                    res = grid_hash_join_batches(
-                        self.grid, lb, rb, radius, self.cap, offsets,
-                        max_pairs=self._max_pairs, dtype=dtype,
-                        backend=self.join_backend, mesh=mesh,
-                    )
-                    count = int(res.count)
-                    if count <= self._max_pairs:
-                        break
-                    self._max_pairs = int(2 ** np.ceil(np.log2(count)))
-                # Transfer whole fixed-shape arrays, slice in numpy — a
-                # device slice of data-dependent length would compile per
-                # distinct count.
-                li = np.asarray(res.left_index)[:count]
-                ri = np.asarray(res.right_index)[:count]
-                dd = np.asarray(res.dist)[:count]
                 pairs = [
                     (left_ev[int(a)], right_ev[int(b)], float(d))
                     for a, b, d in zip(li, ri, dd)
-                    if a >= 0
                 ]
-                overflow = int(res.overflow)
             yield JoinWindowResult(
                 win.start, win.end, pairs, overflow, len(win.events)
             )
 
+
+    def _compact_block(self, lb, rb, radius, offsets, dtype, mesh):
+        """One bucketed join with the persistent-budget retry contract;
+        returns host (left_idx, right_idx, dist, overflow)."""
+        self._max_pairs = max(
+            self._max_pairs, 1024, min(4 * lb.capacity, 262_144)
+        )
+        while True:
+            res = grid_hash_join_batches(
+                self.grid, lb, rb, radius, self.cap, offsets,
+                max_pairs=self._max_pairs, dtype=dtype,
+                backend=self.join_backend, mesh=mesh,
+            )
+            count = int(res.count)
+            if count <= self._max_pairs:
+                break
+            self._max_pairs = int(2 ** np.ceil(np.log2(count)))
+        li = np.asarray(res.left_index)[:count]
+        ri = np.asarray(res.right_index)[:count]
+        dd = np.asarray(res.dist)[:count]
+        keep = li >= 0
+        return li[keep], ri[keep], dd[keep], int(res.overflow)
+
+    def query_panes(
+        self,
+        ordinary: Iterable[Point],
+        query_stream: Iterable[Point],
+        radius: float,
+        dtype=np.float64,
+    ) -> Iterator[JoinWindowResult]:
+        """Incremental sliding-window join via pane-block carry.
+
+        A window's pair set is the union over (left-pane, right-pane)
+        blocks; sliding by one pane only computes the 2·(size/slide)−1
+        blocks that involve the NEW pane — every other block is carried
+        from previous windows (the join analog of the ListState carry,
+        range/PointPointRangeQuery.java:195-296). Per-slide device work
+        drops from O(window²-candidates) to O(pane·window-candidates).
+
+        Pair multiset per window equals ``run()`` whenever
+        ``overflow == 0`` (parity test); pair ORDER differs (block-major
+        instead of window-compaction order). With overflow, the paths
+        diverge: the per-cell ``cap`` applies per PANE here (a cell may
+        exceed cap across the window yet fit per pane — pane carry then
+        keeps pairs run() would drop), and the reported overflow sums the
+        carried blocks' counts instead of one whole-window join's. Same
+        caveats as the other pane paths: in-order streams,
+        ``allowed_lateness`` rejected, size % slide == 0.
+        """
+        if self.conf.allowed_lateness_ms > 0:
+            raise ValueError(
+                "query_panes does not support allowed_lateness; use run()"
+            )
+        if self.conf.query_type != QueryType.WindowBased:
+            raise ValueError(
+                "query_panes requires WindowBased time-sliding windows"
+            )
+        size = self.conf.window_size_ms
+        slide = self.conf.slide_step_ms
+        if size % slide != 0:
+            raise ValueError("query_panes requires size % slide == 0")
+
+        merged = (
+            _TaggedEvent(ev.timestamp, tag, ev)
+            for tag, ev in merge_by_timestamp(ordinary, query_stream)
+        )
+        offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
+        panes: dict = {}  # ps → (left_ev, right_ev, lb|None, rb|None)
+        blocks: dict = {}  # (p, q) → (pairs list, overflow)
+
+        for win in self.windows(merged):
+            starts = list(range(win.start, win.end, slide))
+            fresh = {ps for ps in starts if ps not in panes}
+            if fresh:
+                # One O(window) bucketing pass for all new panes (a
+                # per-pane rescan would be O(panes × window) on e.g.
+                # 10s/10ms configs).
+                grouped: dict = {ps: ([], []) for ps in fresh}
+                for t in win.events:
+                    ps = win.start + ((t.timestamp - win.start) // slide) * slide
+                    if ps in grouped:
+                        grouped[ps][t.tag].append(t.event)
+                for ps, (left_ev, right_ev) in grouped.items():
+                    panes[ps] = (
+                        left_ev,
+                        right_ev,
+                        self.point_batch(left_ev) if left_ev else None,
+                        self.point_batch(right_ev) if right_ev else None,
+                    )
+            for ps in [p for p in panes if p < win.start]:
+                del panes[ps]
+            for key in [k for k in blocks
+                        if k[0] < win.start or k[1] < win.start]:
+                del blocks[key]
+
+            for p in starts:
+                for q in starts:
+                    if (p, q) in blocks:
+                        continue
+                    lev, _, lb, _ = panes[p]
+                    _, rev, _, rb = panes[q]
+                    if lb is None or rb is None:
+                        blocks[(p, q)] = ([], 0)
+                        continue
+                    li, ri, dd, over = self._compact_block(
+                        lb, rb, radius, offsets, dtype, None
+                    )
+                    blocks[(p, q)] = (
+                        [(lev[int(a)], rev[int(b)], float(d))
+                         for a, b, d in zip(li, ri, dd)],
+                        over,
+                    )
+
+            pairs: list = []
+            overflow = 0
+            for p in starts:
+                for q in starts:
+                    bp, bo = blocks[(p, q)]
+                    pairs.extend(bp)
+                    overflow += bo
+            yield JoinWindowResult(
+                win.start, win.end, pairs, overflow, len(win.events)
+            )
 
     def run_soa(
         self,

@@ -503,3 +503,43 @@ def test_point_polygon_range_pruned_path_matches_dense(rng):
             id(p) for p in res.objects)
     dense_sorted = {k: sorted(v) for k, v in dense.items()}
     assert got == dense_sorted
+
+
+def test_pane_join_matches_windowed(rng):
+    """query_panes (pane-block carry) must produce the same pair MULTISET
+    per sliding window as run() full recomputation (order may differ:
+    block-major vs window-compaction order)."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    left = synth_points(rng, n=250)
+    right = [
+        Point(obj_id=f"q{i}", timestamp=int(i * 120),
+              x=float(rng.uniform(0, 10)), y=float(rng.uniform(0, 10)))
+        for i in range(200)
+    ]
+    r = 0.8
+
+    def collect(gen):
+        return {
+            (res.start, res.end): (
+                sorted((id(a), id(b), round(d, 12)) for a, b, d in res.pairs),
+                res.overflow,
+            )
+            for res in gen
+        }
+
+    full = collect(PointPointJoinQuery(conf, GRID).run(iter(left), iter(right), r))
+    pane = collect(
+        PointPointJoinQuery(conf, GRID).query_panes(iter(left), iter(right), r)
+    )
+    assert set(full) == set(pane)
+    for k in full:
+        assert full[k][0] == pane[k][0], k
+        assert full[k][1] == 0 and pane[k][1] == 0
+
+
+def test_pane_join_rejects_lateness(rng):
+    conf = QueryConfiguration(
+        QueryType.WindowBased, window_size=10, slide_step=5, allowed_lateness=2
+    )
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        list(PointPointJoinQuery(conf, GRID).query_panes(iter([]), iter([]), 1.0))
