@@ -261,3 +261,23 @@ def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
 def jitted(fn: Callable, *static: str):
     """Module-level jit cache so every operator instance reuses programs."""
     return jax.jit(fn, static_argnames=static) if static else jax.jit(fn)
+
+
+def window_program(mesh, kernel, data_idx, n_args, topk=False, reduce=False,
+                   **statics):
+    """Mesh-or-single dispatch for a fused window kernel.
+
+    With a mesh: the SAME kernel shard_mapped over the ``data`` axis
+    (parallel/sharded.py — topk kernels pmin-reduce per-object minima,
+    reduce kernels all-reduce their segment reduction, elementwise kernels
+    stay sharded). Without: the module-cached jit. Every operator's
+    mesh path goes through here so a new execution mode lands in one place.
+    """
+    if mesh is not None:
+        from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+        return sharded_window_kernel(
+            mesh, kernel, data_idx, n_args, topk=topk, reduce=reduce,
+            **statics,
+        )
+    return functools.partial(jitted(kernel, *sorted(statics)), **statics)

@@ -24,6 +24,7 @@ from spatialflink_tpu.operators.base import (
     flags_for_queries,
     jitted,
     pack_query_geometries,
+    window_program,
 )
 from spatialflink_tpu.ops.knn import (
     knn_geometry_query_kernel,
@@ -77,27 +78,14 @@ class _PointStreamKNNQuery(SpatialOperator):
         )
 
         def programs(nseg):
-            if mesh is not None:
-                from spatialflink_tpu.parallel.sharded import sharded_window_kernel
-
-                return (
-                    sharded_window_kernel(
-                        mesh, knn_points_fused, (0, 1, 2, 4), 7,
-                        topk=True, k=k, num_segments=nseg,
-                    ),
-                    sharded_window_kernel(
-                        mesh, geom_kernel, (0, 1, 2, 4), 8,
-                        topk=True, k=k, num_segments=nseg,
-                    ),
-                )
             return (
-                functools.partial(
-                    jitted(knn_points_fused, "k", "num_segments"),
-                    k=k, num_segments=nseg,
+                window_program(
+                    mesh, knn_points_fused, (0, 1, 2, 4), 7,
+                    topk=True, k=k, num_segments=nseg,
                 ),
-                functools.partial(
-                    jitted(geom_kernel, "k", "num_segments"),
-                    k=k, num_segments=nseg,
+                window_program(
+                    mesh, geom_kernel, (0, 1, 2, 4), 8,
+                    topk=True, k=k, num_segments=nseg,
                 ),
             )
 
@@ -546,21 +534,10 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 obj_polygonal=self.stream_polygonal,
                 query_polygonal=query_polygonal,
             )
-            if mesh is not None:
-                from spatialflink_tpu.parallel.sharded import sharded_window_kernel
-
-                kg = sharded_window_kernel(
-                    mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
-                    topk=True, **statics,
-                )
-            else:
-                kg = functools.partial(
-                    jitted(
-                        knn_geometry_query_kernel,
-                        "k", "num_segments", "obj_polygonal", "query_polygonal",
-                    ),
-                    **statics,
-                )
+            kg = window_program(
+                mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
+                topk=True, **statics,
+            )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             res = kg(
                 self.device_verts(batch.verts, dtype),

@@ -32,6 +32,7 @@ from spatialflink_tpu.operators.base import (
     jitted,
     pack_query_geometries,
     pack_query_points,
+    window_program,
 )
 from spatialflink_tpu.ops.range import (
     geometry_range_query_kernel,
@@ -71,28 +72,15 @@ class _PointStreamRangeQuery(SpatialOperator):
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
         approx = self.conf.approximate_query
-        if mesh is not None:
-            from spatialflink_tpu.parallel.sharded import sharded_window_kernel
-
-            pk = sharded_window_kernel(
-                mesh, range_points_fused, (0, 1, 2), 6, approximate=approx
-            )
-            polyk = sharded_window_kernel(
-                mesh, range_polygons_fused, (0, 1, 2), 7, approximate=approx
-            )
-            lk = sharded_window_kernel(
-                mesh, range_polylines_fused, (0, 1, 2), 7, approximate=approx
-            )
-        else:
-            pk = functools.partial(
-                jitted(range_points_fused, "approximate"), approximate=approx
-            )
-            polyk = functools.partial(
-                jitted(range_polygons_fused, "approximate"), approximate=approx
-            )
-            lk = functools.partial(
-                jitted(range_polylines_fused, "approximate"), approximate=approx
-            )
+        pk = window_program(
+            mesh, range_points_fused, (0, 1, 2), 6, approximate=approx
+        )
+        polyk = window_program(
+            mesh, range_polygons_fused, (0, 1, 2), 7, approximate=approx
+        )
+        lk = window_program(
+            mesh, range_polylines_fused, (0, 1, 2), 7, approximate=approx
+        )
         if self.query_kind == "point":
             q = self.device_q(pack_query_points(query_set, np.float64), dtype)
         else:

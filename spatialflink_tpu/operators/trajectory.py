@@ -24,14 +24,14 @@ from spatialflink_tpu.operators.base import (
     flags_for_queries,
     jitted,
     pack_query_geometries,
+    window_program,
 )
 from spatialflink_tpu.operators.join_query import _TaggedEvent, merge_by_timestamp
 from spatialflink_tpu.ops.knn import knn_points_fused
-from spatialflink_tpu.ops.polygon import points_in_polygon
 from spatialflink_tpu.ops.trajectory import (
     traj_cell_spans_kernel,
-    traj_hits_kernel,
     traj_pair_dedup_kernel,
+    traj_range_hits_fused,
     traj_stats_kernel,
     traj_stats_sorted_fused,
 )
@@ -79,23 +79,27 @@ class TRangeQuery(SpatialOperator):
         stream: Iterable[Point],
         query_polygons: Sequence[Polygon],
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[TRangeResult]:
+        mesh = mesh if mesh is not None else self.mesh
         verts, ev = pack_query_geometries(query_polygons, np.float64)
         qv = self.device_verts(verts, dtype)
         qe = jnp.asarray(ev)
 
-        def containment(xy, valid, oid, num_segments):
-            inside = jax.vmap(lambda v, e: points_in_polygon(xy, v, e))(qv, qe)
-            return traj_hits_kernel(jnp.any(inside, axis=0), oid, valid, num_segments)
-
-        kern = jax.jit(containment, static_argnames=("num_segments",))
+        def program(nseg):
+            return window_program(
+                mesh, traj_range_hits_fused, (0, 1, 2), 5,
+                reduce=True, num_segments=nseg,
+            )
 
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
             hits = np.asarray(
-                kern(self.device_xy(batch, dtype), jnp.asarray(batch.valid),
-                     jnp.asarray(batch.oid), num_segments=nseg)
+                program(nseg)(
+                    self.device_xy(batch, dtype), jnp.asarray(batch.valid),
+                    jnp.asarray(batch.oid), qv, qe,
+                )
             )
             groups = group_by_oid(win.events)
             out = [
@@ -138,19 +142,26 @@ class TKNNQuery(SpatialOperator):
         radius: float,
         k: int,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[TKnnResult]:
+        mesh = mesh if mesh is not None else self.mesh
         flags = flags_for_queries(self.grid, radius, [query_point])
         flags_d = jnp.asarray(flags)
         q = self.device_q([query_point.x, query_point.y], dtype)
-        kern = jitted(knn_points_fused, "k", "num_segments")
+
+        def program(nseg):
+            return window_program(
+                mesh, knn_points_fused, (0, 1, 2, 4), 7,
+                topk=True, k=k, num_segments=nseg,
+            )
 
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            res = kern(
+            res = program(nseg)(
                 self.device_xy(batch, dtype), jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell), flags_d,
-                jnp.asarray(batch.oid), q, radius, k=k, num_segments=nseg,
+                jnp.asarray(batch.oid), q, radius,
             )
             groups = group_by_oid(win.events)
             out = []
@@ -350,8 +361,8 @@ class TAggregateQuery(SpatialOperator):
     """
 
     def __init__(self, conf, grid, aggregate: str = "SUM",
-                 inactive_threshold_ms: int = 0):
-        super().__init__(conf, grid)
+                 inactive_threshold_ms: int = 0, mesh=None):
+        super().__init__(conf, grid, mesh=mesh)
         if aggregate.upper() not in ("ALL", "SUM", "AVG", "MIN", "MAX"):
             raise ValueError(f"bad aggregate {aggregate!r}")
         self.aggregate = aggregate.upper()
@@ -363,8 +374,16 @@ class TAggregateQuery(SpatialOperator):
         self._smin = np.empty(0, np.int64)
         self._smax = np.empty(0, np.int64)
 
-    def run(self, stream: Iterable[Point], dtype=np.float64) -> Iterator[TAggregateResult]:
-        kern = jax.jit(traj_cell_spans_kernel, static_argnames=("num_pairs",))
+    def run(self, stream: Iterable[Point], dtype=np.float64,
+            mesh=None) -> Iterator[TAggregateResult]:
+        mesh = mesh if mesh is not None else self.mesh
+
+        def program(num_pairs):
+            return window_program(
+                mesh, traj_cell_spans_kernel, (0, 1, 2), 3,
+                reduce=True, num_pairs=num_pairs,
+            )
+
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             n = len(win.events)
@@ -375,9 +394,9 @@ class TAggregateQuery(SpatialOperator):
             pair_id = np.zeros(batch.capacity, np.int32)
             pair_id[:n] = inverse.astype(np.int32)
             num_pairs = next_bucket(len(uniq_keys), minimum=64)
-            spans = kern(
+            spans = program(num_pairs)(
                 jnp.asarray(batch.ts), jnp.asarray(pair_id),
-                jnp.asarray(batch.valid), num_pairs=num_pairs,
+                jnp.asarray(batch.valid),
             )
             mn = np.asarray(spans.min_ts)[: len(uniq_keys)]
             mx = np.asarray(spans.max_ts)[: len(uniq_keys)]

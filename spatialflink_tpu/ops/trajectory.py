@@ -148,12 +148,15 @@ def traj_cell_spans_kernel(
     pair_id: jnp.ndarray,
     valid: jnp.ndarray,
     num_pairs: int,
+    axis_name=None,
 ) -> TrajAggregate:
     """Min/max timestamp per dense (cell, objID) pair id.
 
     The batched form of TAggregateQuery's MapState min/max tracking
     (TAggregateQuery.java:150-250): pair ids are host-interned
-    (np.unique over cell*U+oid), the kernel reduces timestamps.
+    (np.unique over cell*U+oid), the kernel reduces timestamps. With
+    ``axis_name`` (inside shard_map) the per-shard reductions
+    pmin/pmax-reduce across the mesh axis.
     """
     big = jnp.iinfo(ts.dtype).max
     small = jnp.iinfo(ts.dtype).min
@@ -163,6 +166,9 @@ def traj_cell_spans_kernel(
     mx = jax.ops.segment_max(
         jnp.where(valid, ts, small), pair_id, num_segments=num_pairs
     )
+    if axis_name is not None:
+        mn = jax.lax.pmin(mn, axis_name)
+        mx = jax.lax.pmax(mx, axis_name)
     return TrajAggregate(mn, mx)
 
 
@@ -171,12 +177,42 @@ def traj_hits_kernel(
     oid: jnp.ndarray,
     valid: jnp.ndarray,
     num_segments: int,
+    axis_name=None,
 ) -> jnp.ndarray:
     """(U,) bool: does any point of each trajectory satisfy the predicate?
 
     Used by tRange: 'if any point of the trajectory is inside any query
     polygon, the whole (windowed) trajectory qualifies'
-    (tRange/PointPolygonTRangeQuery.java:53-177).
+    (tRange/PointPolygonTRangeQuery.java:53-177). With ``axis_name``
+    (inside shard_map) the per-shard segment reduction pmax-reduces across
+    the mesh axis — a trajectory's points may land on any shard.
     """
     hit = (inside_any & valid).astype(jnp.int32)
-    return jax.ops.segment_max(hit, oid, num_segments=num_segments) > 0
+    seg = jax.ops.segment_max(hit, oid, num_segments=num_segments)
+    if axis_name is not None:
+        seg = jax.lax.pmax(seg, axis_name)
+    return seg > 0
+
+
+def traj_range_hits_fused(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_verts: jnp.ndarray,
+    query_edge_valid: jnp.ndarray,
+    num_segments: int,
+    axis_name=None,
+) -> jnp.ndarray:
+    """tRange's fused per-window program: batched containment against the
+    query polygon set + per-trajectory any-hit reduction — single- and
+    multi-chip paths share it (the mesh path all-reduces via the
+    traj_hits_kernel axis hook)."""
+    from spatialflink_tpu.ops.polygon import points_in_polygon
+
+    inside = jax.vmap(
+        lambda v, e: points_in_polygon(xy, v, e)
+    )(query_verts, query_edge_valid)
+    return traj_hits_kernel(
+        jnp.any(inside, axis=0), oid, valid, num_segments,
+        axis_name=axis_name,
+    )

@@ -65,7 +65,8 @@ def mesh_from_config(shape):
 
 
 @functools.lru_cache(maxsize=None)
-def _cached_sharded_window(mesh, kernel, data_idx, n_args, statics, topk):
+def _cached_sharded_window(mesh, kernel, data_idx, n_args, statics, topk,
+                           reduce=False):
     skw = dict(statics)
     in_specs = tuple(
         P("data") if i in data_idx else P() for i in range(n_args)
@@ -76,6 +77,14 @@ def _cached_sharded_window(mesh, kernel, data_idx, n_args, statics, topk):
             return kernel(*args, axis_name="data", index_base=base, **skw)
 
         out_specs = KnnResult(P(), P(), P(), P())
+    elif reduce:
+        # Segment-reduction kernels (e.g. tRange's per-trajectory hit
+        # flags): the kernel's axis_name hook all-reduces its per-shard
+        # segment reduction; the output is replicated.
+        def local(*args):
+            return kernel(*args, axis_name="data", **skw)
+
+        out_specs = P()
     else:
         def local(*args):
             return kernel(*args, **skw)
@@ -88,7 +97,8 @@ def _cached_sharded_window(mesh, kernel, data_idx, n_args, statics, topk):
     return jax.jit(fn)
 
 
-def sharded_window_kernel(mesh, kernel, data_idx, n_args, topk=False, **statics):
+def sharded_window_kernel(mesh, kernel, data_idx, n_args, topk=False,
+                          reduce=False, **statics):
     """jit + shard_map a fused window kernel over a mesh's ``data`` axis.
 
     This is how the operator layer executes on a mesh: the SAME fused
@@ -109,7 +119,7 @@ def sharded_window_kernel(mesh, kernel, data_idx, n_args, topk=False, **statics)
     """
     return _cached_sharded_window(
         mesh, kernel, tuple(data_idx), n_args,
-        tuple(sorted(statics.items())), topk,
+        tuple(sorted(statics.items())), topk, reduce,
     )
 
 

@@ -223,3 +223,56 @@ def test_geometry_stream_operators_mesh(rng, mesh):
         ]
 
     assert run_knn(mesh) == run_knn(None)
+
+
+def test_trange_operator_mesh_matches_single(rng, mesh):
+    from spatialflink_tpu.operators import TRangeQuery
+
+    pts = _points(rng, 100_000, n_obj=256)
+    polys = [
+        Polygon(rings=[np.array([[3, 3], [4.5, 3], [4.5, 4.5], [3, 4.5],
+                                 [3, 3]], float)]),
+        Polygon(rings=[np.array([[6, 6], [8, 6], [8, 8], [6, 8],
+                                 [6, 6]], float)]),
+    ]
+
+    def run(m):
+        return [
+            (res.start, res.end,
+             sorted(t.obj_id for t in res.trajectories))
+            for res in TRangeQuery(W, GRID).run(iter(pts), polys, mesh=m)
+        ]
+
+    assert run(None) == run(mesh)
+
+
+def test_tknn_operator_mesh_matches_single(rng, mesh):
+    from spatialflink_tpu.operators import TKNNQuery
+
+    pts = _points(rng, 100_000, n_obj=256)
+    q = Point(x=5.0, y=5.0)
+
+    def run(m):
+        return [
+            (res.start, res.end,
+             [(o, round(d, 12)) for o, d, _ in res.neighbors])
+            for res in TKNNQuery(W, GRID).run(iter(pts), q, 2.0, 7, mesh=m)
+        ]
+
+    assert run(None) == run(mesh)
+
+
+def test_taggregate_operator_mesh_matches_single(rng, mesh):
+    from spatialflink_tpu.operators import TAggregateQuery
+
+    pts = _points(rng, 100_000, n_obj=128)
+
+    def run(m):
+        out = []
+        for res in TAggregateQuery(W, GRID, aggregate="SUM").run(
+            iter(pts), mesh=m
+        ):
+            out.append((res.start, res.end, sorted(res.cells.items())))
+        return out
+
+    assert run(None) == run(mesh)
