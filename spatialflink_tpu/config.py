@@ -98,6 +98,7 @@ class QueryConfig:
     query_linestrings: List[List[List[float]]] = field(default_factory=list)
     traj_deletion_threshold: int = 0
     out_of_order_tuples: int = 0
+    incremental: bool = False  # extension: pane/ListState-carry execution
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "QueryConfig":
@@ -128,6 +129,7 @@ class QueryConfig:
             ],
             traj_deletion_threshold=int(th.get("trajDeletion", 0)),
             out_of_order_tuples=int(th.get("outOfOrderTuples", 0)),
+            incremental=bool(d.get("incremental", False)),
         )
 
 

@@ -115,17 +115,41 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
 
     mesh = mesh_from_config(params.device_mesh)
 
+    # query.incremental (extension): pane/ListState-carry execution —
+    # range rides query_incremental (PointPointRangeQuery.java:195-296's
+    # analog), kNN/join ride the pane-digest/pane-block carries. Sliding
+    # windows only; incompatible with a mesh (the carries are
+    # single-device paths). Configurations the carries cannot serve
+    # (size not a slide multiple) fall back to full recomputation rather
+    # than erroring — the flag selects an execution strategy, never a
+    # semantics change.
+    incremental = (
+        bool(getattr(q, "incremental", False))
+        and mesh is None
+        and params.window.interval % max(params.window.step, 1) == 0
+    )
+
     if option in (1, 2):
         conf = window_conf if option == 1 else realtime_conf
         op = PointPointRangeQuery(conf, grid, mesh=mesh)
-        for res in op.run(source, q_points, q.radius):
+        if option == 1 and incremental and len(q_points) == 1:
+            # The carry protocol is single-query (like the reference's
+            # one incremental variant); query sets take the full path.
+            results = op.query_incremental(source, q_points[0], q.radius)
+        else:
+            results = op.run(source, q_points, q.radius)
+        for res in results:
             for p, d in zip(res.objects, res.dists):
                 sink(f"{res.start},{res.end},{p.obj_id},{float(p.x)!r},{float(p.y)!r},{float(d)!r}")
                 n += 1
     elif option in (3, 4):
         conf = window_conf if option == 3 else realtime_conf
         op = PointPointKNNQuery(conf, grid, mesh=mesh)
-        for res in op.run(source, q_points[0], q.radius, q.k):
+        if option == 3 and incremental:
+            results = op.query_panes(source, q_points[0], q.radius, q.k)
+        else:
+            results = op.run(source, q_points[0], q.radius, q.k)
+        for res in results:
             for oid, d, p in res.neighbors:
                 sink(f"{res.start},{res.end},{oid},{float(d)!r}")
                 n += 1
@@ -133,7 +157,12 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
         op = PointPointJoinQuery(window_conf, grid, mesh=mesh)
         events = list(source)
         half = len(events) // 2
-        for res in op.run(iter(events[:half]), iter(events[half:]), q.radius):
+        left, right = iter(events[:half]), iter(events[half:])
+        if incremental:
+            results = op.query_panes(left, right, q.radius)
+        else:
+            results = op.run(left, right, q.radius)
+        for res in results:
             for a, b, d in res.pairs:
                 sink(f"{res.start},{res.end},{a.obj_id},{b.obj_id},{float(d)!r}")
                 n += 1

@@ -192,3 +192,49 @@ window:
                    "--output", str(out)])
         assert rc == 0
         assert out.read_text().strip(), f"option {opt} produced no output"
+
+
+def test_streaming_job_incremental_flag_matches_full(tmp_path):
+    """query.incremental: true routes options 1/3/5 through the carry
+    paths; CLI output must equal the full-recompute run line for line
+    (order-insensitive for the join's block-major ordering)."""
+    from spatialflink_tpu.streaming_job import main
+
+    base = """
+inputStream1:
+  topicName: t
+  format: CSV
+  csvTsvSchemaAttr: [0, 1, 2, 3]
+  gridBBox: [0.0, 0.0, 10.0, 10.0]
+  numGridCells: 20
+  delimiter: ","
+query:
+  option: {opt}
+  radius: 3.0
+  k: 4
+  incremental: {inc}
+  aggregateFunction: "SUM"
+  queryPoints:
+    - [5.0, 5.0]
+window:
+  type: "TIME"
+  interval: 10
+  step: 5
+"""
+    csv = tmp_path / "in.csv"
+    csv.write_text("\n".join(
+        f"dev{i%5},{i * 120},{3 + 0.05*(i % 60)},{4 + 0.03*(i % 60)}"
+        for i in range(160)
+    ))
+    for opt in (1, 3, 5):
+        outs = {}
+        for inc in ("false", "true"):
+            conf = tmp_path / f"c{opt}_{inc}.yml"
+            conf.write_text(base.format(opt=opt, inc=inc))
+            out = tmp_path / f"o{opt}_{inc}.csv"
+            rc = main(["--config", str(conf), "--source", f"csv:{csv}",
+                       "--output", str(out)])
+            assert rc == 0
+            outs[inc] = sorted(out.read_text().strip().splitlines())
+        assert outs["false"] == outs["true"], f"option {opt}"
+        assert outs["true"], f"option {opt} produced no output"
