@@ -228,10 +228,15 @@ class TJoinQuery(SpatialOperator):
     distance is the *minimum* point distance in the window — same pair set,
     a strictly more informative representative (documented deviation).
     ``run_single`` self-joins a stream (PointPointTJoinQuery.runSingle:57).
+
+    ``mesh=`` executes the point-pair join shard_mapped (the dedup stage
+    runs on the compacted pairs). Like PointPointJoinQuery, results are
+    exact iff no cell exceeds ``cap`` — under a mesh the cap applies per
+    shard, so overcapacity windows can differ from single-device.
     """
 
-    def __init__(self, conf, grid, cap: int = 64):
-        super().__init__(conf, grid)
+    def __init__(self, conf, grid, cap: int = 64, mesh=None):
+        super().__init__(conf, grid, mesh=mesh)
         self.cap = cap
         self._max_pairs = 0
         self._max_tpairs = 256
@@ -242,9 +247,11 @@ class TJoinQuery(SpatialOperator):
         query_stream: Iterable[Point],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[TJoinResult]:
         from spatialflink_tpu.operators.join_query import grid_hash_join_batches
 
+        mesh = mesh if mesh is not None else self.mesh
         merged = (
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(stream, query_stream)
@@ -270,7 +277,7 @@ class TJoinQuery(SpatialOperator):
             while True:
                 res = grid_hash_join_batches(
                     self.grid, lb, rb, radius, self.cap, offsets,
-                    max_pairs=self._max_pairs, dtype=dtype,
+                    max_pairs=self._max_pairs, dtype=dtype, mesh=mesh,
                 )
                 if int(res.count) <= self._max_pairs:
                     break

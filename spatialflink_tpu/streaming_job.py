@@ -121,12 +121,15 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
     # windows only; incompatible with a mesh (the carries are
     # single-device paths). Configurations the carries cannot serve
     # (size not a slide multiple) fall back to full recomputation rather
-    # than erroring — the flag selects an execution strategy, never a
-    # semantics change.
+    # than erroring. NB the carry contracts (documented on each method):
+    # in-order streams, and for the join exactness only at overflow == 0
+    # (the per-cell cap applies per pane) — same results as run() within
+    # those contracts, not beyond them.
     incremental = (
         bool(getattr(q, "incremental", False))
         and mesh is None
-        and params.window.interval % max(params.window.step, 1) == 0
+        and window_conf.window_size_ms
+        % max(window_conf.slide_step_ms, 1) == 0
     )
 
     if option in (1, 2):
