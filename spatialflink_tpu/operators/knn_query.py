@@ -327,17 +327,24 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         radius: float,
         k: int,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[MultiKnnWindowResult]:
         """Batched multi-query kNN: ONE fused program per window answers
         the whole query-point set (ops/knn.py:knn_multi_query_kernel),
         instead of one program per query point — the kNN analog of the
         range family's query-set batching. Each query prunes by its own
         neighbor-cell flag table, so per-query results are identical to
-        ``run()`` with that single query (parity test)."""
+        ``run()`` with that single query (parity test).
+
+        ``mesh=``: points shard over ``data``; a 2-D mesh additionally
+        shards the query batch and its flag tables over ``query``
+        (parallel/sharded.py:sharded_knn_multi; winner order matches
+        single-device, distances to 1 ulp)."""
         from spatialflink_tpu.ops.knn import knn_multi_query_kernel
 
         from spatialflink_tpu.utils.padding import pad_to_bucket
 
+        mesh = mesh if mesh is not None else self.mesh
         nq = len(query_points)
         if nq == 0:
             return
@@ -345,6 +352,12 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             [flags_for_queries(self.grid, radius, [q]) for q in query_points]
         )
         qb = next_bucket(nq, minimum=8)
+        if mesh is not None:
+            # The padded query count must divide by the query axis (which
+            # need not be a power of two — round up to a multiple).
+            qa = int(mesh.shape.get("query", 1))
+            if qb % qa:
+                qb = ((qb // qa) + 1) * qa
         block = min(qb, 32)
         # Padded query lanes carry zero flag tables → empty results.
         tables = pad_to_bucket(tables, qb)
@@ -360,16 +373,24 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            res = kernel(
+            args = (
                 self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell),
                 tables_d,
                 jnp.asarray(batch.oid),
                 q_d,
-                radius,
-                k=k, num_segments=nseg, query_block=block,
             )
+            if mesh is not None:
+                from spatialflink_tpu.parallel.sharded import sharded_knn_multi
+
+                res = sharded_knn_multi(
+                    mesh, *args, radius, k=k, num_segments=nseg,
+                )
+            else:
+                res = kernel(
+                    *args, radius, k=k, num_segments=nseg, query_block=block,
+                )
             segs = np.asarray(res.segment)  # (Q, k) bulk fetches
             dists = np.asarray(res.dist)
             idxs = np.asarray(res.index)

@@ -3,6 +3,7 @@ from spatialflink_tpu.parallel.sharded import (  # noqa: F401
     sharded_range_query,
     sharded_range_query_2d,
     sharded_knn,
+    sharded_knn_multi,
     sharded_join,
     sharded_traj_stats,
 )

@@ -223,6 +223,80 @@ def sharded_knn(
     return fn(xy, valid, flags, oid, query_xy)
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_knn_multi(mesh, k, num_segments, query_sharded):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+    from spatialflink_tpu.ops.knn import _topk_from_point_dists
+
+    def local(xy_l, valid_l, cell_l, ft_l, oid_l, q_l, radius):
+        base = jax.lax.axis_index("data") * xy_l.shape[0]
+
+        def one(q_xy, ftab):
+            dist = point_point_distance(xy_l, q_xy[None, :])
+            return _topk_from_point_dists(
+                dist, valid_l, gather_cell_flags(cell_l, ftab), oid_l,
+                radius, k, num_segments,
+                axis_name="data", index_base=base,
+            )
+
+        # Same query blocking as knn_multi_query_kernel: vmap only
+        # ``block`` query lanes at a time under lax.map so peak memory is
+        # O(block × N_local), not O(Q_local × N_local).
+        q_total = q_l.shape[0]
+        block = next(b for b in (32, 16, 8, 4, 2, 1) if q_total % b == 0)
+
+        def blk(args):
+            q_b, f_b = args
+            return jax.vmap(one)(q_b, f_b)
+
+        res = jax.lax.map(
+            blk,
+            (
+                q_l.reshape(-1, block, 2),
+                ft_l.reshape(q_total // block, block, -1),
+            ),
+        )
+        return KnnResult(*[x.reshape((q_total,) + x.shape[2:]) for x in res])
+
+    qspec = P("query") if query_sharded else P()
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("data"), P("data"), P("data"), qspec, P("data"), qspec, P(),
+        ),
+        out_specs=KnnResult(qspec, qspec, qspec, qspec),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_knn_multi(
+    mesh: Mesh,
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    flags_tables: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    radius,
+    k: int,
+    num_segments: int,
+) -> KnnResult:
+    """Sharded MULTI-query kNN: points over ``data``; with a 2-D mesh the
+    query batch (and its per-query flag tables) additionally shards over
+    ``query``. Each (data[, query]) tile answers its query slice against
+    its point shard; per-object minima pmin-reduce over ``data`` (batched
+    collective under vmap — one ICI all-reduce per query lane), and the
+    (Q, k) results stay sharded over ``query`` (replicated on 1-D
+    meshes). The scale-out form of ops/knn.py:knn_multi_query_kernel for
+    query sets too large for one chip's flag-table memory. On a 2-D mesh
+    Q must divide the query-axis size."""
+    query_sharded = "query" in mesh.shape
+    fn = _cached_knn_multi(mesh, k, num_segments, query_sharded)
+    return fn(xy, valid, cell, flags_tables, oid, query_xy, radius)
+
+
 def sharded_traj_stats(
     mesh: Mesh,
     xy: jnp.ndarray,

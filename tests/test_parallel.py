@@ -156,3 +156,44 @@ def test_sequence_parallel_traj_stats_matches_single(rng, mesh):
     np.testing.assert_array_equal(np.asarray(tp), np.asarray(single.temporal_length))
     np.testing.assert_array_equal(np.asarray(cnt), np.asarray(single.count))
     np.testing.assert_allclose(np.asarray(speed), np.asarray(single.avg_speed), rtol=1e-12)
+
+
+def test_sharded_knn_multi_matches_single(rng):
+    """2-D mesh multi-query kNN (points over data, queries over query)
+    must equal the single-device knn_multi_query_kernel row for row."""
+    from spatialflink_tpu.ops.knn import knn_multi_query_kernel
+    from spatialflink_tpu.parallel import sharded_knn_multi
+
+    mesh2d = make_mesh((4, 2), ("data", "query"))
+    batch = make_batch(rng, n=2000, bucket=2048)
+    nq, k, r = 8, 5, 2.5
+    qxy = rng.uniform(0, 10, (nq, 2))
+    tables = np.stack(
+        [GRID.neighbor_flags(r, [GRID.flat_cell(*p)]) for p in qxy]
+    )
+
+    single = jax.jit(
+        knn_multi_query_kernel,
+        static_argnames=("k", "num_segments", "query_block"),
+    )(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(batch.cell), jnp.asarray(tables), jnp.asarray(batch.oid),
+        jnp.asarray(qxy), r, k=k, num_segments=128, query_block=4,
+    )
+    sharded = sharded_knn_multi(
+        mesh2d, jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(batch.cell), jnp.asarray(tables), jnp.asarray(batch.oid),
+        jnp.asarray(qxy), r, k=k, num_segments=128,
+    )
+    np.testing.assert_array_equal(np.asarray(sharded.segment),
+                                  np.asarray(single.segment))
+    np.testing.assert_array_equal(np.asarray(sharded.index),
+                                  np.asarray(single.index))
+    # Winner sets/order are identical; raw distances may differ by 1 ulp
+    # (the blocked lax.map single-device program and the per-tile sharded
+    # program contract FMAs differently on CPU — same caveat as sharded
+    # TStats' reassociated sums, PARITY.md mesh row).
+    np.testing.assert_allclose(np.asarray(sharded.dist),
+                               np.asarray(single.dist), rtol=5e-16)
+    np.testing.assert_array_equal(np.asarray(sharded.num_valid),
+                                  np.asarray(single.num_valid))

@@ -302,3 +302,22 @@ def test_tjoin_operator_mesh_matches_single(rng, mesh):
         ]
 
     assert run(None) == run(mesh)
+
+
+def test_run_multi_mesh_matches_single(rng, mesh):
+    """run_multi on a 1-D data mesh (replicated queries) must produce the
+    same per-query winner lists as single-device (distances to 1 ulp)."""
+    pts = _points(rng, 80_000, n_obj=256)
+    queries = [Point(x=2.0, y=2.0), Point(x=5.0, y=5.0), Point(x=8.0, y=7.0)]
+
+    def run(m):
+        return [
+            (res.start, res.end,
+             [[(o, round(d, 12)) for o, d, _ in r.neighbors]
+              for r in res.results])
+            for res in PointPointKNNQuery(W, GRID).run_multi(
+                iter(pts), queries, 1.5, 6, mesh=m
+            )
+        ]
+
+    assert run(None) == run(mesh)
