@@ -126,6 +126,68 @@ class GeometryBatch:
         return self.verts.shape[0]
 
     @classmethod
+    def from_ragged(
+        cls,
+        ts: np.ndarray,
+        oid: np.ndarray,
+        lengths: np.ndarray,
+        verts_flat: np.ndarray,
+        bucket: Optional[int] = None,
+        vert_bucket: Optional[int] = None,
+        dtype=np.float64,
+    ) -> "GeometryBatch":
+        """Vectorized batch build from ragged SoA arrays — the geometry
+        analog of the point SoA fast path: no per-object Python.
+
+        ``lengths[i]`` vertices of object ``i`` occupy the corresponding
+        run of ``verts_flat``, as one PACKED boundary chain (closed ring
+        for polygons — ``pack_rings``' contract — open for polylines).
+        Single-chain objects only; multi-ring geometries need
+        ``from_objects``. ``oid`` must already be dense int32.
+        """
+        n = len(ts)
+        lengths = np.asarray(lengths, np.int64)
+        if n and int(lengths.min()) < 2:
+            raise ValueError(
+                "from_ragged requires every chain length >= 2 (a zero-"
+                "length run would corrupt the reduceat bboxes silently)"
+            )
+        verts_flat = np.asarray(verts_flat, np.float64)
+        offsets = np.concatenate([[0], np.cumsum(lengths)])
+        total = int(offsets[-1])
+        vmax = int(lengths.max()) if n else 2
+        v = vert_bucket if vert_bucket is not None else next_bucket(
+            max(vmax, 2), minimum=8)
+
+        lane = np.arange(v)
+        gather = np.minimum(offsets[:-1, None] + lane[None, :],
+                            max(total - 1, 0))
+        mask = lane[None, :] < lengths[:, None]  # (n, v)
+        verts = np.where(
+            mask[:, :, None], verts_flat[gather], 0.0
+        ).astype(dtype)
+        ev = lane[None, : v - 1] < (lengths - 1)[:, None]
+
+        # Per-object bbox via ragged reduceat (empty-safe: n>0 runs only).
+        if n:
+            red_idx = offsets[:-1]
+            mins = np.minimum.reduceat(verts_flat, red_idx, axis=0)
+            maxs = np.maximum.reduceat(verts_flat, red_idx, axis=0)
+            boxes = np.concatenate([mins, maxs], axis=1).astype(dtype)
+        else:
+            boxes = np.zeros((0, 4), dtype)
+
+        b = bucket if bucket is not None else next_bucket(n, minimum=8)
+        return cls(
+            verts=pad_to_bucket(verts, b),
+            edge_valid=pad_to_bucket(ev, b, fill=False),
+            bbox=pad_to_bucket(boxes, b),
+            ts=pad_to_bucket(np.asarray(ts, np.int64), b),
+            oid=pad_to_bucket(np.asarray(oid, np.int32), b),
+            valid=pad_to_bucket(np.ones(n, bool), b, fill=False),
+        )
+
+    @classmethod
     def from_objects(
         cls,
         objs: Sequence[Polygon | LineString],

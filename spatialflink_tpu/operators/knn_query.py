@@ -519,6 +519,18 @@ class _GeometryStreamKNNQuery(SpatialOperator):
 
     stream_polygonal = True  # Polygon* subclasses; LineString* override
 
+    def _query_arrays(self, query_obj):
+        """(qverts, qev, query_polygonal) — a Point query packs as a
+        degenerate one-edge boundary. Shared by run() and run_soa()."""
+        if isinstance(query_obj, Point):
+            qverts = np.asarray(
+                [[query_obj.x, query_obj.y], [query_obj.x, query_obj.y]],
+                np.float64,
+            )
+            return qverts, np.asarray([True], bool), False
+        verts, ev = pack_query_geometries([query_obj], np.float64)
+        return verts[0], ev[0], isinstance(query_obj, Polygon)
+
     def run(
         self,
         stream: Iterable[Polygon | LineString],
@@ -530,17 +542,7 @@ class _GeometryStreamKNNQuery(SpatialOperator):
     ) -> Iterator[KnnWindowResult]:
         mesh = mesh if mesh is not None else self.mesh
         flags = flags_for_queries(self.grid, radius, [query_obj])
-        if isinstance(query_obj, Point):
-            qverts = np.asarray(
-                [[query_obj.x, query_obj.y], [query_obj.x, query_obj.y]],
-                np.float64,
-            )
-            qev = np.asarray([True], bool)
-            query_polygonal = False
-        else:
-            verts, ev = pack_query_geometries([query_obj], np.float64)
-            qverts, qev = verts[0], ev[0]
-            query_polygonal = isinstance(query_obj, Polygon)
+        qverts, qev, query_polygonal = self._query_arrays(query_obj)
         qv = self.device_verts(qverts, dtype)
         qe = jnp.asarray(qev)
 
@@ -580,6 +582,70 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 for i in range(nv)
             ]
             yield KnnWindowResult(win.start, win.end, neighbors, len(win.events))
+
+
+    def run_soa(
+        self,
+        chunks,
+        query_obj: SpatialObject,
+        radius: float,
+        k: int,
+        num_segments: int,
+        dtype=np.float64,
+    ):
+        """Ragged-SoA fast path for geometry-stream kNN: chunks
+        ``{"ts","oid","lengths","verts"}`` → per-window
+        (start, end, oids, dists, num_valid) through the same
+        knn_geometry_query_kernel as ``run()``, zero per-object Python."""
+        from spatialflink_tpu.models.batch import (
+            GeometryBatch,
+            flag_prefix_planes,
+        )
+        from spatialflink_tpu.streams.soa import RaggedSoaWindowAssembler
+
+        flags = flags_for_queries(self.grid, radius, [query_obj])
+        qverts, qev, query_polygonal = self._query_arrays(query_obj)
+        qv = self.device_verts(qverts, dtype)
+        qe = jnp.asarray(qev)
+        kg = functools.partial(
+            jitted(
+                knn_geometry_query_kernel,
+                "k", "num_segments", "obj_polygonal", "query_polygonal",
+            ),
+            k=k, num_segments=num_segments,
+            obj_polygonal=self.stream_polygonal,
+            query_polygonal=query_polygonal,
+        )
+
+        prefix = flag_prefix_planes(self.grid, flags)
+        asm = RaggedSoaWindowAssembler(
+            self.conf.window_size_ms, self.conf.slide_step_ms,
+            ooo_ms=self.conf.allowed_lateness_ms,
+        )
+        for win in asm.stream(chunks):
+            if win.count and int(win.oid.max()) >= num_segments:
+                raise ValueError(
+                    f"oid {int(win.oid.max())} >= num_segments "
+                    f"{num_segments}: out-of-range ids would be silently "
+                    "dropped by the segment reduction"
+                )
+            batch = GeometryBatch.from_ragged(
+                win.ts, win.oid, win.lengths, win.verts, dtype=np.float64
+            )
+            oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
+            res = kg(
+                self.device_verts(batch.verts, dtype),
+                jnp.asarray(batch.edge_valid),
+                jnp.asarray(batch.valid),
+                jnp.asarray(oflags),
+                jnp.asarray(batch.oid),
+                qv, qe, radius,
+            )
+            nv = int(res.num_valid)
+            yield (
+                win.start, win.end,
+                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
+            )
 
 
 class PolygonPointKNNQuery(_GeometryStreamKNNQuery):

@@ -274,6 +274,24 @@ class _GeometryStreamRangeQuery(SpatialOperator):
     query_kind = "point"
     stream_polygonal = True
 
+    def _kernel_statics(self):
+        return dict(
+            approximate=self.conf.approximate_query,
+            obj_polygonal=self.stream_polygonal,
+            query_polygonal=self.query_kind == "polygon",
+        )
+
+    def _query_arrays(self, query_set):
+        """(qverts, qev) for the packed query set — points become
+        degenerate 2-vertex polylines. Shared by run() and run_soa()."""
+        if self.query_kind == "point":
+            q = pack_query_points(query_set, np.float64)
+            return (
+                np.repeat(q[:, None, :], 2, axis=1),
+                np.ones((len(query_set), 1), bool),
+            )
+        return pack_query_geometries(query_set, np.float64)
+
     def run(
         self,
         stream: Iterable[Polygon | LineString],
@@ -286,11 +304,7 @@ class _GeometryStreamRangeQuery(SpatialOperator):
         if not isinstance(query_set, (list, tuple)):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
-        statics = dict(
-            approximate=self.conf.approximate_query,
-            obj_polygonal=self.stream_polygonal,
-            query_polygonal=self.query_kind == "polygon",
-        )
+        statics = self._kernel_statics()
         if mesh is not None:
             from spatialflink_tpu.parallel.sharded import sharded_window_kernel
 
@@ -305,13 +319,7 @@ class _GeometryStreamRangeQuery(SpatialOperator):
                 ),
                 **statics,
             )
-        if self.query_kind == "point":
-            # Points as degenerate 2-vertex polylines.
-            q = pack_query_points(query_set, np.float64)
-            qverts = np.repeat(q[:, None, :], 2, axis=1)
-            qev = np.ones((len(query_set), 1), bool)
-        else:
-            qverts, qev = pack_query_geometries(query_set, np.float64)
+        qverts, qev = self._query_arrays(query_set)
         qv, qe = self.device_verts(qverts, dtype), jnp.asarray(qev)
 
         from spatialflink_tpu.models.batch import flag_prefix_planes
@@ -334,6 +342,62 @@ class _GeometryStreamRangeQuery(SpatialOperator):
             idx = np.nonzero(keep)[0]
             objs = [win.events[i] for i in idx]
             yield RangeResult(win.start, win.end, objs, dist[idx], len(win.events))
+
+    def run_soa(
+        self,
+        chunks,
+        query_set: Sequence[SpatialObject],
+        radius: float,
+        dtype=np.float64,
+    ):
+        """Ragged-SoA fast path: geometry chunks
+        ``{"ts","oid","lengths","verts"}`` (packed single boundary chains,
+        dense int32 oids) → per-window (start, end, kept_indices,
+        kept_oids, dists, window_count) arrays through the SAME fused
+        kernel as ``run()`` with zero per-object Python
+        (GeometryBatch.from_ragged + RaggedSoaWindowAssembler)."""
+        from spatialflink_tpu.models.batch import (
+            GeometryBatch,
+            flag_prefix_planes,
+        )
+        from spatialflink_tpu.streams.soa import RaggedSoaWindowAssembler
+
+        if not isinstance(query_set, (list, tuple)):
+            query_set = [query_set]
+        flags = flags_for_queries(self.grid, radius, query_set)
+        gk = functools.partial(
+            jitted(
+                geometry_range_query_kernel,
+                "approximate", "obj_polygonal", "query_polygonal",
+            ),
+            **self._kernel_statics(),
+        )
+        qverts, qev = self._query_arrays(query_set)
+        qv, qe = self.device_verts(qverts, dtype), jnp.asarray(qev)
+
+        prefix = flag_prefix_planes(self.grid, flags)
+        asm = RaggedSoaWindowAssembler(
+            self.conf.window_size_ms, self.conf.slide_step_ms,
+            ooo_ms=self.conf.allowed_lateness_ms,
+        )
+        for win in asm.stream(chunks):
+            batch = GeometryBatch.from_ragged(
+                win.ts, win.oid, win.lengths, win.verts, dtype=np.float64
+            )
+            oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
+            keep, dist = gk(
+                self.device_verts(batch.verts, dtype),
+                jnp.asarray(batch.edge_valid),
+                jnp.asarray(batch.valid),
+                jnp.asarray(oflags),
+                qv, qe, radius,
+            )
+            keep = np.asarray(keep)
+            idx = np.nonzero(keep)[0]
+            yield (
+                win.start, win.end, idx, win.oid[idx],
+                np.asarray(dist)[idx], win.count,
+            )
 
 
 class PolygonPointRangeQuery(_GeometryStreamRangeQuery):

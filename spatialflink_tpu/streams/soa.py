@@ -21,6 +21,13 @@ from typing import Dict, Iterable, Iterator, List, Optional
 import numpy as np
 
 
+def earliest_window_of(ts_val: int, size: int, slide: int) -> int:
+    """Start of the earliest sliding window containing ``ts_val`` — the one
+    firing-semantics formula both SoA assemblers share."""
+    last = ts_val - ((ts_val % slide) + slide) % slide
+    return last - size + slide
+
+
 @dataclass
 class SoaWindow:
     """One fired window: [start, end) and its event arrays."""
@@ -87,9 +94,7 @@ class SoaWindowAssembler:
         return merged
 
     def _earliest_window_of(self, ts_val: int) -> int:
-        """Start of the earliest window containing ts_val."""
-        last = ts_val - ((ts_val % self.slide) + self.slide) % self.slide
-        return last - self.size + self.slide
+        return earliest_window_of(ts_val, self.size, self.slide)
 
     def _fire(self, wm: int) -> List[SoaWindow]:
         out: List[SoaWindow] = []
@@ -152,3 +157,144 @@ def csv_chunk_source(path: str, parser, chunk_bytes: int = 1 << 22):
                 continue
             rest = block[cut + 1:]
             yield parser.parse(block[: cut + 1])
+
+
+def _ragged_reorder(flat: np.ndarray, lengths: np.ndarray, order: np.ndarray):
+    """Reorder a ragged array (``flat`` rows grouped into ``lengths``-sized
+    runs) by a per-group ``order`` — fully vectorized."""
+    starts = np.concatenate([[0], np.cumsum(lengths)])[:-1]
+    new_lens = lengths[order]
+    total = int(new_lens.sum())
+    pos_base = np.repeat(np.cumsum(new_lens) - new_lens, new_lens)
+    src = (
+        np.repeat(starts[order], new_lens)
+        + np.arange(total, dtype=np.int64)
+        - pos_base
+    )
+    return flat[src], new_lens
+
+
+@dataclass
+class RaggedSoaWindow:
+    """One fired geometry window: object rows + their flat boundary chains.
+
+    ``lengths[i]`` vertices of object ``i`` occupy
+    ``verts[offsets[i]:offsets[i+1]]`` where ``offsets = cumsum``.
+    """
+
+    start: int
+    end: int
+    ts: np.ndarray  # (n,)
+    oid: np.ndarray  # (n,) dense int32
+    lengths: np.ndarray  # (n,)
+    verts: np.ndarray  # (sum lengths, 2)
+
+    @property
+    def count(self) -> int:
+        return len(self.ts)
+
+
+class RaggedSoaWindowAssembler:
+    """Sliding event-time windows over ragged GEOMETRY chunks.
+
+    Chunks are ``{"ts": (n,), "oid": (n,), "lengths": (n,),
+    "verts": (sum lengths, 2)}`` — each object's packed single boundary
+    chain (closed ring for polygons, open for polylines; multi-ring
+    objects need the object path). Watermark/firing semantics match
+    SoaWindowAssembler: wm = max_ts − ooo, a window fires once when the
+    watermark passes its end, late rows are dropped and counted.
+    """
+
+    def __init__(self, size_ms: int, slide_ms: int, ooo_ms: int = 0):
+        if size_ms <= 0 or slide_ms <= 0:
+            raise ValueError("size and slide must be positive")
+        self.size = int(size_ms)
+        self.slide = int(slide_ms)
+        self.ooo = int(ooo_ms)
+        self._rows: List[Dict[str, np.ndarray]] = []
+        self._verts: List[np.ndarray] = []
+        self._max_ts: Optional[int] = None
+        self._next_start: Optional[int] = None
+        self.dropped_late = 0
+
+    def feed(self, chunk: Dict[str, np.ndarray]) -> List[RaggedSoaWindow]:
+        ts = np.asarray(chunk["ts"], np.int64)
+        if len(ts) == 0:
+            return []
+        self._rows.append({
+            "ts": ts,
+            "oid": np.asarray(chunk["oid"], np.int32),
+            "lengths": np.asarray(chunk["lengths"], np.int64),
+        })
+        self._verts.append(np.asarray(chunk["verts"], np.float64))
+        mx = int(ts.max())
+        if self._max_ts is None or mx > self._max_ts:
+            self._max_ts = mx
+        if self._next_start is None:
+            horizon = min(int(ts.min()), self._max_ts - self.ooo)
+            self._next_start = earliest_window_of(horizon, self.size, self.slide)
+        return self._fire(self._max_ts - self.ooo)
+
+    def flush(self) -> List[RaggedSoaWindow]:
+        if self._max_ts is None:
+            return []
+        return self._fire(self._max_ts + self.size + 1)
+
+    def _consolidate(self):
+        if len(self._rows) > 1:
+            rows = {
+                k: np.concatenate([c[k] for c in self._rows])
+                for k in ("ts", "oid", "lengths")
+            }
+            verts = np.concatenate(self._verts)
+        else:
+            rows = self._rows[0]
+            verts = self._verts[0]
+        order = np.argsort(rows["ts"], kind="stable")
+        if not np.array_equal(order, np.arange(len(order))):
+            verts, _ = _ragged_reorder(verts, rows["lengths"], order)
+            rows = {k: v[order] for k, v in rows.items()}
+        self._rows = [rows]
+        self._verts = [verts]
+        return rows, verts
+
+    def _fire(self, wm: int) -> List[RaggedSoaWindow]:
+        out: List[RaggedSoaWindow] = []
+        if self._next_start is None or self._next_start + self.size > wm:
+            return out
+        rows, verts = self._consolidate()
+        ts = rows["ts"]
+        offsets = np.concatenate([[0], np.cumsum(rows["lengths"])])
+        late = int(np.searchsorted(ts, self._next_start, side="left"))
+        if late:
+            self.dropped_late += late
+        while self._next_start + self.size <= wm:
+            s, e = self._next_start, self._next_start + self.size
+            lo = int(np.searchsorted(ts, s, side="left"))
+            hi = int(np.searchsorted(ts, e, side="left"))
+            if hi > lo:
+                out.append(RaggedSoaWindow(
+                    s, e, ts[lo:hi], rows["oid"][lo:hi],
+                    rows["lengths"][lo:hi],
+                    verts[offsets[lo]:offsets[hi]],
+                ))
+                self._next_start += self.slide
+            elif lo < len(ts):
+                self._next_start = max(
+                    self._next_start + self.slide,
+                    earliest_window_of(int(ts[lo]), self.size, self.slide),
+                )
+            else:
+                self._next_start += self.slide
+                break
+        keep_from = int(np.searchsorted(ts, self._next_start, side="left"))
+        if keep_from:
+            self._rows = [{k: v[keep_from:] for k, v in rows.items()}]
+            self._verts = [verts[offsets[keep_from]:]]
+        return out
+
+    def stream(self, chunks: Iterable[Dict[str, np.ndarray]]
+               ) -> Iterator[RaggedSoaWindow]:
+        for c in chunks:
+            yield from self.feed(c)
+        yield from self.flush()

@@ -198,3 +198,104 @@ def test_soa_knn_panes_matches_run_soa(rng):
     pane = collect(PointPointKNNQuery(conf, GRID).run_soa_panes(
         _chunks(ts, xs, ys, oids), q, r, k, num_segments=64))
     assert full == pane
+
+
+def _geoms_to_ragged_chunks(geoms, interner, n_chunks=4):
+    """Objects → ragged SoA chunks via each object's own packed() chain
+    (the from_ragged contract: single closed/open boundary chains)."""
+    rows = []
+    for g in geoms:
+        pv, pe = g.packed()
+        ln = int(pe.sum()) + 1  # valid chain length
+        rows.append((g.timestamp, interner.intern(g.obj_id), pv[:ln]))
+    bounds = np.linspace(0, len(rows), n_chunks + 1).astype(int)
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        part = rows[a:b]
+        if not part:
+            continue
+        yield {
+            "ts": np.array([r[0] for r in part], np.int64),
+            "oid": np.array([r[1] for r in part], np.int32),
+            "lengths": np.array([len(r[2]) for r in part], np.int64),
+            "verts": np.concatenate([r[2] for r in part]),
+        }
+
+
+def test_geometry_soa_range_matches_object_path(rng):
+    """Ragged-SoA geometry range == object path, including bbox pruning
+    and polygon containment semantics."""
+    from spatialflink_tpu.models.objects import Polygon
+    from spatialflink_tpu.operators import PolygonPointRangeQuery
+    from spatialflink_tpu.utils.interning import Interner
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    polys = []
+    for i in range(120):
+        cx, cy = rng.uniform(1, 9), rng.uniform(1, 9)
+        s = rng.uniform(0.1, 0.4)
+        polys.append(Polygon(
+            obj_id=f"poly{i}", timestamp=int(i * 250),
+            rings=[np.array([[cx - s, cy - s], [cx + s, cy - s],
+                             [cx + s, cy + s], [cx - s, cy + s],
+                             [cx - s, cy - s]])],
+        ))
+    q = Point(x=5.0, y=5.0)
+    r = 1.2
+
+    obj_op = PolygonPointRangeQuery(conf, GRID)
+    obj_res = {
+        (res.start, res.end): sorted(
+            (p.obj_id, round(float(d), 12))
+            for p, d in zip(res.objects, res.dists)
+        )
+        for res in obj_op.run(iter(polys), [q], r)
+    }
+
+    soa_op = PolygonPointRangeQuery(conf, GRID)
+    interner = Interner()
+    chunks = list(_geoms_to_ragged_chunks(polys, interner))
+    soa_res = {
+        (s, e): sorted(
+            (interner.lookup(int(o)), round(float(d), 12))
+            for o, d in zip(oids, dists)
+        )
+        for s, e, idx, oids, dists, cnt in soa_op.run_soa(
+            iter(chunks), [q], r
+        )
+    }
+    assert obj_res == soa_res and obj_res
+
+
+def test_geometry_soa_knn_matches_object_path(rng):
+    from spatialflink_tpu.models.objects import LineString
+    from spatialflink_tpu.operators import LineStringPointKNNQuery
+    from spatialflink_tpu.utils.interning import Interner
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    lines = []
+    for i in range(90):
+        start = rng.uniform(1, 9, 2)
+        pts = start + np.cumsum(rng.uniform(-0.2, 0.2, (4, 2)), axis=0)
+        lines.append(LineString(
+            obj_id=f"ls{i}", timestamp=int(i * 300),
+            coords=np.vstack([start, pts]),
+        ))
+    q = Point(x=5.0, y=5.0)
+    r, k = 3.0, 6
+
+    obj_res = [
+        (res.start, res.end,
+         [(o, round(d, 12)) for o, d, _ in res.neighbors])
+        for res in LineStringPointKNNQuery(conf, GRID).run(iter(lines), q, r, k)
+    ]
+    soa_op = LineStringPointKNNQuery(conf, GRID)
+    interner = Interner()
+    chunks = list(_geoms_to_ragged_chunks(lines, interner))
+    soa_res = [
+        (s, e, [(interner.lookup(int(o)), round(float(d), 12))
+                for o, d in zip(oids, dists)])
+        for s, e, oids, dists, nv in soa_op.run_soa(
+            iter(chunks), q, r, k, num_segments=128
+        )
+    ]
+    assert obj_res == soa_res and obj_res
