@@ -288,8 +288,8 @@ def bench_join(jax, jnp, grid, quick):
     from spatialflink_tpu.ops.join import join_window_bucketed, pallas_join_supported
 
     win_pts = 131_072
-    n_win = 3 if quick else 8
-    xy_a, _, _ = _stream(win_pts * n_win, seed=1)
+    n_win = 3 if quick else 16  # enough windows that pipeline fill/drain
+    xy_a, _, _ = _stream(win_pts * n_win, seed=1)  # overhead amortizes
     xy_b, _, _ = _stream(win_pts * n_win, seed=2)
     r = np.float32(0.002)
     layers = grid.candidate_layers(float(r))

@@ -156,6 +156,11 @@ class GeometryBatch:
         offsets = np.concatenate([[0], np.cumsum(lengths)])
         total = int(offsets[-1])
         vmax = int(lengths.max()) if n else 2
+        if vert_bucket is not None and vert_bucket < vmax:
+            raise ValueError(
+                f"vert_bucket {vert_bucket} < longest chain {vmax}: chains "
+                "would be silently truncated"
+            )
         v = vert_bucket if vert_bucket is not None else next_bucket(
             max(vmax, 2), minimum=8)
 

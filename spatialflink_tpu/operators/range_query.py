@@ -356,10 +356,7 @@ class _GeometryStreamRangeQuery(SpatialOperator):
         kept_oids, dists, window_count) arrays through the SAME fused
         kernel as ``run()`` with zero per-object Python
         (GeometryBatch.from_ragged + RaggedSoaWindowAssembler)."""
-        from spatialflink_tpu.models.batch import (
-            GeometryBatch,
-            flag_prefix_planes,
-        )
+        from spatialflink_tpu.models.batch import flag_prefix_planes
         from spatialflink_tpu.streams.soa import RaggedSoaWindowAssembler
 
         if not isinstance(query_set, (list, tuple)):

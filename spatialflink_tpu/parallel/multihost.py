@@ -1,0 +1,58 @@
+"""Multi-host initialization — the DCN scale-out seam.
+
+The reference scales out through Flink's network stack + Kafka
+(StreamingJob.java:188-191; conf parallelism 15 at geoflink-conf.yml:55).
+Here the distributed backend is JAX itself: after
+``jax.distributed.initialize``, ``jax.devices()`` spans every host's
+chips, the SAME ``jax.sharding.Mesh`` construction (parallel/mesh.py)
+lays a global mesh over them, and every shard_mapped kernel in
+``parallel/sharded.py`` runs unchanged — XLA routes intra-slice
+collectives over ICI and cross-slice traffic over DCN. No NCCL/MPI and
+no code changes in the operator layer: multi-host is a mesh-shape
+decision, exactly like single-host multi-chip.
+
+This environment exposes one chip and no second host, so this module is
+exercised only for its no-op single-process path; the contract it wraps
+(jax.distributed) is the standard JAX multi-host bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join a multi-host JAX job; no-op for single-process runs.
+
+    Arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID`` — also set by TPU pod runtimes automatically).
+    Returns True when a multi-process group was joined. After a True
+    return, build meshes from ``jax.devices()`` (global across hosts) as
+    usual; ``mesh_from_config`` device products may then exceed one
+    host's chip count.
+    """
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if not addr and nproc <= 1:
+        return False
+    if not addr or nproc <= 1:
+        # A half-configured job must not silently run single-host.
+        raise ValueError(
+            "partial multi-host config: need BOTH a coordinator address "
+            f"and num_processes > 1 (got address={addr!r}, "
+            f"num_processes={nproc})"
+        )
+    pid = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "0"))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=nproc, process_id=pid
+    )
+    return True

@@ -41,8 +41,19 @@ class SoaWindow:
         return len(self.arrays["ts"])
 
 
-class SoaWindowAssembler:
-    """Sliding event-time windows over SoA chunks."""
+class _SlidingAssemblerBase:
+    """The ONE sliding-window watermark state machine, shared by the point
+    and ragged-geometry SoA assemblers. Subclasses supply the payload via
+    four hooks: ``_ingest`` (store a chunk, return its ts array),
+    ``_consolidate`` (merge+ts-sort the payload, return sorted ts),
+    ``_window`` (materialize rows [lo:hi) of the consolidated payload as a
+    fired window) and ``_evict`` (drop rows below ``keep_from``).
+
+    Semantics (the object assembler in streams/windows.py is the
+    reference): wm = max_ts − ooo; a window fires once when the watermark
+    passes its end; every window containing ≥1 event fires exactly once;
+    events older than every live window are dropped and counted.
+    """
 
     def __init__(self, size_ms: int, slide_ms: int, ooo_ms: int = 0):
         if size_ms <= 0 or slide_ms <= 0:
@@ -50,17 +61,15 @@ class SoaWindowAssembler:
         self.size = int(size_ms)
         self.slide = int(slide_ms)
         self.ooo = int(ooo_ms)
-        self._chunks: List[Dict[str, np.ndarray]] = []
         self._max_ts: Optional[int] = None
         self._next_start: Optional[int] = None  # earliest unfired window start
         self.dropped_late = 0
 
-    def feed(self, chunk: Dict[str, np.ndarray]) -> List[SoaWindow]:
-        """Add one SoA chunk; return windows that fire."""
-        ts = np.asarray(chunk["ts"], np.int64)
-        if len(ts) == 0:
+    def feed(self, chunk):
+        """Add one chunk; return the windows that fire."""
+        ts = self._ingest(chunk)
+        if ts is None or len(ts) == 0:
             return []
-        self._chunks.append({k: np.asarray(v) for k, v in chunk.items()})
         mx = int(ts.max())
         if self._max_ts is None or mx > self._max_ts:
             self._max_ts = mx
@@ -69,39 +78,25 @@ class SoaWindowAssembler:
             # by both the first observed timestamp and the initial watermark
             # (later within-bound arrivals may precede the first event).
             horizon = min(int(ts.min()), self._max_ts - self.ooo)
-            self._next_start = self._earliest_window_of(horizon)
+            self._next_start = earliest_window_of(horizon, self.size, self.slide)
         return self._fire(self._max_ts - self.ooo)
 
-    def flush(self) -> List[SoaWindow]:
+    def flush(self):
         """End of stream: fire everything up to the last event."""
         if self._max_ts is None:
             return []
         return self._fire(self._max_ts + self.size + 1)
 
-    # -- internals ------------------------------------------------------------
+    def stream(self, chunks):
+        for c in chunks:
+            yield from self.feed(c)
+        yield from self.flush()
 
-    def _consolidate(self) -> Dict[str, np.ndarray]:
-        if len(self._chunks) == 1:
-            merged = self._chunks[0]
-        else:
-            merged = {
-                k: np.concatenate([c[k] for c in self._chunks])
-                for k in self._chunks[0]
-            }
-        order = np.argsort(merged["ts"], kind="stable")
-        merged = {k: v[order] for k, v in merged.items()}
-        self._chunks = [merged]
-        return merged
-
-    def _earliest_window_of(self, ts_val: int) -> int:
-        return earliest_window_of(ts_val, self.size, self.slide)
-
-    def _fire(self, wm: int) -> List[SoaWindow]:
-        out: List[SoaWindow] = []
+    def _fire(self, wm: int):
+        out = []
         if self._next_start is None or self._next_start + self.size > wm:
             return out
-        merged = self._consolidate()
-        ts = merged["ts"]
+        ts = self._consolidate()
         # Events older than the earliest live window start are late beyond
         # every remaining window: count and trim.
         late = int(np.searchsorted(ts, self._next_start, side="left"))
@@ -112,16 +107,14 @@ class SoaWindowAssembler:
             lo = int(np.searchsorted(ts, s, side="left"))
             hi = int(np.searchsorted(ts, e, side="left"))
             if hi > lo:
-                out.append(
-                    SoaWindow(s, e, {k: v[lo:hi] for k, v in merged.items()})
-                )
+                out.append(self._window(s, e, lo, hi))
                 self._next_start += self.slide
             elif lo < len(ts):
                 # Empty window: fast-forward to the earliest window holding
                 # the next buffered event (no O(gap/slide) spinning).
                 self._next_start = max(
                     self._next_start + self.slide,
-                    self._earliest_window_of(int(ts[lo])),
+                    earliest_window_of(int(ts[lo]), self.size, self.slide),
                 )
             else:
                 # No buffered events at/after s: wait for more data.
@@ -130,13 +123,43 @@ class SoaWindowAssembler:
         # Evict rows no live window can need.
         keep_from = int(np.searchsorted(ts, self._next_start, side="left"))
         if keep_from:
-            self._chunks = [{k: v[keep_from:] for k, v in merged.items()}]
+            self._evict(keep_from)
         return out
 
-    def stream(self, chunks: Iterable[Dict[str, np.ndarray]]) -> Iterator[SoaWindow]:
-        for c in chunks:
-            yield from self.feed(c)
-        yield from self.flush()
+
+class SoaWindowAssembler(_SlidingAssemblerBase):
+    """Sliding event-time windows over SoA chunks."""
+
+    def __init__(self, size_ms: int, slide_ms: int, ooo_ms: int = 0):
+        super().__init__(size_ms, slide_ms, ooo_ms)
+        self._chunks: List[Dict[str, np.ndarray]] = []
+
+    def _ingest(self, chunk: Dict[str, np.ndarray]):
+        ts = np.asarray(chunk["ts"], np.int64)
+        if len(ts) == 0:
+            return None
+        self._chunks.append({k: np.asarray(v) for k, v in chunk.items()})
+        return ts
+
+    def _consolidate(self) -> np.ndarray:
+        if len(self._chunks) == 1:
+            merged = self._chunks[0]
+        else:
+            merged = {
+                k: np.concatenate([c[k] for c in self._chunks])
+                for k in self._chunks[0]
+            }
+        order = np.argsort(merged["ts"], kind="stable")
+        merged = {k: v[order] for k, v in merged.items()}
+        self._chunks = [merged]
+        return merged["ts"]
+
+    def _window(self, s, e, lo, hi) -> SoaWindow:
+        merged = self._chunks[0]
+        return SoaWindow(s, e, {k: v[lo:hi] for k, v in merged.items()})
+
+    def _evict(self, keep_from: int) -> None:
+        self._chunks = [{k: v[keep_from:] for k, v in self._chunks[0].items()}]
 
 
 def csv_chunk_source(path: str, parser, chunk_bytes: int = 1 << 22):
@@ -194,53 +217,42 @@ class RaggedSoaWindow:
         return len(self.ts)
 
 
-class RaggedSoaWindowAssembler:
+class RaggedSoaWindowAssembler(_SlidingAssemblerBase):
     """Sliding event-time windows over ragged GEOMETRY chunks.
 
     Chunks are ``{"ts": (n,), "oid": (n,), "lengths": (n,),
     "verts": (sum lengths, 2)}`` — each object's packed single boundary
     chain (closed ring for polygons, open for polylines; multi-ring
-    objects need the object path). Watermark/firing semantics match
-    SoaWindowAssembler: wm = max_ts − ooo, a window fires once when the
-    watermark passes its end, late rows are dropped and counted.
+    objects need the object path). Watermark/firing semantics come from
+    the shared state machine (_SlidingAssemblerBase).
     """
 
     def __init__(self, size_ms: int, slide_ms: int, ooo_ms: int = 0):
-        if size_ms <= 0 or slide_ms <= 0:
-            raise ValueError("size and slide must be positive")
-        self.size = int(size_ms)
-        self.slide = int(slide_ms)
-        self.ooo = int(ooo_ms)
+        super().__init__(size_ms, slide_ms, ooo_ms)
         self._rows: List[Dict[str, np.ndarray]] = []
         self._verts: List[np.ndarray] = []
-        self._max_ts: Optional[int] = None
-        self._next_start: Optional[int] = None
-        self.dropped_late = 0
 
-    def feed(self, chunk: Dict[str, np.ndarray]) -> List[RaggedSoaWindow]:
+    def _ingest(self, chunk: Dict[str, np.ndarray]):
         ts = np.asarray(chunk["ts"], np.int64)
         if len(ts) == 0:
-            return []
+            return None
+        lengths = np.asarray(chunk["lengths"], np.int64)
+        verts = np.asarray(chunk["verts"], np.float64)
+        if int(lengths.sum()) != len(verts):
+            raise ValueError(
+                f"ragged chunk mismatch: lengths sum to {int(lengths.sum())}"
+                f" but verts has {len(verts)} rows — offsets for every later"
+                " object would silently misalign"
+            )
         self._rows.append({
             "ts": ts,
             "oid": np.asarray(chunk["oid"], np.int32),
-            "lengths": np.asarray(chunk["lengths"], np.int64),
+            "lengths": lengths,
         })
-        self._verts.append(np.asarray(chunk["verts"], np.float64))
-        mx = int(ts.max())
-        if self._max_ts is None or mx > self._max_ts:
-            self._max_ts = mx
-        if self._next_start is None:
-            horizon = min(int(ts.min()), self._max_ts - self.ooo)
-            self._next_start = earliest_window_of(horizon, self.size, self.slide)
-        return self._fire(self._max_ts - self.ooo)
+        self._verts.append(verts)
+        return ts
 
-    def flush(self) -> List[RaggedSoaWindow]:
-        if self._max_ts is None:
-            return []
-        return self._fire(self._max_ts + self.size + 1)
-
-    def _consolidate(self):
+    def _consolidate(self) -> np.ndarray:
         if len(self._rows) > 1:
             rows = {
                 k: np.concatenate([c[k] for c in self._rows])
@@ -256,45 +268,20 @@ class RaggedSoaWindowAssembler:
             rows = {k: v[order] for k, v in rows.items()}
         self._rows = [rows]
         self._verts = [verts]
-        return rows, verts
+        self._offsets = np.concatenate([[0], np.cumsum(rows["lengths"])])
+        return rows["ts"]
 
-    def _fire(self, wm: int) -> List[RaggedSoaWindow]:
-        out: List[RaggedSoaWindow] = []
-        if self._next_start is None or self._next_start + self.size > wm:
-            return out
-        rows, verts = self._consolidate()
-        ts = rows["ts"]
-        offsets = np.concatenate([[0], np.cumsum(rows["lengths"])])
-        late = int(np.searchsorted(ts, self._next_start, side="left"))
-        if late:
-            self.dropped_late += late
-        while self._next_start + self.size <= wm:
-            s, e = self._next_start, self._next_start + self.size
-            lo = int(np.searchsorted(ts, s, side="left"))
-            hi = int(np.searchsorted(ts, e, side="left"))
-            if hi > lo:
-                out.append(RaggedSoaWindow(
-                    s, e, ts[lo:hi], rows["oid"][lo:hi],
-                    rows["lengths"][lo:hi],
-                    verts[offsets[lo]:offsets[hi]],
-                ))
-                self._next_start += self.slide
-            elif lo < len(ts):
-                self._next_start = max(
-                    self._next_start + self.slide,
-                    earliest_window_of(int(ts[lo]), self.size, self.slide),
-                )
-            else:
-                self._next_start += self.slide
-                break
-        keep_from = int(np.searchsorted(ts, self._next_start, side="left"))
-        if keep_from:
-            self._rows = [{k: v[keep_from:] for k, v in rows.items()}]
-            self._verts = [verts[offsets[keep_from]:]]
-        return out
+    def _window(self, s, e, lo, hi) -> RaggedSoaWindow:
+        rows = self._rows[0]
+        offs = self._offsets
+        return RaggedSoaWindow(
+            s, e, rows["ts"][lo:hi], rows["oid"][lo:hi],
+            rows["lengths"][lo:hi],
+            self._verts[0][offs[lo]:offs[hi]],
+        )
 
-    def stream(self, chunks: Iterable[Dict[str, np.ndarray]]
-               ) -> Iterator[RaggedSoaWindow]:
-        for c in chunks:
-            yield from self.feed(c)
-        yield from self.flush()
+    def _evict(self, keep_from: int) -> None:
+        rows = self._rows[0]
+        offs = self._offsets
+        self._rows = [{k: v[keep_from:] for k, v in rows.items()}]
+        self._verts = [self._verts[0][offs[keep_from]:]]

@@ -197,3 +197,20 @@ def test_sharded_knn_multi_matches_single(rng):
                                np.asarray(single.dist), rtol=5e-16)
     np.testing.assert_array_equal(np.asarray(sharded.num_valid),
                                   np.asarray(single.num_valid))
+
+
+def test_initialize_distributed_noop_single_process(monkeypatch):
+    from spatialflink_tpu.parallel.multihost import initialize_distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize_distributed() is False
+    # Half-configured jobs must fail loudly, not silently run single-host.
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "h:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    with pytest.raises(ValueError, match="partial multi-host"):
+        initialize_distributed()
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    with pytest.raises(ValueError, match="partial multi-host"):
+        initialize_distributed()
