@@ -98,12 +98,30 @@ class _PointStreamRangeQuery(SpatialOperator):
             and mesh is None and not approx
         )
         if use_pruned:
-            from spatialflink_tpu.ops.range import range_polygons_pruned_fused
-
-            prunedk = jitted(
-                range_polygons_pruned_fused, "cand", "point_chunk",
-                "approximate",
+            from spatialflink_tpu.ops.range import (
+                range_polygons_pruned_compact_fused,
+                range_polygons_pruned_fused,
             )
+
+            # Sparse query sets (their candidate-cell union covers little
+            # of the grid) additionally compact candidate lanes before the
+            # per-candidate work; dense unions (e.g. 1000 polygons
+            # covering most cells) skip compaction — it could never drop
+            # enough lanes to pay for itself.
+            occupancy = float((flags > 0).mean())
+            use_compact = occupancy < 0.25
+            if use_compact:
+                prunedk = jitted(
+                    range_polygons_pruned_compact_fused,
+                    "budget", "cand", "point_chunk",
+                )
+                if not hasattr(self, "_cand_budget"):
+                    self._cand_budget = 4096  # persists across windows
+            else:
+                prunedk = jitted(
+                    range_polygons_pruned_fused, "cand", "point_chunk",
+                    "approximate",
+                )
 
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
@@ -126,12 +144,28 @@ class _PointStreamRangeQuery(SpatialOperator):
                 if use_pruned:
                     ncand = 8
                     while True:
-                        keep, dist, over = prunedk(
-                            *common, qv, qe, radius, cand=ncand,
-                        )
-                        if int(over) == 0 or ncand >= len(query_set):
+                        if use_compact:
+                            keep, dist, c_over, b_over = prunedk(
+                                *common, qv, qe, radius,
+                                budget=self._cand_budget, cand=ncand,
+                            )
+                        else:
+                            keep, dist, c_over = prunedk(
+                                *common, qv, qe, radius, cand=ncand,
+                            )
+                            b_over = 0
+                        grew = False
+                        if int(b_over) > 0:
+                            need = self._cand_budget + int(b_over)
+                            self._cand_budget = int(
+                                2 ** np.ceil(np.log2(need))
+                            )
+                            grew = True
+                        if int(c_over) > 0 and ncand < len(query_set):
+                            ncand = min(ncand * 2, len(query_set))
+                            grew = True
+                        if not grew:
                             break
-                        ncand = min(ncand * 2, len(query_set))
                 else:
                     keep, dist = polyk(*common, qv, qe, radius)
             else:

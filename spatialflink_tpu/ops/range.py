@@ -209,6 +209,72 @@ def range_polygons_pruned_fused(xy, valid, cell, flags_table, poly_verts,
     )
 
 
+def range_query_polygons_pruned_compact_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    flags: jnp.ndarray,
+    poly_verts: jnp.ndarray,
+    poly_edge_valid: jnp.ndarray,
+    radius,
+    budget: int,
+    cand: int = 8,
+    point_chunk: int = 8192,
+):
+    """Candidate-compacted form of the pruned kernel.
+
+    Grid flags already exclude most of a window (typically >90% of lanes
+    have flags == 0 and can never be emitted); this kernel gathers the
+    ≤ ``budget`` candidate lanes on device and runs the bbox-pruned
+    evaluation only on them — the one place compaction beats the
+    mask-don't-compact default, because the per-lane work here
+    (P bbox distances + top-cand + cand·E exact edges) is ~1000×
+    an elementwise op.
+
+    Returns (keep (N,), min_dist (N,) — +big on lanes that were not
+    evaluated — cand_overflow, budget_overflow). Exactness contract:
+    both overflows 0 ⇒ keep/min_dist(kept) are bit-exact; a nonzero
+    ``budget_overflow`` means more than ``budget`` candidate lanes
+    existed (retry with a bigger budget), a nonzero ``cand_overflow``
+    means retry with bigger ``cand``. Exact mode only (the approximate
+    keep-set is flag-driven and needs no distances — use the dense
+    kernel's approximate path).
+    """
+    n = xy.shape[0]
+    lanes = valid & (flags > 0)
+    n_cand = jnp.sum(lanes.astype(jnp.int32))
+    idx = jnp.nonzero(lanes, size=budget, fill_value=n)[0]
+    in_range = idx < n
+    safe = jnp.minimum(idx, n - 1)
+    xy_c = jnp.where(in_range[:, None], xy[safe], 0.0)
+    flags_c = jnp.where(in_range, flags[safe], 0)
+
+    keep_c, dist_c, cand_over = range_query_polygons_pruned_kernel(
+        xy_c, in_range, flags_c, poly_verts, poly_edge_valid, radius,
+        cand=cand, point_chunk=min(point_chunk, budget),
+    )
+
+    big = jnp.asarray(jnp.finfo(dist_c.dtype).max, dist_c.dtype)
+    # Scatter through the RAW indices: padding lanes carry idx == n, which
+    # mode="drop" discards (clipped indices would overwrite lane n-1).
+    keep = jnp.zeros(n, bool).at[idx].set(keep_c, mode="drop")
+    dist = jnp.full(n, big, dist_c.dtype).at[idx].set(dist_c, mode="drop")
+    budget_overflow = jnp.maximum(n_cand - budget, 0)
+    return keep, dist, cand_over, budget_overflow
+
+
+def range_polygons_pruned_compact_fused(
+    xy, valid, cell, flags_table, poly_verts, poly_edge_valid, radius,
+    budget: int, cand: int = 8, point_chunk: int = 8192,
+):
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    return range_query_polygons_pruned_compact_kernel(
+        xy, valid, gather_cell_flags(cell, flags_table), poly_verts,
+        poly_edge_valid, radius, budget=budget, cand=cand,
+        point_chunk=point_chunk,
+    )
+
+
 def _chunked_min_over_geoms(one_fn, verts, edge_valid, chunk):
     """min over geometries of per-geometry point distances, processed in
     ``chunk``-geometry lax.map blocks so the (chunk, N, E) intermediate

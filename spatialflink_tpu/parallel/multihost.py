@@ -48,8 +48,14 @@ def initialize_distributed(
             f"and num_processes > 1 (got address={addr!r}, "
             f"num_processes={nproc})"
         )
-    pid = process_id if process_id is not None else int(
-        os.environ.get("JAX_PROCESS_ID", "0"))
+    pid_env = os.environ.get("JAX_PROCESS_ID")
+    if process_id is None and pid_env is None:
+        raise ValueError(
+            "multi-host config without a process id: set JAX_PROCESS_ID "
+            "(unique per host) or pass process_id — defaulting every host "
+            "to 0 would deadlock the coordinator barrier"
+        )
+    pid = process_id if process_id is not None else int(pid_env)
     import jax
 
     jax.distributed.initialize(

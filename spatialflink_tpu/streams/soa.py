@@ -16,7 +16,7 @@ dropped and counted (``dropped_late``).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Iterator, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -150,7 +150,9 @@ class SoaWindowAssembler(_SlidingAssemblerBase):
                 for k in self._chunks[0]
             }
         order = np.argsort(merged["ts"], kind="stable")
-        merged = {k: v[order] for k, v in merged.items()}
+        if not np.array_equal(order, np.arange(len(order))):
+            # In-order streams (the common case) skip the gather-copy.
+            merged = {k: v[order] for k, v in merged.items()}
         self._chunks = [merged]
         return merged["ts"]
 
@@ -237,18 +239,20 @@ class RaggedSoaWindowAssembler(_SlidingAssemblerBase):
         if len(ts) == 0:
             return None
         lengths = np.asarray(chunk["lengths"], np.int64)
+        oid = np.asarray(chunk["oid"], np.int32)
         verts = np.asarray(chunk["verts"], np.float64)
+        if not (len(ts) == len(oid) == len(lengths)):
+            raise ValueError(
+                f"ragged chunk row mismatch: ts={len(ts)} oid={len(oid)} "
+                f"lengths={len(lengths)} must be equal"
+            )
         if int(lengths.sum()) != len(verts):
             raise ValueError(
                 f"ragged chunk mismatch: lengths sum to {int(lengths.sum())}"
                 f" but verts has {len(verts)} rows — offsets for every later"
                 " object would silently misalign"
             )
-        self._rows.append({
-            "ts": ts,
-            "oid": np.asarray(chunk["oid"], np.int32),
-            "lengths": lengths,
-        })
+        self._rows.append({"ts": ts, "oid": oid, "lengths": lengths})
         self._verts.append(verts)
         return ts
 

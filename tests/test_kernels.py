@@ -442,3 +442,69 @@ def test_pruned_polygon_range_overflow_detects_undercount(rng):
         jnp.asarray(np.ones(2, np.uint8)), jnp.asarray(verts),
         jnp.asarray(ev), 1.0, cand=4, point_chunk=2)
     assert int(over) > 0
+
+
+def test_pruned_compact_polygon_range_matches_dense(rng):
+    """The candidate-compacted pruned kernel must keep exactly the dense
+    kernel's lanes (equal dists on kept lanes) when both overflows are 0,
+    with realistic mostly-non-candidate flags."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import pack_query_geometries
+    from spatialflink_tpu.ops.range import (
+        range_query_polygons_kernel,
+        range_query_polygons_pruned_compact_kernel,
+    )
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+
+    polys = generate_query_polygons(50, 0.0, 0.0, 10.0, 10.0, grid_size=20,
+                                    seed=6)
+    verts, ev = pack_query_geometries(polys, np.float64)
+    n = 4000
+    xy = rng.uniform(0, 10, (n, 2))
+    valid = np.ones(n, bool)
+    # ~10% candidate lanes, rest pruned by flags.
+    flags = np.where(rng.uniform(size=n) < 0.1, 1, 0).astype(np.uint8)
+    r = 0.35
+
+    keep_d, dist_d = jax.jit(range_query_polygons_kernel,
+                             static_argnames="approximate")(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(flags),
+        jnp.asarray(verts), jnp.asarray(ev), r)
+    keep_c, dist_c, cand_over, budget_over = jax.jit(
+        range_query_polygons_pruned_compact_kernel,
+        static_argnames=("budget", "cand", "point_chunk"))(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(flags),
+        jnp.asarray(verts), jnp.asarray(ev), r,
+        budget=1024, cand=8, point_chunk=256)
+    assert int(cand_over) == 0 and int(budget_over) == 0
+    np.testing.assert_array_equal(np.asarray(keep_c), np.asarray(keep_d))
+    kept = np.asarray(keep_d)
+    np.testing.assert_allclose(np.asarray(dist_c)[kept],
+                               np.asarray(dist_d)[kept], rtol=0, atol=0)
+
+
+def test_pruned_compact_budget_overflow(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import pack_query_geometries
+    from spatialflink_tpu.ops.range import (
+        range_query_polygons_pruned_compact_kernel,
+    )
+    from spatialflink_tpu.utils.helper import generate_query_polygons
+
+    polys = generate_query_polygons(10, 0.0, 0.0, 10.0, 10.0, grid_size=20,
+                                    seed=8)
+    verts, ev = pack_query_geometries(polys, np.float64)
+    n = 512
+    xy = rng.uniform(0, 10, (n, 2))
+    flags = np.ones(n, np.uint8)  # every lane is a candidate
+    _, _, _, budget_over = jax.jit(
+        range_query_polygons_pruned_compact_kernel,
+        static_argnames=("budget", "cand", "point_chunk"))(
+        jnp.asarray(xy), jnp.asarray(np.ones(n, bool)), jnp.asarray(flags),
+        jnp.asarray(verts), jnp.asarray(ev), 0.3,
+        budget=128, cand=8, point_chunk=128)
+    assert int(budget_over) == n - 128

@@ -543,3 +543,45 @@ def test_pane_join_rejects_lateness(rng):
     )
     with pytest.raises(ValueError, match="allowed_lateness"):
         list(PointPointJoinQuery(conf, GRID).query_panes(iter([]), iter([]), 1.0))
+
+
+def test_point_polygon_range_compact_path_matches_dense(rng):
+    """A sparse >=64-polygon query set (clustered: low flag occupancy)
+    selects the candidate-compacted pruned kernel; results must match the
+    dense path exactly, including across budget-growth retries."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=700)
+    # 70 tiny polygons clustered in one corner: candidate union is small.
+    polys = []
+    for i in range(70):
+        cx, cy = rng.uniform(1.0, 2.5), rng.uniform(1.0, 2.5)
+        polys.append(Polygon(rings=[np.array(
+            [[cx - .1, cy - .1], [cx + .1, cy - .1], [cx + .1, cy + .1],
+             [cx - .1, cy + .1], [cx - .1, cy - .1]])]))
+    op = PointPolygonRangeQuery(conf, GRID)
+    op._cand_budget = 64  # force at least one budget-growth retry
+    got = {
+        (res.start, res.end): sorted(
+            (id(p), round(float(d), 12))
+            for p, d in zip(res.objects, res.dists))
+        for res in op.run(iter(pts), polys, 0.2)
+    }
+    dense = {}
+    for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys[:63], 0.2):
+        dense.setdefault((res.start, res.end), set()).update(
+            (id(p), round(float(d), 12))
+            for p, d in zip(res.objects, res.dists))
+    for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys[63:], 0.2):
+        dense.setdefault((res.start, res.end), set()).update(
+            (id(p), round(float(d), 12))
+            for p, d in zip(res.objects, res.dists))
+    # Union of the two dense sub-queries: a point can match both halves
+    # with different min distances; keep the min like the full query does.
+    dense_min = {}
+    for k, v in dense.items():
+        best = {}
+        for pid, d in v:
+            if pid not in best or d < best[pid]:
+                best[pid] = d
+        dense_min[k] = sorted(best.items())
+    assert got == dense_min
