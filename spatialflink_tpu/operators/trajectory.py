@@ -110,6 +110,33 @@ class TRangeQuery(SpatialOperator):
             yield TRangeResult(win.start, win.end, out, len(win.events))
 
 
+    def run_soa(self, chunks, query_polygons: Sequence[Polygon],
+                num_segments: int, dtype=np.float64):
+        """SoA fast path: point chunks {"ts","x","y","oid"} (dense int32
+        oids in [0, num_segments)) → per-window (start, end, hit_oids,
+        window_count) — the containment + per-trajectory any-hit program
+        of run() with no per-object Python."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+
+        verts, ev = pack_query_geometries(query_polygons, np.float64)
+        qv = self.device_verts(verts, dtype)
+        qe = jnp.asarray(ev)
+        program = jitted(traj_range_hits_fused, "num_segments")
+        for win, xy, valid, cell, oid in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            if win.count and int(oid[:win.count].max()) >= num_segments:
+                raise ValueError(
+                    f"oid >= num_segments {num_segments}: ids would be "
+                    "silently dropped by the segment reduction"
+                )
+            hits = np.asarray(program(
+                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(oid),
+                qv, qe, num_segments=num_segments,
+            ))
+            yield (win.start, win.end, np.flatnonzero(hits), win.count)
+
+
 class PointPolygonTRangeQuery(TRangeQuery):
     """tRange/PointPolygonTRangeQuery.java."""
 
@@ -384,38 +411,56 @@ class TAggregateQuery(SpatialOperator):
     def run(self, stream: Iterable[Point], dtype=np.float64,
             mesh=None) -> Iterator[TAggregateResult]:
         mesh = mesh if mesh is not None else self.mesh
-
-        def program(num_pairs):
-            return window_program(
-                mesh, traj_cell_spans_kernel, (0, 1, 2), 3,
-                reduce=True, num_pairs=num_pairs,
-            )
-
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             n = len(win.events)
-            key64 = (
-                batch.cell[:n].astype(np.int64) << 32
-            ) | batch.oid[:n].astype(np.int64)
-            uniq_keys, inverse = np.unique(key64, return_inverse=True)
-            pair_id = np.zeros(batch.capacity, np.int32)
-            pair_id[:n] = inverse.astype(np.int32)
-            num_pairs = next_bucket(len(uniq_keys), minimum=64)
-            spans = program(num_pairs)(
-                jnp.asarray(batch.ts), jnp.asarray(pair_id),
-                jnp.asarray(batch.valid),
+            self._ingest_window(
+                batch.ts, batch.cell, batch.oid, batch.valid, n, mesh
             )
-            mn = np.asarray(spans.min_ts)[: len(uniq_keys)]
-            mx = np.asarray(spans.max_ts)[: len(uniq_keys)]
-            self._merge_state(uniq_keys, mn, mx)
-            # Inactive-trajectory deletion (TAggregateQuery.deleteHalted…).
-            if self.inactive_threshold_ms > 0 and len(mx):
-                horizon = max(int(mx.max()), 0) - self.inactive_threshold_ms
-                keep = self._smax >= horizon
-                self._skeys = self._skeys[keep]
-                self._smin = self._smin[keep]
-                self._smax = self._smax[keep]
             yield self._aggregate_state(win)
+
+    def _ingest_window(self, ts_p, cell_p, oid_p, valid_p, n, mesh=None):
+        """One window's (cell, objID) span reduction merged into the
+        MapState-analog arrays, incl. inactive-trajectory deletion
+        (TAggregateQuery.deleteHalted…) — shared by run()/run_soa()."""
+        key64 = (
+            cell_p[:n].astype(np.int64) << 32
+        ) | oid_p[:n].astype(np.int64)
+        uniq_keys, inverse = np.unique(key64, return_inverse=True)
+        pair_id = np.zeros(len(valid_p), np.int32)
+        pair_id[:n] = inverse.astype(np.int32)
+        num_pairs = next_bucket(len(uniq_keys), minimum=64)
+        spans = window_program(
+            mesh, traj_cell_spans_kernel, (0, 1, 2), 3,
+            reduce=True, num_pairs=num_pairs,
+        )(jnp.asarray(ts_p), jnp.asarray(pair_id), jnp.asarray(valid_p))
+        mn = np.asarray(spans.min_ts)[: len(uniq_keys)]
+        mx = np.asarray(spans.max_ts)[: len(uniq_keys)]
+        self._merge_state(uniq_keys, mn, mx)
+        if self.inactive_threshold_ms > 0 and len(mx):
+            horizon = max(int(mx.max()), 0) - self.inactive_threshold_ms
+            keep = self._smax >= horizon
+            self._skeys = self._skeys[keep]
+            self._smin = self._smin[keep]
+            self._smax = self._smax[keep]
+
+    def run_soa(self, chunks, dtype=np.float64):
+        """SoA fast path: point chunks {"ts","x","y","oid"} (dense int32
+        oids) → per-window TAggregateResult with the same MapState-carry
+        semantics as run(); in ALL mode the per-trajectory keys are the
+        dense int ids (the chunk contract's id space — callers own the
+        string mapping)."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+        from spatialflink_tpu.utils.padding import pad_to_bucket
+
+        for win, xy, valid, cell, oid in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            ts_p = pad_to_bucket(
+                np.asarray(win.arrays["ts"], np.int64), len(valid)
+            )
+            self._ingest_window(ts_p, cell, oid, valid, win.count)
+            yield self._aggregate_state(win, lookup=str)
 
     def _merge_state(self, keys: np.ndarray, mn: np.ndarray, mx: np.ndarray):
         """min/max-merge the window's (key, span) table into the sorted
@@ -434,10 +479,12 @@ class TAggregateQuery(SpatialOperator):
             self._smin = np.concatenate([self._smin, mn[~hit]])[order]
             self._smax = np.concatenate([self._smax, mx[~hit]])[order]
 
-    def _aggregate_state(self, win: WindowBatch) -> TAggregateResult:
+    def _aggregate_state(self, win, lookup=None) -> TAggregateResult:
+        lookup = lookup if lookup is not None else self.interner.lookup
+        count = len(win.events) if hasattr(win, "events") else win.count
         out: Dict[str, Tuple[int, Dict[str, int]]] = {}
         if not len(self._skeys):
-            return TAggregateResult(win.start, win.end, out, len(win.events))
+            return TAggregateResult(win.start, win.end, out, count)
         cells = (self._skeys >> 32).astype(np.int64)
         oids = (self._skeys & 0xFFFFFFFF).astype(np.int64)
         lens = self._smax - self._smin
@@ -454,7 +501,7 @@ class TAggregateQuery(SpatialOperator):
             seg = lens[s:e]
             if self.aggregate == "ALL":
                 out[name] = (cnt, {
-                    self.interner.lookup(int(o)): int(v)
+                    lookup(int(o)): int(v)
                     for o, v in zip(oids[s:e], seg)
                 })
             elif self.aggregate == "SUM":
@@ -463,11 +510,11 @@ class TAggregateQuery(SpatialOperator):
                 out[name] = (cnt, {"": round(float(seg.sum()) / cnt)})
             elif self.aggregate == "MIN":
                 i = int(np.argmin(seg))
-                out[name] = (cnt, {self.interner.lookup(int(oids[s + i])): int(seg[i])})
+                out[name] = (cnt, {lookup(int(oids[s + i])): int(seg[i])})
             else:  # MAX
                 i = int(np.argmax(seg))
-                out[name] = (cnt, {self.interner.lookup(int(oids[s + i])): int(seg[i])})
-        return TAggregateResult(win.start, win.end, out, len(win.events))
+                out[name] = (cnt, {lookup(int(oids[s + i])): int(seg[i])})
+        return TAggregateResult(win.start, win.end, out, count)
 
 
 class PointTAggregateQuery(TAggregateQuery):

@@ -149,9 +149,9 @@ class SoaWindowAssembler(_SlidingAssemblerBase):
                 k: np.concatenate([c[k] for c in self._chunks])
                 for k in self._chunks[0]
             }
-        order = np.argsort(merged["ts"], kind="stable")
-        if not np.array_equal(order, np.arange(len(order))):
-            # In-order streams (the common case) skip the gather-copy.
+        ts = merged["ts"]
+        if np.any(ts[:-1] > ts[1:]):  # in-order streams skip the sort
+            order = np.argsort(ts, kind="stable")
             merged = {k: v[order] for k, v in merged.items()}
         self._chunks = [merged]
         return merged["ts"]
@@ -266,8 +266,9 @@ class RaggedSoaWindowAssembler(_SlidingAssemblerBase):
         else:
             rows = self._rows[0]
             verts = self._verts[0]
-        order = np.argsort(rows["ts"], kind="stable")
-        if not np.array_equal(order, np.arange(len(order))):
+        ts = rows["ts"]
+        if np.any(ts[:-1] > ts[1:]):  # in-order streams skip the sort
+            order = np.argsort(ts, kind="stable")
             verts, _ = _ragged_reorder(verts, rows["lengths"], order)
             rows = {k: v[order] for k, v in rows.items()}
         self._rows = [rows]

@@ -212,3 +212,81 @@ def test_traj_stats_sliding_extreme_overlap(rng):
     # Windows containing only one endpoint: no segment.
     one_pt = (res.count[:, 0] == 1)
     assert (res.spatial[one_pt, 0] == 0).all()
+
+
+def test_trange_soa_matches_object_path(rng):
+    """TRange SoA fast path == object path hit sets (dense-id space)."""
+    from spatialflink_tpu.models.objects import Point, Polygon
+    from spatialflink_tpu.operators import (
+        QueryConfiguration, QueryType, TRangeQuery,
+    )
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    n = 2500
+    ts = np.sort(rng.integers(0, 30_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 40, n).astype(np.int32)
+    polys = [Polygon(rings=[np.array(
+        [[3, 3], [4.2, 3], [4.2, 4.2], [3, 4.2], [3, 3]], float)])]
+
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+    op = TRangeQuery(conf, GRID)
+    obj_res = {
+        (r.start, r.end): sorted(int(t.obj_id) for t in r.trajectories)
+        for r in op.run(iter(pts), polys)
+    }
+    bounds = np.linspace(0, n, 5).astype(int)
+    chunks = [
+        {"ts": ts[a:b], "x": xs[a:b], "y": ys[a:b], "oid": oids[a:b]}
+        for a, b in zip(bounds[:-1], bounds[1:])
+    ]
+    soa_res = {
+        (s, e): sorted(int(o) for o in hit_oids)
+        for s, e, hit_oids, cnt in TRangeQuery(conf, GRID).run_soa(
+            iter(chunks), polys, num_segments=64
+        )
+    }
+    assert obj_res == soa_res and obj_res
+
+
+def test_taggregate_soa_matches_object_path(rng):
+    """TAggregate SoA path == object path per-cell aggregates (ALL mode
+    compares dense-id keys against interner-mapped keys)."""
+    from spatialflink_tpu.models.objects import Point
+    from spatialflink_tpu.operators import (
+        QueryConfiguration, QueryType, TAggregateQuery,
+    )
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    n = 2000
+    ts = np.sort(rng.integers(0, 30_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 25, n).astype(np.int32)
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+
+    for agg in ("SUM", "ALL"):
+        obj_res = [
+            (r.start, r.end, {
+                c: (cnt, {str(k): v for k, v in d.items()})
+                for c, (cnt, d) in r.cells.items()
+            })
+            for r in TAggregateQuery(conf, GRID, aggregate=agg).run(iter(pts))
+        ]
+        bounds = np.linspace(0, n, 4).astype(int)
+        chunks = [
+            {"ts": ts[a:b], "x": xs[a:b], "y": ys[a:b], "oid": oids[a:b]}
+            for a, b in zip(bounds[:-1], bounds[1:])
+        ]
+        soa_res = [
+            (r.start, r.end, {
+                c: (cnt, {str(k): v for k, v in d.items()})
+                for c, (cnt, d) in r.cells.items()
+            })
+            for r in TAggregateQuery(conf, GRID, aggregate=agg).run_soa(
+                iter(chunks))
+        ]
+        assert obj_res == soa_res and obj_res, agg
