@@ -115,7 +115,7 @@ def main() -> None:
     # results collected as handles and materialized once at the end
     # (device_get is the only true sync on this tunnel; a per-window fetch
     # would drain the pipeline every slide). The measurement tunnel's
-    # bandwidth fluctuates ±50% run to run, so the loop runs 3 times and
+    # bandwidth fluctuates ±50% run to run, so the loop runs 5 times and
     # the MEDIAN rate is reported.
     def timed_run():
         nonlocal d_prev
@@ -134,7 +134,7 @@ def main() -> None:
         return time.perf_counter() - t0, results
 
     with trace_ctx:
-        runs = [timed_run() for _ in range(3)]
+        runs = [timed_run() for _ in range(5)]
     t_total = float(np.median([t for t, _ in runs]))
     results = runs[-1][1]
 

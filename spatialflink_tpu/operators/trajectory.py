@@ -693,6 +693,39 @@ class TFilterQuery(SpatialOperator):
             ]
             yield TFilterResult(win.start, win.end, out, len(win.events))
 
+    def run_soa(self, chunks, traj_ids: Sequence[int]):
+        """SoA fast path: per window, the selected trajectories as sorted
+        arrays — (start, end, oids (m,), ts (m,), xy (m, 2), count) with
+        rows lexsorted by (oid, ts), ready for vectorized sub-trajectory
+        slicing. ``traj_ids`` are the dense int ids of the chunk contract."""
+        from spatialflink_tpu.ops.counters import counters
+        from spatialflink_tpu.streams.soa import SoaWindowAssembler
+
+        wanted = np.asarray(sorted(traj_ids), np.int32)
+        asm = SoaWindowAssembler(
+            self.conf.window_size_ms, self.conf.slide_step_ms,
+            ooo_ms=self.conf.allowed_lateness_ms,
+        )
+        for win in asm.stream(chunks):
+            if counters.enabled:
+                counters.record_window(win.count, 0, 0)
+            oid = np.asarray(win.arrays["oid"], np.int32)
+            keep = np.isin(oid, wanted)
+            # Mask BEFORE the float64 conversion: typical filters keep a
+            # tiny fraction of the window.
+            ts = np.asarray(win.arrays["ts"][keep], np.int64)
+            xy = np.stack(
+                [np.asarray(win.arrays["x"][keep], np.float64),
+                 np.asarray(win.arrays["y"][keep], np.float64)],
+                axis=1,
+            )
+            o = oid[keep]
+            order = np.lexsort((ts, o))
+            yield (
+                win.start, win.end, o[order], ts[order], xy[order],
+                win.count,
+            )
+
 
 class PointTFilterQuery(TFilterQuery):
     """tFilter/PointTFilterQuery.java."""

@@ -290,3 +290,41 @@ def test_taggregate_soa_matches_object_path(rng):
                 iter(chunks))
         ]
         assert obj_res == soa_res and obj_res, agg
+
+
+def test_tfilter_soa_matches_object_path(rng):
+    from spatialflink_tpu.models.objects import Point
+    from spatialflink_tpu.operators import (
+        QueryConfiguration, QueryType, TFilterQuery,
+    )
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    n = 1500
+    ts = np.sort(rng.integers(0, 25_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 20, n).astype(np.int32)
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+    wanted = [3, 7, 11]
+
+    obj_res = {}
+    for r in TFilterQuery(conf, GRID).run(iter(pts), [str(w) for w in wanted]):
+        obj_res[(r.start, r.end)] = {
+            t.obj_id: [tuple(c) for c in t.coords] for t in r.trajectories
+        }
+    bounds = np.linspace(0, n, 4).astype(int)
+    chunks = [
+        {"ts": ts[a:b], "x": xs[a:b], "y": ys[a:b], "oid": oids[a:b]}
+        for a, b in zip(bounds[:-1], bounds[1:])
+    ]
+    soa_res = {}
+    for s, e, o, t, xy, cnt in TFilterQuery(conf, GRID).run_soa(
+        iter(chunks), wanted
+    ):
+        trajs = {}
+        for oid_val in np.unique(o):
+            m = o == oid_val
+            trajs[str(int(oid_val))] = [tuple(c) for c in xy[m]]
+        soa_res[(s, e)] = trajs
+    assert obj_res == soa_res and obj_res
