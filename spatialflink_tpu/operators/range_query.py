@@ -177,6 +177,57 @@ class _PointStreamRangeQuery(SpatialOperator):
             yield RangeResult(win.start, win.end, objs, dist[idx], len(win.events))
 
 
+    def run_soa(
+        self,
+        chunks,
+        query_set: Sequence[SpatialObject],
+        radius: float,
+        dtype=np.float64,
+    ):
+        """High-rate SoA path: chunks of {"ts","x","y",...} arrays →
+        per-window (start, end, matched_arrays, dists), where
+        ``matched_arrays`` is the window's SoA sliced down to the matching
+        events (so callers get the actual matches, not just a count).
+        Works for every query kind of the family (point / polygon /
+        linestring query sets), same kernels as run()."""
+        from spatialflink_tpu.operators.base import soa_point_batches
+
+        if not isinstance(query_set, (list, tuple)):
+            query_set = [query_set]
+        flags = flags_for_queries(self.grid, radius, query_set)
+        flags_d = jnp.asarray(flags)
+        approx = self.conf.approximate_query
+        if self.query_kind == "point":
+            kern = jitted(range_points_fused, "approximate")
+            q = self.device_q(pack_query_points(query_set, np.float64), dtype)
+            qargs = (q,)
+        else:
+            kern = jitted(
+                range_polygons_fused if self.query_kind == "polygon"
+                else range_polylines_fused,
+                "approximate",
+            )
+            verts, ev = pack_query_geometries(query_set, np.float64)
+            qargs = (self.device_q(verts, dtype), jnp.asarray(ev))
+        from spatialflink_tpu.ops.counters import count_candidates, counters
+
+        for win, xy, valid, cell, _ in soa_point_batches(
+            self.grid, chunks, self.conf, dtype
+        ):
+            if counters.enabled:
+                cand = count_candidates(flags, cell, win.count)
+                counters.record_candidates(cand, cand * len(query_set))
+            keep, dist = kern(
+                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+                flags_d, *qargs, radius, approximate=approx,
+            )
+            n = win.count
+            keep = np.asarray(keep)[:n]
+            idx = np.nonzero(keep)[0]
+            matched = {k: np.asarray(v)[idx] for k, v in win.arrays.items()}
+            yield win.start, win.end, matched, np.asarray(dist)[:n][idx]
+
+
 class PointPointRangeQuery(_PointStreamRangeQuery):
     """range/PointPointRangeQuery.java (realtime :44-108, window :111-187)."""
 
@@ -250,43 +301,6 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
             )
 
 
-    def run_soa(
-        self,
-        chunks,
-        query_set: Sequence[Point],
-        radius: float,
-        dtype=np.float64,
-    ):
-        """High-rate SoA path: chunks of {"ts","x","y",...} arrays →
-        per-window (start, end, matched_arrays, dists), where
-        ``matched_arrays`` is the window's SoA sliced down to the matching
-        events (so callers get the actual matches, not just a count)."""
-        from spatialflink_tpu.operators.base import soa_point_batches
-
-        if not isinstance(query_set, (list, tuple)):
-            query_set = [query_set]
-        flags = flags_for_queries(self.grid, radius, query_set)
-        flags_d = jnp.asarray(flags)
-        pk = jitted(range_points_fused, "approximate")
-        q = self.device_q(pack_query_points(query_set, np.float64), dtype)
-        from spatialflink_tpu.ops.counters import count_candidates, counters
-
-        for win, xy, valid, cell, _ in soa_point_batches(
-            self.grid, chunks, self.conf, dtype
-        ):
-            if counters.enabled:
-                cand = count_candidates(flags, cell, win.count)
-                counters.record_candidates(cand, cand * len(query_set))
-            keep, dist = pk(
-                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
-                flags_d, q, radius,
-                approximate=self.conf.approximate_query,
-            )
-            n = win.count
-            keep = np.asarray(keep)[:n]
-            idx = np.nonzero(keep)[0]
-            matched = {k: np.asarray(v)[idx] for k, v in win.arrays.items()}
-            yield win.start, win.end, matched, np.asarray(dist)[:n][idx]
 
 
 class PointPolygonRangeQuery(_PointStreamRangeQuery):

@@ -299,3 +299,39 @@ def test_geometry_soa_knn_matches_object_path(rng):
         )
     ]
     assert obj_res == soa_res and obj_res
+
+
+def test_soa_point_polygon_range_matches_object_path(rng):
+    """The generalized point-stream run_soa must equal the object path for
+    a polygon query set (the Q1-style hot path)."""
+    from spatialflink_tpu.models.objects import Polygon
+    from spatialflink_tpu.operators import PointPolygonRangeQuery
+
+    n = 2500
+    ts = np.sort(rng.integers(0, 30_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 7, n).astype(np.int32)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    polys = [
+        Polygon(rings=[np.array([[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]], float)]),
+        Polygon(rings=[np.array([[1, 7], [2, 7], [2, 8.5], [1, 8.5], [1, 7]], float)]),
+    ]
+    r = 0.4
+
+    soa = {
+        (s, e): sorted(zip(m["ts"].tolist(), np.round(dd, 12).tolist()))
+        for s, e, m, dd in PointPolygonRangeQuery(conf, GRID).run_soa(
+            _chunks(ts, xs, ys, oids), polys, r
+        )
+    }
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+    obj = {
+        (res.start, res.end): sorted(
+            zip((p.timestamp for p in res.objects),
+                np.round(res.dists, 12).tolist())
+        )
+        for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys, r)
+    }
+    assert soa == obj and soa
