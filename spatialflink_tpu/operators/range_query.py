@@ -58,6 +58,89 @@ class _PointStreamRangeQuery(SpatialOperator):
 
     query_kind = "point"
 
+    def _window_evaluator(self, query_set, flags, radius, dtype, mesh):
+        """Build ``eval(common) -> (keep, dist)`` for this family's query
+        kind — ONE place for kernel selection, query packing, and the
+        polygon pruned/compact overflow-retry machinery (budgets persist
+        on the operator). Shared by run() and run_soa().
+
+        Polygon selection: large exact-mode query sets use bbox-candidate
+        pruning (the dense P·E sweep loses ~10× there); sparse candidate
+        unions (<25% flag occupancy) additionally compact candidate lanes
+        first. Approximate mode stays dense — its keep-set ignores
+        distances, so pruned min-over-candidates dists would diverge from
+        the dense min-over-all on kept lanes.
+        """
+        approx = self.conf.approximate_query
+        if self.query_kind == "point":
+            pk = window_program(
+                mesh, range_points_fused, (0, 1, 2), 6, approximate=approx
+            )
+            q = self.device_q(pack_query_points(query_set, np.float64), dtype)
+            return lambda common: pk(*common, q, radius)
+
+        verts, ev = pack_query_geometries(query_set, np.float64)
+        qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
+        if self.query_kind == "linestring":
+            lk = window_program(
+                mesh, range_polylines_fused, (0, 1, 2), 7, approximate=approx
+            )
+            return lambda common: lk(*common, qv, qe, radius)
+
+        nq = len(query_set)
+        use_pruned = nq >= 64 and mesh is None and not approx
+        if not use_pruned:
+            polyk = window_program(
+                mesh, range_polygons_fused, (0, 1, 2), 7, approximate=approx
+            )
+            return lambda common: polyk(*common, qv, qe, radius)
+
+        from spatialflink_tpu.ops.range import (
+            range_polygons_pruned_compact_fused,
+            range_polygons_pruned_fused,
+        )
+
+        use_compact = float((flags > 0).mean()) < 0.25
+        if use_compact:
+            prunedk = jitted(
+                range_polygons_pruned_compact_fused,
+                "budget", "cand", "point_chunk",
+            )
+            if not hasattr(self, "_cand_budget"):
+                self._cand_budget = 4096  # persists across windows
+        else:
+            prunedk = jitted(
+                range_polygons_pruned_fused, "cand", "point_chunk",
+                "approximate",
+            )
+        if not hasattr(self, "_ncand"):
+            self._ncand = 8  # persists: dense data pays the retry once
+
+        def ev_pruned(common):
+            while True:
+                if use_compact:
+                    keep, dist, c_over, b_over = prunedk(
+                        *common, qv, qe, radius,
+                        budget=self._cand_budget, cand=self._ncand,
+                    )
+                else:
+                    keep, dist, c_over = prunedk(
+                        *common, qv, qe, radius, cand=self._ncand,
+                    )
+                    b_over = 0
+                grew = False
+                if int(b_over) > 0:
+                    need = self._cand_budget + int(b_over)
+                    self._cand_budget = int(2 ** np.ceil(np.log2(need)))
+                    grew = True
+                if int(c_over) > 0 and self._ncand < nq:
+                    self._ncand = min(self._ncand * 2, nq)
+                    grew = True
+                if not grew:
+                    return keep, dist
+
+        return ev_pruned
+
     def run(
         self,
         stream: Iterable[Point],
@@ -71,57 +154,7 @@ class _PointStreamRangeQuery(SpatialOperator):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
-        approx = self.conf.approximate_query
-        pk = window_program(
-            mesh, range_points_fused, (0, 1, 2), 6, approximate=approx
-        )
-        polyk = window_program(
-            mesh, range_polygons_fused, (0, 1, 2), 7, approximate=approx
-        )
-        lk = window_program(
-            mesh, range_polylines_fused, (0, 1, 2), 7, approximate=approx
-        )
-        if self.query_kind == "point":
-            q = self.device_q(pack_query_points(query_set, np.float64), dtype)
-        else:
-            verts, ev = pack_query_geometries(query_set, np.float64)
-            qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
-
-        # Large polygon query sets: bbox-candidate pruning beats the dense
-        # P·E sweep ~10× (the 1000-polygon config); exact via the
-        # overflow/retry contract (range_query_polygons_pruned_kernel).
-        # Approximate mode stays on the dense path: its keep-set ignores
-        # distances, so pruned min-over-candidates dists would diverge
-        # from the dense kernel's min-over-all for kept lanes.
-        use_pruned = (
-            self.query_kind == "polygon" and len(query_set) >= 64
-            and mesh is None and not approx
-        )
-        if use_pruned:
-            from spatialflink_tpu.ops.range import (
-                range_polygons_pruned_compact_fused,
-                range_polygons_pruned_fused,
-            )
-
-            # Sparse query sets (their candidate-cell union covers little
-            # of the grid) additionally compact candidate lanes before the
-            # per-candidate work; dense unions (e.g. 1000 polygons
-            # covering most cells) skip compaction — it could never drop
-            # enough lanes to pay for itself.
-            occupancy = float((flags > 0).mean())
-            use_compact = occupancy < 0.25
-            if use_compact:
-                prunedk = jitted(
-                    range_polygons_pruned_compact_fused,
-                    "budget", "cand", "point_chunk",
-                )
-                if not hasattr(self, "_cand_budget"):
-                    self._cand_budget = 4096  # persists across windows
-            else:
-                prunedk = jitted(
-                    range_polygons_pruned_fused, "cand", "point_chunk",
-                    "approximate",
-                )
+        evaluate = self._window_evaluator(query_set, flags, radius, dtype, mesh)
 
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
@@ -132,44 +165,12 @@ class _PointStreamRangeQuery(SpatialOperator):
                 counters.record_window(
                     len(win.events), cand, cand * len(query_set)
                 )
-            common = (
+            keep, dist = evaluate((
                 self.device_xy(batch, dtype),
                 jnp.asarray(batch.valid),
                 jnp.asarray(batch.cell),
                 flags_d,
-            )
-            if self.query_kind == "point":
-                keep, dist = pk(*common, q, radius)
-            elif self.query_kind == "polygon":
-                if use_pruned:
-                    ncand = 8
-                    while True:
-                        if use_compact:
-                            keep, dist, c_over, b_over = prunedk(
-                                *common, qv, qe, radius,
-                                budget=self._cand_budget, cand=ncand,
-                            )
-                        else:
-                            keep, dist, c_over = prunedk(
-                                *common, qv, qe, radius, cand=ncand,
-                            )
-                            b_over = 0
-                        grew = False
-                        if int(b_over) > 0:
-                            need = self._cand_budget + int(b_over)
-                            self._cand_budget = int(
-                                2 ** np.ceil(np.log2(need))
-                            )
-                            grew = True
-                        if int(c_over) > 0 and ncand < len(query_set):
-                            ncand = min(ncand * 2, len(query_set))
-                            grew = True
-                        if not grew:
-                            break
-                else:
-                    keep, dist = polyk(*common, qv, qe, radius)
-            else:
-                keep, dist = lk(*common, qv, qe, radius)
+            ))
             keep = np.asarray(keep)
             dist = np.asarray(dist)
             idx = np.nonzero(keep)[0]
@@ -189,26 +190,16 @@ class _PointStreamRangeQuery(SpatialOperator):
         ``matched_arrays`` is the window's SoA sliced down to the matching
         events (so callers get the actual matches, not just a count).
         Works for every query kind of the family (point / polygon /
-        linestring query sets), same kernels as run()."""
+        linestring query sets), with run()'s exact kernel selection —
+        including the pruned/compact large-polygon-set paths."""
         from spatialflink_tpu.operators.base import soa_point_batches
 
         if not isinstance(query_set, (list, tuple)):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
         flags_d = jnp.asarray(flags)
-        approx = self.conf.approximate_query
-        if self.query_kind == "point":
-            kern = jitted(range_points_fused, "approximate")
-            q = self.device_q(pack_query_points(query_set, np.float64), dtype)
-            qargs = (q,)
-        else:
-            kern = jitted(
-                range_polygons_fused if self.query_kind == "polygon"
-                else range_polylines_fused,
-                "approximate",
-            )
-            verts, ev = pack_query_geometries(query_set, np.float64)
-            qargs = (self.device_q(verts, dtype), jnp.asarray(ev))
+        evaluate = self._window_evaluator(query_set, flags, radius, dtype,
+                                          mesh=None)
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
         for win, xy, valid, cell, _ in soa_point_batches(
@@ -217,10 +208,10 @@ class _PointStreamRangeQuery(SpatialOperator):
             if counters.enabled:
                 cand = count_candidates(flags, cell, win.count)
                 counters.record_candidates(cand, cand * len(query_set))
-            keep, dist = kern(
+            keep, dist = evaluate((
                 jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
-                flags_d, *qargs, radius, approximate=approx,
-            )
+                flags_d,
+            ))
             n = win.count
             keep = np.asarray(keep)[:n]
             idx = np.nonzero(keep)[0]

@@ -335,3 +335,73 @@ def test_soa_point_polygon_range_matches_object_path(rng):
         for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys, r)
     }
     assert soa == obj and soa
+
+
+def test_soa_point_linestring_range_matches_object_path(rng):
+    from spatialflink_tpu.models.objects import LineString
+    from spatialflink_tpu.operators import PointLineStringRangeQuery
+
+    n = 2000
+    ts = np.sort(rng.integers(0, 20_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 5, n).astype(np.int32)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    lines = [LineString(coords=np.array([[2, 2], [5, 5], [8, 3]], float))]
+    r = 0.5
+
+    soa = {
+        (s, e): sorted(zip(m["ts"].tolist(), np.round(dd, 12).tolist()))
+        for s, e, m, dd in PointLineStringRangeQuery(conf, GRID).run_soa(
+            _chunks(ts, xs, ys, oids), lines, r
+        )
+    }
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+    obj = {
+        (res.start, res.end): sorted(
+            zip((p.timestamp for p in res.objects),
+                np.round(res.dists, 12).tolist())
+        )
+        for res in PointLineStringRangeQuery(conf, GRID).run(iter(pts), lines, r)
+    }
+    assert soa == obj and soa
+
+
+def test_soa_large_polygon_set_uses_pruned_path(rng):
+    """run_soa with >=64 exact-mode polygons rides the pruned/compact
+    evaluator (parity + the operator grows persistent budgets)."""
+    from spatialflink_tpu.models.objects import Polygon
+    from spatialflink_tpu.operators import PointPolygonRangeQuery
+
+    n = 2000
+    ts = np.sort(rng.integers(0, 20_000, n)).astype(np.int64)
+    xs = rng.uniform(0, 10, n)
+    ys = rng.uniform(0, 10, n)
+    oids = rng.integers(0, 5, n).astype(np.int32)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+    polys = []
+    for i in range(70):
+        cx, cy = rng.uniform(1, 3), rng.uniform(1, 3)
+        polys.append(Polygon(rings=[np.array(
+            [[cx - .1, cy - .1], [cx + .1, cy - .1], [cx + .1, cy + .1],
+             [cx - .1, cy + .1], [cx - .1, cy - .1]])]))
+    r = 0.15
+
+    op = PointPolygonRangeQuery(conf, GRID)
+    op._cand_budget = 64  # force budget growth through the SoA path
+    soa = {
+        (s, e): sorted(zip(m["ts"].tolist(), np.round(dd, 12).tolist()))
+        for s, e, m, dd in op.run_soa(_chunks(ts, xs, ys, oids), polys, r)
+    }
+    pts = [Point(obj_id=str(o), timestamp=int(t), x=float(x), y=float(y))
+           for t, x, y, o in zip(ts, xs, ys, oids)]
+    obj = {
+        (res.start, res.end): sorted(
+            zip((p.timestamp for p in res.objects),
+                np.round(res.dists, 12).tolist())
+        )
+        for res in PointPolygonRangeQuery(conf, GRID).run(iter(pts), polys, r)
+    }
+    assert soa == obj
+    assert op._cand_budget > 64  # the growth persisted
