@@ -195,4 +195,123 @@ int64_t sf_parse_points_csv(void* interner_h, const char* buf, int64_t len,
   return rows;
 }
 
+// Parse lines "objID<delim>timestamp<delim>WKT" where WKT is a single-ring
+// POLYGON ((x y, ...)) or a LINESTRING (x y, ...) — the reference's WKT
+// trajectory wire format (Deserialization.java WKTToTSpatial; the WKT
+// output schemas prepend objID + timestamp). Emits the ragged SoA layout
+// GeometryBatch.from_ragged takes: per-row (ts, interned oid, chain
+// length, polygonal flag) + flat vertex pairs. Open polygon rings are
+// closed (pack_rings' contract). Multi-ring polygons, other geometry
+// types, and malformed lines are SKIPPED and counted into *skipped (the
+// Python object path handles them). Returns rows written; parsing stops
+// early (rows so far returned) if the vertex capacity would overflow.
+int64_t sf_parse_wkt_geoms(void* interner_h, const char* buf, int64_t len,
+                           char delim, int64_t max_rows, int64_t max_verts,
+                           int64_t* out_ts, int32_t* out_oid,
+                           int64_t* out_lengths, uint8_t* out_polygonal,
+                           double* out_verts, int64_t* skipped) {
+  auto* interner = static_cast<Interner*>(interner_h);
+  int64_t rows = 0;
+  int64_t nv = 0;  // vertices written (pairs)
+  *skipped = 0;
+  const char* p = buf;
+  const char* buf_end = buf + len;
+
+  auto starts_with = [](std::string_view s, std::string_view pre) {
+    return s.size() >= pre.size() &&
+           std::memcmp(s.data(), pre.data(), pre.size()) == 0;
+  };
+
+  while (p < buf_end && rows < max_rows) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(buf_end - p)));
+    if (line_end == nullptr) line_end = buf_end;
+    const char* line_start = p;
+    p = line_end + 1;
+
+    // Split objID | ts | wkt-rest on the first two delimiters.
+    const char* c1 = static_cast<const char*>(
+        std::memchr(line_start, delim,
+                    static_cast<size_t>(line_end - line_start)));
+    if (c1 == nullptr) { if (line_end > line_start) ++*skipped; continue; }
+    const char* c2 = static_cast<const char*>(
+        std::memchr(c1 + 1, delim, static_cast<size_t>(line_end - c1 - 1)));
+    if (c2 == nullptr) { ++*skipped; continue; }
+    std::string_view oid_sv = trim(
+        std::string_view(line_start, static_cast<size_t>(c1 - line_start)));
+    int64_t ts_val = parse_long(c1 + 1, c2);
+    std::string_view wkt = trim(
+        std::string_view(c2 + 1, static_cast<size_t>(line_end - c2 - 1)));
+
+    bool polygonal;
+    size_t open_parens;
+    if (starts_with(wkt, "POLYGON")) {
+      polygonal = true;
+      open_parens = 2;  // POLYGON ((ring))
+      wkt.remove_prefix(7);
+    } else if (starts_with(wkt, "LINESTRING")) {
+      polygonal = false;
+      open_parens = 1;
+      wkt.remove_prefix(10);
+    } else {
+      ++*skipped;
+      continue;
+    }
+    // Consume expected opening parens (whitespace-tolerant).
+    size_t i = 0, seen = 0;
+    while (i < wkt.size() && seen < open_parens) {
+      if (wkt[i] == '(') ++seen;
+      else if (wkt[i] != ' ' && wkt[i] != '\t') break;
+      ++i;
+    }
+    if (seen != open_parens) { ++*skipped; continue; }
+
+    // Read "x y" pairs separated by ','; stop at ')'.
+    int64_t start_nv = nv;
+    bool ok = true, closed = false;
+    while (i < wkt.size()) {
+      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
+      // number number
+      const char* xs = wkt.data() + i;
+      double xv = 0.0, yv = 0.0;
+      auto rx = std::from_chars(xs, wkt.data() + wkt.size(), xv);
+      if (rx.ec != std::errc()) { ok = false; break; }
+      i = static_cast<size_t>(rx.ptr - wkt.data());
+      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
+      auto ry = std::from_chars(wkt.data() + i, wkt.data() + wkt.size(), yv);
+      if (ry.ec != std::errc()) { ok = false; break; }
+      i = static_cast<size_t>(ry.ptr - wkt.data());
+      if (nv >= max_verts) { nv = start_nv; return rows; }  // capacity stop
+      out_verts[2 * nv] = xv;
+      out_verts[2 * nv + 1] = yv;
+      ++nv;
+      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
+      if (i < wkt.size() && wkt[i] == ',') { ++i; continue; }
+      if (i < wkt.size() && wkt[i] == ')') { closed = true; ++i; break; }
+      ok = false;
+      break;
+    }
+    if (!ok || !closed || nv - start_nv < 2) { nv = start_nv; ++*skipped; continue; }
+    if (polygonal) {
+      // Reject multi-ring: after the ring's ')', a ',' introduces a hole.
+      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
+      if (i < wkt.size() && wkt[i] == ',') { nv = start_nv; ++*skipped; continue; }
+      // Close an open ring (pack_rings' contract).
+      if (out_verts[2 * start_nv] != out_verts[2 * (nv - 1)] ||
+          out_verts[2 * start_nv + 1] != out_verts[2 * (nv - 1) + 1]) {
+        if (nv >= max_verts) { nv = start_nv; return rows; }
+        out_verts[2 * nv] = out_verts[2 * start_nv];
+        out_verts[2 * nv + 1] = out_verts[2 * start_nv + 1];
+        ++nv;
+      }
+    }
+    out_ts[rows] = ts_val;
+    out_oid[rows] = interner->intern(oid_sv);
+    out_lengths[rows] = nv - start_nv;
+    out_polygonal[rows] = polygonal ? 1 : 0;
+    ++rows;
+  }
+  return rows;
+}
+
 }  // extern "C"

@@ -24,10 +24,13 @@ _build_failed = False
 
 
 def ensure_built(quiet: bool = True) -> bool:
-    """Build the shared library if missing. Returns availability."""
+    """(Re)build the shared library. Returns availability.
+
+    Always invokes make (an incremental no-op when up to date): merely
+    checking for the .so would leave a STALE prebuilt library fatal when
+    _load() looks up a newly added symbol (AttributeError instead of the
+    documented graceful fallback)."""
     global _build_failed
-    if os.path.exists(_LIB_PATH):
-        return True
     if _build_failed:
         return False
     try:
@@ -39,7 +42,7 @@ def ensure_built(quiet: bool = True) -> bool:
         return os.path.exists(_LIB_PATH)
     except (subprocess.CalledProcessError, FileNotFoundError):
         _build_failed = True
-        return False
+        return os.path.exists(_LIB_PATH)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -71,6 +74,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, i64_p, dbl_p, dbl_p, i32_p,
     ]
     lib.sf_parse_points_csv.restype = ctypes.c_int64
+    u8_p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.sf_parse_wkt_geoms.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+        ctypes.c_int64, ctypes.c_int64, i64_p, i32_p, i64_p, u8_p, dbl_p,
+        np.ctypeslib.ndpointer(np.int64, shape=(1,), flags="C_CONTIGUOUS"),
+    ]
+    lib.sf_parse_wkt_geoms.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
@@ -79,20 +89,14 @@ def available() -> bool:
     return _load() is not None
 
 
-class NativeGpsParser:
-    """Buffer-at-a-time 14-column GPS CSV parser with device interning.
-
-    ``parse(data)`` → dict of SoA numpy arrays (ts, lon, lat, speed, fa,
-    ff, dev). Device ids are dense int32, stable across calls; decode with
-    ``device_name(id)`` / ``device_table()``.
-    """
+class _NativeInternerParser:
+    """Shared ctypes lifecycle for the native parsers: library handle,
+    interner ownership, id→string lookups, delimiter encoding."""
 
     def __init__(self, delimiter: str = ","):
         lib = _load()
         if lib is None:
-            raise RuntimeError(
-                "native library unavailable (build failed); use the Python serde"
-            )
+            raise RuntimeError("native library unavailable")
         self._lib = lib
         self._h = lib.sf_interner_new()
         self.delimiter = delimiter.encode()[:1]
@@ -101,6 +105,26 @@ class NativeGpsParser:
         if getattr(self, "_h", None) and self._lib is not None:
             self._lib.sf_interner_free(self._h)
             self._h = None
+
+    @property
+    def num_objects(self) -> int:
+        return int(self._lib.sf_interner_size(self._h))
+
+    def object_name(self, oid: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        n = self._lib.sf_interner_get(self._h, oid, buf, 256)
+        if n < 0:
+            raise KeyError(oid)
+        return buf.value.decode()
+
+
+class NativeGpsParser(_NativeInternerParser):
+    """Buffer-at-a-time 14-column GPS CSV parser with device interning.
+
+    ``parse(data)`` → dict of SoA numpy arrays (ts, lon, lat, speed, fa,
+    ff, dev). Device ids are dense int32, stable across calls; decode with
+    ``device_name(id)`` / ``device_table()``.
+    """
 
     def parse(self, data: bytes | str) -> Dict[str, np.ndarray]:
         if isinstance(data, str):
@@ -137,22 +161,12 @@ class NativeGpsParser:
         return [self.device_name(i) for i in range(self.num_devices)]
 
 
-class NativePointParser:
+class NativePointParser(_NativeInternerParser):
     """Schema-positional point CSV parser (csvTsvSchemaAttr semantics)."""
 
     def __init__(self, schema=(0, 1, 2, 3), delimiter: str = ","):
-        lib = _load()
-        if lib is None:
-            raise RuntimeError("native library unavailable")
-        self._lib = lib
-        self._h = lib.sf_interner_new()
+        super().__init__(delimiter)
         self.schema = tuple(int(i) for i in schema)
-        self.delimiter = delimiter.encode()[:1]
-
-    def __del__(self):
-        if getattr(self, "_h", None) and self._lib is not None:
-            self._lib.sf_interner_free(self._h)
-            self._h = None
 
     def parse(self, data: bytes | str) -> Dict[str, np.ndarray]:
         if isinstance(data, str):
@@ -169,13 +183,48 @@ class NativePointParser:
         )
         return {"ts": ts[:n], "x": x[:n], "y": y[:n], "oid": oid[:n]}
 
-    @property
-    def num_objects(self) -> int:
-        return int(self._lib.sf_interner_size(self._h))
 
-    def object_name(self, oid: int) -> str:
-        buf = ctypes.create_string_buffer(256)
-        n = self._lib.sf_interner_get(self._h, oid, buf, 256)
-        if n < 0:
-            raise KeyError(oid)
-        return buf.value.decode()
+class NativeWktParser(_NativeInternerParser):
+    """WKT geometry-line parser → ragged SoA chunks.
+
+    Wire format: ``objID<delim>timestamp<delim>WKT`` (the reference's WKT
+    trajectory lines — Deserialization.java's WKTToTSpatial reads what the
+    WKT output schemas write). Single-ring POLYGONs (closed on parse) and
+    LINESTRINGs are parsed natively into the exact chunk layout
+    ``RaggedSoaWindowAssembler``/``GeometryBatch.from_ragged`` take;
+    multi-ring/other/malformed lines are skipped and counted
+    (``last_skipped``) for the Python object path to handle.
+    """
+
+    def __init__(self, delimiter: str = ","):
+        super().__init__(delimiter)
+        self.last_skipped = 0
+
+    def parse(self, data: bytes | str) -> Dict[str, np.ndarray]:
+        if isinstance(data, str):
+            data = data.encode()
+        max_rows = data.count(b"\n") + 1
+        # Vertex upper bound: every vertex needs a ',' or ')' after it, and
+        # polygon closing can add one vertex per row — overflow-free by
+        # construction, so the kernel's capacity early-stop never triggers.
+        max_verts = data.count(b",") + 2 * max_rows + 2
+        ts = np.empty(max_rows, np.int64)
+        oid = np.empty(max_rows, np.int32)
+        lengths = np.empty(max_rows, np.int64)
+        polygonal = np.empty(max_rows, np.uint8)
+        verts = np.empty((max_verts, 2), np.float64)
+        skipped = np.zeros(1, np.int64)
+        n = self._lib.sf_parse_wkt_geoms(
+            self._h, data, len(data), self.delimiter,
+            max_rows, max_verts, ts, oid, lengths, polygonal,
+            verts.reshape(-1), skipped,
+        )
+        self.last_skipped = int(skipped[0])
+        total = int(lengths[:n].sum())
+        return {
+            "ts": ts[:n].copy(),
+            "oid": oid[:n].copy(),
+            "lengths": lengths[:n].copy(),
+            "polygonal": polygonal[:n].copy(),
+            "verts": verts[:total].copy(),
+        }
