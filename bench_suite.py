@@ -334,6 +334,60 @@ def bench_join(jax, jnp, grid, quick):
     )
 
 
+def bench_knn_multi_query(jax, jnp, grid, quick):
+    """Extension config: batched MULTI-query kNN — 64 query points answered
+    by ONE fused program per window (ops/knn.py:knn_multi_query_kernel),
+    each query pruning by its own flag table. Not a BASELINE.json config;
+    recorded to show the query-set batching surface's throughput."""
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.knn import knn_multi_query_kernel
+
+    nq, k = 64, 10
+    win_pts = 262_144
+    n_win = 3 if quick else 6
+    rng = np.random.default_rng(23)
+    qxy = np.stack(
+        [rng.uniform(115.6, 117.5, nq), rng.uniform(39.7, 41.0, nq)], axis=1
+    ).astype(np.float32)
+    tables = np.stack([
+        grid.neighbor_flags(0.05, [grid.flat_cell(*p)]) for p in qxy
+    ])
+    xy, oid, ts = _stream(win_pts * n_win, seed=29)
+    oid16 = oid.astype(np.int16)
+    dev = jax.devices()[0]
+    q_d = jax.device_put(jnp.asarray(qxy), dev)
+    tables_d = jax.device_put(jnp.asarray(tables), dev)
+    valid_d = jax.device_put(jnp.asarray(np.ones(win_pts, bool)), dev)
+
+    def step(xy_w, oid16_w, valid, ftabs, queries):
+        cell = assign_cells(
+            xy_w, grid.min_x, grid.min_y, grid.cell_length, grid.n
+        )
+        return knn_multi_query_kernel(
+            xy_w, valid, cell, ftabs, oid16_w.astype(jnp.int32), queries,
+            np.float32(0.05), k=k, num_segments=16_384, query_block=32,
+        )
+
+    jstep = jax.jit(step)
+
+    def win_arrays(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        return (
+            jax.device_put(xy[sl], dev),
+            jax.device_put(oid16[sl], dev),
+        )
+
+    xa, oa = win_arrays(0)
+    jax.device_get(jstep(xa, oa, valid_d, tables_d, q_d).num_valid)
+
+    out, dt = _pipelined(
+        jax, n_win, win_arrays,
+        lambda args: jstep(*args, valid_d, tables_d, q_d).num_valid,
+    )
+    return _result(f"knn_multi_{nq}queries_k{k}", n_win * win_pts, dt,
+                   {"num_valid_min": int(min(v.min() for v in out))})
+
+
 def bench_tstats_pane(jax, jnp, grid, quick):
     """tStats through the reference's extreme-overlap 10s/10ms sliding
     config (Q2_BrakeMonitor-style) via pane decomposition
@@ -473,6 +527,7 @@ def main():
         bench_join(jax, jnp, grid, args.quick),
         bench_tknn(jax, jnp, grid, args.quick),
         bench_tstats_pane(jax, jnp, grid, args.quick),
+        bench_knn_multi_query(jax, jnp, grid, args.quick),
     ]
     if args.cpu_baseline:
         results.append(bench_headline_knn_1m(jax, jnp, grid))

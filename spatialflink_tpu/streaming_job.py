@@ -11,8 +11,9 @@ line-record boundary) and dispatches on ``query.option``:
       (StreamingJob.java:254-263)
   2 = Range query, real-time, Point stream × Point query set (:265-275)
   (extensions) 3 = window kNN, 4 = realtime kNN, 5 = window join,
-  6 = tStats, 7 = tAggregate — the operator families the reference keeps
-  in its commented-out cases.
+  6 = tStats, 7 = tAggregate, 8 = multi-query window kNN (one fused
+  program answers the whole queryPoints set per window) — the operator
+  families the reference keeps in its commented-out cases.
 """
 
 from __future__ import annotations
@@ -169,6 +170,13 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
             for a, b, d in res.pairs:
                 sink(f"{res.start},{res.end},{a.obj_id},{b.obj_id},{float(d)!r}")
                 n += 1
+    elif option == 8:
+        op = PointPointKNNQuery(window_conf, grid, mesh=mesh)
+        for res in op.run_multi(source, q_points, q.radius, q.k):
+            for qi, r_ in enumerate(res.results):
+                for oid, d, p in r_.neighbors:
+                    sink(f"{res.start},{res.end},{qi},{oid},{float(d)!r}")
+                    n += 1
     elif option == 6:
         op = TStatsQuery(window_conf, grid, mesh=mesh)
         for res in op.run(source):
@@ -185,7 +193,7 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
                 sink(f"{res.start},{res.end},{cell},{cnt},{lens}")
                 n += 1
     else:
-        raise SystemExit(f"Unrecognized query option {option}. Use 1-7.")
+        raise SystemExit(f"Unrecognized query option {option}. Use 1-8.")
     return n
 
 

@@ -154,7 +154,8 @@ def test_socket_source_loopback():
 
 
 def test_streaming_job_remaining_options(tmp_path):
-    """CLI options 2 (realtime range), 5 (join), 7 (tAggregate)."""
+    """CLI options 2 (realtime range), 5 (join), 7 (tAggregate),
+    8 (multi-query kNN)."""
     from spatialflink_tpu.streaming_job import main
 
     base = """
@@ -172,6 +173,7 @@ query:
   aggregateFunction: "SUM"
   queryPoints:
     - [5.0, 5.0]
+    - [4.5, 5.2]
 window:
   type: "TIME"
   interval: 10
@@ -184,7 +186,7 @@ window:
         f"dev{i%3},{(i % 40) * 250},{4 + 0.02*(i % 40)},{5 + 0.01*(i % 40)}"
         for i in range(80)
     ))
-    for opt in (2, 5, 7):
+    for opt in (2, 5, 7, 8):
         conf = tmp_path / f"c{opt}.yml"
         conf.write_text(base.format(opt=opt))
         out = tmp_path / f"o{opt}.csv"
