@@ -195,24 +195,29 @@ int64_t sf_parse_points_csv(void* interner_h, const char* buf, int64_t len,
   return rows;
 }
 
-// Parse lines "objID<delim>timestamp<delim>WKT" where WKT is a single-ring
-// POLYGON ((x y, ...)) or a LINESTRING (x y, ...) — the reference's WKT
-// trajectory wire format (Deserialization.java WKTToTSpatial; the WKT
-// output schemas prepend objID + timestamp). Emits the ragged SoA layout
-// GeometryBatch.from_ragged takes: per-row (ts, interned oid, chain
-// length, polygonal flag) + flat vertex pairs. Open polygon rings are
-// closed (pack_rings' contract). Multi-ring polygons, other geometry
-// types, and malformed lines are SKIPPED and counted into *skipped (the
-// Python object path handles them). Returns rows written; parsing stops
-// early (rows so far returned) if the vertex capacity would overflow.
+// Parse lines "objID<delim>timestamp<delim>WKT" where WKT is a POLYGON
+// (any number of rings — holes supported) or a LINESTRING — the
+// reference's WKT trajectory wire format (Deserialization.java
+// WKTToTSpatial; the WKT output schemas prepend objID + timestamp).
+// Emits the ragged SoA layout GeometryBatch.from_ragged takes: per-row
+// (ts, interned oid, chain length, polygonal flag), flat vertex pairs,
+// and a flat per-object edge mask of (length-1) entries matching
+// pack_rings' contract exactly: rings are closed if open, consecutive
+// rings concatenate into one chain with the seam edge invalid. Other
+// geometry types and malformed lines are SKIPPED and counted into
+// *skipped (the Python object path handles them). Returns rows written;
+// parsing stops early (rows so far returned) if the vertex capacity
+// would overflow.
 int64_t sf_parse_wkt_geoms(void* interner_h, const char* buf, int64_t len,
                            char delim, int64_t max_rows, int64_t max_verts,
                            int64_t* out_ts, int32_t* out_oid,
                            int64_t* out_lengths, uint8_t* out_polygonal,
-                           double* out_verts, int64_t* skipped) {
+                           double* out_verts, uint8_t* out_edges,
+                           int64_t* skipped) {
   auto* interner = static_cast<Interner*>(interner_h);
   int64_t rows = 0;
   int64_t nv = 0;  // vertices written (pairs)
+  int64_t ne = 0;  // edge-mask entries written
   *skipped = 0;
   const char* p = buf;
   const char* buf_end = buf + len;
@@ -244,66 +249,87 @@ int64_t sf_parse_wkt_geoms(void* interner_h, const char* buf, int64_t len,
         std::string_view(c2 + 1, static_cast<size_t>(line_end - c2 - 1)));
 
     bool polygonal;
-    size_t open_parens;
     if (starts_with(wkt, "POLYGON")) {
       polygonal = true;
-      open_parens = 2;  // POLYGON ((ring))
       wkt.remove_prefix(7);
     } else if (starts_with(wkt, "LINESTRING")) {
       polygonal = false;
-      open_parens = 1;
       wkt.remove_prefix(10);
     } else {
       ++*skipped;
       continue;
     }
-    // Consume expected opening parens (whitespace-tolerant).
-    size_t i = 0, seen = 0;
-    while (i < wkt.size() && seen < open_parens) {
-      if (wkt[i] == '(') ++seen;
-      else if (wkt[i] != ' ' && wkt[i] != '\t') break;
+
+    size_t i = 0;
+    auto skip_ws = [&]() {
+      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
+    };
+    skip_ws();
+    // POLYGON has an outer paren around the ring list.
+    if (polygonal) {
+      if (i >= wkt.size() || wkt[i] != '(') { ++*skipped; continue; }
       ++i;
     }
-    if (seen != open_parens) { ++*skipped; continue; }
 
-    // Read "x y" pairs separated by ','; stop at ')'.
     int64_t start_nv = nv;
-    bool ok = true, closed = false;
-    while (i < wkt.size()) {
-      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
-      // number number
-      const char* xs = wkt.data() + i;
-      double xv = 0.0, yv = 0.0;
-      auto rx = std::from_chars(xs, wkt.data() + wkt.size(), xv);
-      if (rx.ec != std::errc()) { ok = false; break; }
-      i = static_cast<size_t>(rx.ptr - wkt.data());
-      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
-      auto ry = std::from_chars(wkt.data() + i, wkt.data() + wkt.size(), yv);
-      if (ry.ec != std::errc()) { ok = false; break; }
-      i = static_cast<size_t>(ry.ptr - wkt.data());
-      if (nv >= max_verts) { nv = start_nv; return rows; }  // capacity stop
-      out_verts[2 * nv] = xv;
-      out_verts[2 * nv + 1] = yv;
-      ++nv;
-      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
-      if (i < wkt.size() && wkt[i] == ',') { ++i; continue; }
-      if (i < wkt.size() && wkt[i] == ')') { closed = true; ++i; break; }
-      ok = false;
-      break;
-    }
-    if (!ok || !closed || nv - start_nv < 2) { nv = start_nv; ++*skipped; continue; }
-    if (polygonal) {
-      // Reject multi-ring: after the ring's ')', a ',' introduces a hole.
-      while (i < wkt.size() && (wkt[i] == ' ' || wkt[i] == '\t')) ++i;
-      if (i < wkt.size() && wkt[i] == ',') { nv = start_nv; ++*skipped; continue; }
-      // Close an open ring (pack_rings' contract).
-      if (out_verts[2 * start_nv] != out_verts[2 * (nv - 1)] ||
-          out_verts[2 * start_nv + 1] != out_verts[2 * (nv - 1) + 1]) {
-        if (nv >= max_verts) { nv = start_nv; return rows; }
-        out_verts[2 * nv] = out_verts[2 * start_nv];
-        out_verts[2 * nv + 1] = out_verts[2 * start_nv + 1];
+    int64_t start_ne = ne;
+    bool ok = true;
+
+    // One chain (LINESTRING) or one ring per iteration (POLYGON).
+    while (ok) {
+      skip_ws();
+      if (i >= wkt.size() || wkt[i] != '(') { ok = false; break; }
+      ++i;
+      int64_t ring_nv = nv;
+      bool ring_closed = false;
+      while (i < wkt.size()) {
+        skip_ws();
+        double xv = 0.0, yv = 0.0;
+        auto rx = std::from_chars(wkt.data() + i, wkt.data() + wkt.size(), xv);
+        if (rx.ec != std::errc()) break;
+        i = static_cast<size_t>(rx.ptr - wkt.data());
+        skip_ws();
+        auto ry = std::from_chars(wkt.data() + i, wkt.data() + wkt.size(), yv);
+        if (ry.ec != std::errc()) break;
+        i = static_cast<size_t>(ry.ptr - wkt.data());
+        if (nv >= max_verts) { nv = start_nv; ne = start_ne; return rows; }
+        if (nv > start_nv) {
+          // Edge into this vertex: valid within a ring, invalid across
+          // the seam from the previous ring's last vertex.
+          out_edges[ne++] = (nv > ring_nv) ? 1 : 0;
+        }
+        out_verts[2 * nv] = xv;
+        out_verts[2 * nv + 1] = yv;
         ++nv;
+        skip_ws();
+        if (i < wkt.size() && wkt[i] == ',') { ++i; continue; }
+        if (i < wkt.size() && wkt[i] == ')') { ring_closed = true; ++i; break; }
+        break;
       }
+      if (!ring_closed || nv - ring_nv < 2) { ok = false; break; }
+      if (polygonal) {
+        // Close an open ring (pack_rings' contract).
+        if (out_verts[2 * ring_nv] != out_verts[2 * (nv - 1)] ||
+            out_verts[2 * ring_nv + 1] != out_verts[2 * (nv - 1) + 1]) {
+          if (nv >= max_verts) { nv = start_nv; ne = start_ne; return rows; }
+          out_edges[ne++] = 1;
+          out_verts[2 * nv] = out_verts[2 * ring_nv];
+          out_verts[2 * nv + 1] = out_verts[2 * ring_nv + 1];
+          ++nv;
+        }
+        skip_ws();
+        if (i < wkt.size() && wkt[i] == ',') { ++i; continue; }  // next ring
+        if (i < wkt.size() && wkt[i] == ')') { ++i; break; }      // ring list end
+        ok = false;
+        break;
+      }
+      break;  // LINESTRING: single chain
+    }
+    if (!ok || nv - start_nv < 2) {
+      nv = start_nv;
+      ne = start_ne;
+      ++*skipped;
+      continue;
     }
     out_ts[rows] = ts_val;
     out_oid[rows] = interner->intern(oid_sv);
@@ -313,5 +339,9 @@ int64_t sf_parse_wkt_geoms(void* interner_h, const char* buf, int64_t len,
   }
   return rows;
 }
+
+// Bump whenever any exported signature changes; native.py refuses to bind
+// a library whose version differs (stale prebuilt .so protection).
+int32_t sf_abi_version() { return 2; }
 
 }  // extern "C"

@@ -132,6 +132,7 @@ class GeometryBatch:
         oid: np.ndarray,
         lengths: np.ndarray,
         verts_flat: np.ndarray,
+        edge_valid_flat: Optional[np.ndarray] = None,
         bucket: Optional[int] = None,
         vert_bucket: Optional[int] = None,
         dtype=np.float64,
@@ -140,10 +141,13 @@ class GeometryBatch:
         analog of the point SoA fast path: no per-object Python.
 
         ``lengths[i]`` vertices of object ``i`` occupy the corresponding
-        run of ``verts_flat``, as one PACKED boundary chain (closed ring
+        run of ``verts_flat`` as one PACKED boundary chain (closed rings
         for polygons — ``pack_rings``' contract — open for polylines).
-        Single-chain objects only; multi-ring geometries need
-        ``from_objects``. ``oid`` must already be dense int32.
+        ``edge_valid_flat``: optional flat per-object (length−1)-run edge
+        mask — REQUIRED for multi-ring chains (ring seam edges invalid,
+        pack_rings' layout; the native WKT parser emits it); omitted, all
+        within-chain edges are valid (single-chain objects).
+        ``oid`` must already be dense int32.
         """
         n = len(ts)
         lengths = np.asarray(lengths, np.int64)
@@ -171,7 +175,24 @@ class GeometryBatch:
         verts = np.where(
             mask[:, :, None], verts_flat[gather], 0.0
         ).astype(dtype)
-        ev = lane[None, : v - 1] < (lengths - 1)[:, None]
+        if edge_valid_flat is None:
+            ev = lane[None, : v - 1] < (lengths - 1)[:, None]
+        else:
+            edge_valid_flat = np.asarray(edge_valid_flat, bool)
+            e_lens = lengths - 1
+            if int(e_lens.sum()) != len(edge_valid_flat):
+                raise ValueError(
+                    f"edge mask has {len(edge_valid_flat)} entries; "
+                    f"lengths-1 sums to {int(e_lens.sum())}"
+                )
+            e_off = np.concatenate([[0], np.cumsum(e_lens)])
+            e_total = int(e_off[-1])
+            e_gather = np.minimum(e_off[:-1, None] + lane[None, : v - 1],
+                                  max(e_total - 1, 0))
+            in_run = lane[None, : v - 1] < e_lens[:, None]
+            src = (edge_valid_flat[e_gather] if e_total
+                   else np.zeros((n, v - 1), bool))
+            ev = in_run & src
 
         # Per-object bbox via ragged reduceat (empty-safe: n>0 runs only).
         if n:

@@ -21,6 +21,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libsfnative.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_ABI_VERSION = 2  # must match sf_abi_version() in sfnative.cpp
 
 
 def ensure_built(quiet: bool = True) -> bool:
@@ -52,6 +53,15 @@ def _load() -> Optional[ctypes.CDLL]:
     if not ensure_built():
         return None
     lib = ctypes.CDLL(_LIB_PATH)
+    # ABI guard: a stale prebuilt .so with the right symbols but an older
+    # signature would corrupt memory through mismatched argtypes.
+    try:
+        lib.sf_abi_version.restype = ctypes.c_int32
+        abi = int(lib.sf_abi_version())
+    except AttributeError:
+        abi = -1
+    if abi != _ABI_VERSION:
+        return None
     lib.sf_interner_new.restype = ctypes.c_void_p
     lib.sf_interner_free.argtypes = [ctypes.c_void_p]
     lib.sf_interner_size.argtypes = [ctypes.c_void_p]
@@ -78,6 +88,7 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.sf_parse_wkt_geoms.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
         ctypes.c_int64, ctypes.c_int64, i64_p, i32_p, i64_p, u8_p, dbl_p,
+        u8_p,
         np.ctypeslib.ndpointer(np.int64, shape=(1,), flags="C_CONTIGUOUS"),
     ]
     lib.sf_parse_wkt_geoms.restype = ctypes.c_int64
@@ -189,11 +200,12 @@ class NativeWktParser(_NativeInternerParser):
 
     Wire format: ``objID<delim>timestamp<delim>WKT`` (the reference's WKT
     trajectory lines — Deserialization.java's WKTToTSpatial reads what the
-    WKT output schemas write). Single-ring POLYGONs (closed on parse) and
-    LINESTRINGs are parsed natively into the exact chunk layout
-    ``RaggedSoaWindowAssembler``/``GeometryBatch.from_ragged`` take;
-    multi-ring/other/malformed lines are skipped and counted
-    (``last_skipped``) for the Python object path to handle.
+    WKT output schemas write). POLYGONs — any ring count, holes included —
+    and LINESTRINGs parse natively into the exact chunk layout
+    ``RaggedSoaWindowAssembler``/``GeometryBatch.from_ragged`` take
+    (rings closed + seam edges invalidated, pack_rings' contract, via the
+    flat ``edge_valid`` mask); other/malformed lines are skipped and
+    counted (``last_skipped``) for the Python object path to handle.
     """
 
     def __init__(self, delimiter: str = ","):
@@ -204,20 +216,22 @@ class NativeWktParser(_NativeInternerParser):
         if isinstance(data, str):
             data = data.encode()
         max_rows = data.count(b"\n") + 1
-        # Vertex upper bound: every vertex needs a ',' or ')' after it, and
-        # polygon closing can add one vertex per row — overflow-free by
-        # construction, so the kernel's capacity early-stop never triggers.
-        max_verts = data.count(b",") + 2 * max_rows + 2
+        # Vertex upper bound: every parsed vertex is followed by a ',' or
+        # ')' and ring closing can add one vertex PER RING (each ring ends
+        # with its own ')') — counting both keeps the kernel's capacity
+        # early-stop unreachable by construction.
+        max_verts = data.count(b",") + data.count(b")") + 2 * max_rows + 2
         ts = np.empty(max_rows, np.int64)
         oid = np.empty(max_rows, np.int32)
         lengths = np.empty(max_rows, np.int64)
         polygonal = np.empty(max_rows, np.uint8)
         verts = np.empty((max_verts, 2), np.float64)
+        edges = np.empty(max_verts, np.uint8)
         skipped = np.zeros(1, np.int64)
         n = self._lib.sf_parse_wkt_geoms(
             self._h, data, len(data), self.delimiter,
             max_rows, max_verts, ts, oid, lengths, polygonal,
-            verts.reshape(-1), skipped,
+            verts.reshape(-1), edges, skipped,
         )
         self.last_skipped = int(skipped[0])
         total = int(lengths[:n].sum())
@@ -227,4 +241,6 @@ class NativeWktParser(_NativeInternerParser):
             "lengths": lengths[:n].copy(),
             "polygonal": polygonal[:n].copy(),
             "verts": verts[:total].copy(),
+            "edge_valid": edges[:total - n].astype(bool) if n else
+            np.zeros(0, bool),
         }

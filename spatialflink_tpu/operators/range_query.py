@@ -418,7 +418,8 @@ class _GeometryStreamRangeQuery(SpatialOperator):
         )
         for win in asm.stream(chunks):
             batch = GeometryBatch.from_ragged(
-                win.ts, win.oid, win.lengths, win.verts, dtype=np.float64
+                win.ts, win.oid, win.lengths, win.verts,
+                edge_valid_flat=win.edge_valid, dtype=np.float64,
             )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             keep, dist = gk(

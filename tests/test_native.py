@@ -113,24 +113,32 @@ def test_wkt_parser_roundtrips_serde_output(rng):
                 obj_id=f"l{i}", timestamp=i * 100,
                 coords=rng.uniform(0, 10, (4, 2)),
             ))
+    # A polygon WITH A HOLE parses natively too (multi-ring chains with
+    # seam edges invalidated, pack_rings' layout); junk is skipped.
+    objs.append(Polygon(
+        obj_id="hole", timestamp=9999,
+        rings=[np.array([[0, 0], [5, 0], [5, 5], [0, 0]], float),
+               np.array([[1, 1], [2, 1], [1, 2], [1, 1]], float)],
+    ))
     lines = [f"{o.obj_id},{o.timestamp},{to_wkt(o)}" for o in objs]
-    # A multi-ring polygon and junk: both must be skipped, not crash.
-    lines.append("hole,9999,POLYGON ((0 0, 5 0, 5 5, 0 0), (1 1, 2 1, 1 2, 1 1))")
     lines.append("junk,1,POINT (1 2)")
 
     p = NativeWktParser()
     chunk = p.parse("\n".join(lines))
-    assert p.last_skipped == 2
+    assert p.last_skipped == 1
     assert len(chunk["ts"]) == len(objs)
     offsets = np.concatenate([[0], np.cumsum(chunk["lengths"])])
+    e_offsets = np.concatenate([[0], np.cumsum(chunk["lengths"] - 1)])
     for i, o in enumerate(objs):
         assert chunk["ts"][i] == o.timestamp
         assert p.object_name(int(chunk["oid"][i])) == o.obj_id
         got = chunk["verts"][offsets[i]:offsets[i + 1]]
+        got_ev = chunk["edge_valid"][e_offsets[i]:e_offsets[i + 1]]
         ref = parse_wkt(to_wkt(o))
         pv, pe = ref.packed()
-        ln = int(pe.sum()) + 1
-        np.testing.assert_allclose(got, pv[:ln], rtol=0, atol=0)
+        assert len(got) == len(pv) and len(got_ev) == len(pe)
+        np.testing.assert_allclose(got, pv, rtol=0, atol=0)
+        np.testing.assert_array_equal(got_ev, pe)
         assert bool(chunk["polygonal"][i]) == isinstance(o, Polygon)
 
 
@@ -208,3 +216,53 @@ def test_wkt_parser_throughput():
     rate = n / dt
     assert len(chunk["ts"]) == n
     assert rate > 1_000_000, f"native WKT parse too slow: {rate:.0f} rows/s"
+
+
+@needs_native
+def test_wkt_holes_through_geometry_soa_pipeline(rng):
+    """Polygons WITH HOLES through the native parser + ragged SoA range:
+    a query point inside a hole must NOT count as contained — parity with
+    the object path end to end."""
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.models.objects import Point
+    from spatialflink_tpu.native import NativeWktParser
+    from spatialflink_tpu.operators import (
+        PolygonPointRangeQuery,
+        QueryConfiguration,
+        QueryType,
+    )
+    from spatialflink_tpu.streams.serde import parse_wkt
+
+    grid = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
+                              slide_step=10)
+    # Donut centered at (5,5): hole spans (4..6)^2; query point sits in
+    # the hole, so distance is to the hole boundary, not 0.
+    wkts = [
+        "donut,100,POLYGON ((2 2, 8 2, 8 8, 2 8, 2 2), "
+        "(4 4, 6 4, 6 6, 4 6, 4 4))",
+        "solid,200,POLYGON ((1 1, 3 1, 3 3, 1 3, 1 1))",
+    ]
+    objs = [parse_wkt(w.split(",", 2)[2], obj_id=w.split(",")[0],
+                      timestamp=int(w.split(",")[1])) for w in wkts]
+    q = Point(x=5.0, y=5.0)
+    r = 1.5
+
+    obj_res = [
+        sorted((p.obj_id, round(float(d), 12))
+               for p, d in zip(res.objects, res.dists))
+        for res in PolygonPointRangeQuery(conf, grid).run(iter(objs), [q], r)
+    ]
+    parser = NativeWktParser()
+    chunk = parser.parse("\n".join(wkts))
+    assert parser.last_skipped == 0
+    soa_res = [
+        sorted((parser.object_name(int(o)), round(float(d), 12))
+               for o, d in zip(oids, dists))
+        for s_, e, idx, oids, dists, cnt in
+        PolygonPointRangeQuery(conf, grid).run_soa(iter([chunk]), [q], r)
+    ]
+    assert soa_res == obj_res
+    # The donut's hole keeps the query point OUT: dist = 1.0 to the hole
+    # ring, not 0 (containment would make it 0).
+    assert obj_res[0] == [("donut", 1.0)]
