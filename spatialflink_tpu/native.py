@@ -21,6 +21,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libsfnative.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_abi_mismatch = False
 _ABI_VERSION = 2  # must match sf_abi_version() in sfnative.cpp
 
 
@@ -47,9 +48,11 @@ def ensure_built(quiet: bool = True) -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _abi_mismatch
     if _lib is not None:
         return _lib
+    if _abi_mismatch:
+        return None
     if not ensure_built():
         return None
     lib = ctypes.CDLL(_LIB_PATH)
@@ -61,6 +64,9 @@ def _load() -> Optional[ctypes.CDLL]:
     except AttributeError:
         abi = -1
     if abi != _ABI_VERSION:
+        # A rebuilt-from-this-tree .so can't fix itself mid-process; cache
+        # the rejection so available() stops paying make+CDLL per call.
+        _abi_mismatch = True
         return None
     lib.sf_interner_new.restype = ctypes.c_void_p
     lib.sf_interner_free.argtypes = [ctypes.c_void_p]

@@ -209,6 +209,17 @@ def center_coords(grid: UniformGrid, xy: np.ndarray, dtype) -> np.ndarray:
     return (np.asarray(xy, np.float64) - np.array([cx, cy])).astype(out_dtype)
 
 
+def check_oid_range(oid, num_segments: int) -> None:
+    """Dense-id contract guard for the SoA fast paths: ids >= num_segments
+    would be silently dropped by the segment reductions — fail loudly at
+    the batch boundary instead."""
+    if len(oid) and int(np.max(oid)) >= num_segments:
+        raise ValueError(
+            f"oid {int(np.max(oid))} >= num_segments {num_segments}: "
+            f"out-of-range ids would be silently dropped"
+        )
+
+
 def device_point_args(grid: UniformGrid, xy64: np.ndarray, oid, dtype):
     """One SoA point-slice → device-ready padded (xy, valid, cell, oid).
 

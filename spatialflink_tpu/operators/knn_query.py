@@ -21,6 +21,7 @@ import numpy as np
 from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
 from spatialflink_tpu.operators.base import (
     SpatialOperator,
+    check_oid_range,
     flags_for_queries,
     jitted,
     pack_query_geometries,
@@ -196,11 +197,13 @@ class _PointStreamKNNQuery(SpatialOperator):
 
         def empty_digest(nseg):
             if nseg not in empties:
-                fbig = np.finfo(np.float64 if jax.config.jax_enable_x64
-                                and np.dtype(dtype) == np.float64
-                                else np.float32).max
+                # Match the live digests' dtype exactly: a default-dtype
+                # jnp.full under x64 would promote a float32 pipeline's
+                # merge to float64, shrinking the absent-object sentinel
+                # below finfo.max and surfacing ghost neighbors.
+                sm_dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
                 empties[nseg] = (
-                    jnp.full((nseg,), fbig),
+                    jnp.full((nseg,), np.finfo(sm_dtype).max, sm_dtype),
                     jnp.full((nseg,), int_big, jnp.int32),
                 )
             return empties[nseg]
@@ -305,6 +308,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
+            check_oid_range(oid[:win.count], num_segments)
             if counters.enabled:
                 cand = count_candidates(flags, cell, win.count)
                 counters.record_candidates(cand, cand)
@@ -460,6 +464,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 if hi <= lo:
                     panes[ps] = None
                     continue
+                # O(pane), not O(window): carried panes were checked when
+                # first digested.
+                check_oid_range(win.arrays["oid"][lo:hi], num_segments)
                 xy64 = np.stack(
                     [np.asarray(win.arrays["x"][lo:hi], np.float64),
                      np.asarray(win.arrays["y"][lo:hi], np.float64)],
@@ -623,12 +630,7 @@ class _GeometryStreamKNNQuery(SpatialOperator):
             ooo_ms=self.conf.allowed_lateness_ms,
         )
         for win in asm.stream(chunks):
-            if win.count and int(win.oid.max()) >= num_segments:
-                raise ValueError(
-                    f"oid {int(win.oid.max())} >= num_segments "
-                    f"{num_segments}: out-of-range ids would be silently "
-                    "dropped by the segment reduction"
-                )
+            check_oid_range(win.oid[:win.count], num_segments)
             batch = GeometryBatch.from_ragged(
                 win.ts, win.oid, win.lengths, win.verts,
                 edge_valid_flat=win.edge_valid, dtype=np.float64,

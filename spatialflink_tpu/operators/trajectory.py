@@ -116,7 +116,10 @@ class TRangeQuery(SpatialOperator):
         oids in [0, num_segments)) → per-window (start, end, hit_oids,
         window_count) — the containment + per-trajectory any-hit program
         of run() with no per-object Python."""
-        from spatialflink_tpu.operators.base import soa_point_batches
+        from spatialflink_tpu.operators.base import (
+            check_oid_range,
+            soa_point_batches,
+        )
 
         verts, ev = pack_query_geometries(query_polygons, np.float64)
         qv = self.device_verts(verts, dtype)
@@ -125,11 +128,7 @@ class TRangeQuery(SpatialOperator):
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
-            if win.count and int(oid[:win.count].max()) >= num_segments:
-                raise ValueError(
-                    f"oid >= num_segments {num_segments}: ids would be "
-                    "silently dropped by the segment reduction"
-                )
+            check_oid_range(oid[:win.count], num_segments)
             hits = np.asarray(program(
                 jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(oid),
                 qv, qe, num_segments=num_segments,

@@ -374,6 +374,35 @@ def test_pane_knn_with_empty_panes(rng):
     assert full == pane
 
 
+def test_pane_knn_empty_panes_float32(rng):
+    """Regression: with a float32 pipeline under x64, the empty-pane digest
+    must stay float32 — a default-dtype jnp.full promoted the merge to
+    float64, making the float32-max absent-object sentinel compare as a
+    real distance and report ghost neighbors (~3.4e38) for any window
+    containing an empty pane."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=20, slide_step=5)
+    early = synth_points(rng, n=60, t_span=9_000)
+    late = [
+        Point(obj_id=f"late{i % 5}", timestamp=31_000 + i * 150,
+              x=float(rng.uniform(0, 10)), y=float(rng.uniform(0, 10)))
+        for i in range(40)
+    ]
+    pts = early + late
+    q = Point(x=5.0, y=5.0)
+    r, k = 5.0, 4
+    full = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).run(iter(pts), q, r, k,
+                                           dtype=np.float32)
+    )
+    pane = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).query_panes(iter(pts), q, r, k,
+                                                   dtype=np.float32)
+    )
+    assert full == pane
+    for neighbors in pane.values():
+        assert all(d < 1e30 for _, d, _ in neighbors)
+
+
 def test_pane_knn_polygon_query(rng):
     """Pane carry through the polygon-query digest (containment → 0)."""
     from spatialflink_tpu.operators import PointPolygonKNNQuery
