@@ -7,13 +7,29 @@ benchmark harness is configured for a 20,000 events/sec single-node target
 (BenchmarkRunner.java:25-26, InstrumentedMN_Q1.java:88-89), so
 ``vs_baseline`` = measured points/sec/chip ÷ 20,000.
 
-The measured loop is the real per-window path: host window slice → pad →
-device transfer → fused XLA program (cell-flag gather, masked distances,
-per-object segment-min dedup, top-50) → result fetch. Object ids are dense
-ints (the framework interns strings at ingest; interning is amortized
-stream-side, not per window).
+The measured program is the pane-carry sliding-window pipeline in its
+TPU-first form (ops/knn.py):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  6 B/pt wire record (uint16 grid-relative coords + int16 interned oid,
+  streams/wire.py — device upcast bit-exact) → top-``cand``-compacted
+  pane digest (``knn_pane_digest_compact``: radius-masked distances →
+  lax.top_k → tiny segment-min scatters; automatic exact fallback) →
+  window merge + top-50. One transfer and ONE dispatch per slide.
+
+TWO throughputs in the single JSON line:
+
+- ``value`` (points/s, e2e): host slide → wire transfer → digest+merge →
+  pipelined result fetch. In this environment the host→device link is a
+  ~20-30 MB/s measurement tunnel, so this is TUNNEL-bound (~6 B/pt ⇒
+  ceiling ≈ link/6), not silicon.
+- ``device_resident_points_per_sec``: same wire records staged in HBM
+  once, same digest+merge per window inside one compiled scan per pass,
+  passes chained through the carried digest, EVERY window's full top-50
+  result kept live and fetched. The chip's own sustained rate on the
+  flagship kernel — compare against the measured XLA:CPU in-RAM figure
+  (CPU_BASELINE.json, regenerated with this same program).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -31,7 +47,41 @@ N_WINDOWS = 20
 K = 50
 NUM_SEGMENTS = 16_384  # distinct objIDs
 RADIUS = 0.05
+CAND = 8_192  # top-k compaction width (exact fallback above this)
 BASELINE_EPS = 20_000.0
+
+
+def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
+                        radius=RADIUS, cand=CAND):
+    """The headline program, shared verbatim with the CPU-baseline run
+    (bench_suite.bench_headline_knn_1m): one slide of packed wire records
+    + the carried digest → (new digest, window KnnResult).
+
+    ``wire_s``: (slide, 3) uint16 — x_q, y_q, oid (int16 bits). Returns a
+    raw fn for jax.jit / lax.scan embedding.
+    """
+    from spatialflink_tpu.ops.knn import (
+        knn_merge_digest_list,
+        knn_pane_digest_compact,
+    )
+
+    bases = np.asarray([0, slide], np.int32)
+
+    def step(seg_prev, rep_prev, wire_s, query_xy):
+        xyq = wire_s[:, :2]
+        oid = wire_s[:, 2].astype(jnp.int32)  # oids < 32768: bit-exact
+        xy = wf.dequantize(xyq)
+        valid = jnp.ones((wire_s.shape[0],), bool)
+        d = knn_pane_digest_compact(
+            xy, valid, None, None, oid, query_xy, np.float32(radius),
+            jnp.int32(0), num_segments=nseg, cand=cand,
+        )
+        res = knn_merge_digest_list(
+            (seg_prev, d.seg_min), (rep_prev, d.rep), bases, k=k
+        )
+        return d.seg_min, d.rep, res
+
+    return step
 
 
 def main() -> None:
@@ -39,61 +89,44 @@ def main() -> None:
     import jax.numpy as jnp
 
     from spatialflink_tpu.grid import UniformGrid
-    from spatialflink_tpu.ops.cells import assign_cells
-    from spatialflink_tpu.ops.knn import knn_merge_digest_list, knn_pane_digest
+    from spatialflink_tpu.streams.wire import WireFormat
 
     from __graft_entry__ import BEIJING_GRID_ARGS, QUERY_POINT
 
     dev = jax.devices()[0]
     grid = UniformGrid(**BEIJING_GRID_ARGS)
+    wf = WireFormat.for_grid(grid)
     q = np.asarray(QUERY_POINT, np.float32)
-    flags = grid.neighbor_flags(RADIUS, [grid.flat_cell(*q)])
 
-    # Synthetic Beijing stream: enough points for N sliding windows.
+    # Synthetic Beijing stream packed in the 6 B/pt wire format: one
+    # contiguous (n, 3) uint16 record stream (quantized coords ~3.2e-5°
+    # lattice ≈ 3.6 m — beneath GPS accuracy, upcast bit-exact per
+    # tests/test_wire.py; int16 interned oid). ONE transfer per slide.
     rng = np.random.default_rng(42)
     total = SLIDE * (N_WINDOWS - 1) + WINDOW
-    xs = rng.uniform(115.5, 117.6, total).astype(np.float32)
-    ys = rng.uniform(39.6, 41.1, total).astype(np.float32)
-    stream_xy = np.stack([xs, ys], axis=1)
-    # Wire format: object ids ship as int16 (NUM_SEGMENTS <= 32768) and
-    # upcast on device — ingest bandwidth is the bottleneck in this
-    # environment, not compute.
-    stream_oid = (rng.integers(0, NUM_SEGMENTS, total)).astype(np.int16)
-    valid = np.ones(SLIDE, bool)  # digest operates on one slide pane
+    xyq = wf.quantize(np.stack(
+        [rng.uniform(115.5, 117.6, total), rng.uniform(39.6, 41.1, total)],
+        axis=1,
+    ))
+    oid16 = (rng.integers(0, NUM_SEGMENTS, total)).astype(np.int16)
+    wire = np.concatenate([xyq, oid16.view(np.uint16)[:, None]], axis=1)
 
-    def digest_step(xy_s, oid_s, valid, flags_table, query_xy):
-        # One slide pane → per-object minima digest. Each ingested point
-        # crosses host→device once and is DIGESTED once; every window is a
-        # merge of its two slides' carried digests (ops/knn.py pane carry —
-        # the same program the operator's query_panes/run_soa_panes run).
-        cell = assign_cells(
-            xy_s, grid.min_x, grid.min_y, grid.cell_length, grid.n
-        )
-        return knn_pane_digest(
-            xy_s, valid, cell, flags_table, oid_s.astype(jnp.int32),
-            query_xy, np.float32(RADIUS), jnp.int32(0),
-            num_segments=NUM_SEGMENTS,
-        )
-
-    jdigest = jax.jit(digest_step)
-    jmerge = jax.jit(knn_merge_digest_list, static_argnames="k")
-    bases = np.asarray([0, SLIDE], np.int32)  # window-local slide offsets
-    flags_d = jax.device_put(jnp.asarray(flags), dev)
+    step = build_headline_step(jnp, wf)
+    jstep = jax.jit(step)
     q_d = jax.device_put(jnp.asarray(q), dev)
-    valid_d = jax.device_put(jnp.asarray(valid), dev)
+    big = np.float32(np.finfo(np.float32).max)
+    empty_seg = jax.device_put(
+        jnp.full((NUM_SEGMENTS,), big, jnp.float32), dev
+    )
+    empty_rep = jax.device_put(
+        jnp.full((NUM_SEGMENTS,), np.iinfo(np.int32).max, jnp.int32), dev
+    )
 
-    def slide_arrays(i):
-        lo, hi = i * SLIDE, (i + 1) * SLIDE
-        return (
-            jax.device_put(stream_xy[lo:hi], dev),
-            jax.device_put(stream_oid[lo:hi], dev),
-        )
+    def slide_wire(i):
+        return jax.device_put(wire[i * SLIDE:(i + 1) * SLIDE], dev)
 
     # Warm-up (compile) + slide-0 digest (its ingest precedes window 0).
-    xy_a, oid_a = slide_arrays(0)
-    d_prev = jdigest(xy_a, oid_a, valid_d, flags_d, q_d)
-    warm = jmerge((d_prev.seg_min, d_prev.seg_min),
-                  (d_prev.rep, d_prev.rep), bases, k=K)
+    seg0, rep0, warm = jstep(empty_seg, empty_rep, slide_wire(0), q_d)
     jax.device_get(warm.num_valid)  # true sync (block_until_ready is a
     # no-op on the axon tunnel)
 
@@ -111,32 +144,26 @@ def main() -> None:
         else contextlib.nullcontext()
     )
 
-    # Throughput loop: fully pipelined — ingest double-buffered, window
-    # results collected as handles and materialized once at the end
-    # (device_get is the only true sync on this tunnel; a per-window fetch
-    # would drain the pipeline every slide). The measurement tunnel's
-    # bandwidth fluctuates ±50% run to run, so the loop runs 5 times and
-    # the MEDIAN rate is reported.
-    d_slide0 = d_prev  # window 0's carried slide; re-seeded per repetition
-
+    # Throughput loop: fully pipelined — ingest double-buffered, one
+    # transfer + one dispatch per slide, window results collected as
+    # handles and materialized once at the end (device_get is the only
+    # true sync on this tunnel; a per-window fetch would drain the
+    # pipeline every slide). The tunnel's bandwidth fluctuates ±50% run
+    # to run, so the loop runs 5 times and the MEDIAN rate is reported.
     def timed_run():
-        nonlocal d_prev
-        # Re-seed outside the timed region: carrying the previous run's
-        # final slide into window 0 would merge non-adjacent panes (same
-        # timing, wrong window semantics in the reported results).
-        d_prev = d_slide0
+        # Re-seed from slide 0's digest outside the timed region:
+        # carrying the previous run's final slide into window 0 would
+        # merge non-adjacent panes.
+        sp, rp = seg0, rep0
         fired = []
         t0 = time.perf_counter()
-        staged = [slide_arrays(1), slide_arrays(2)]
+        staged = [slide_wire(1), slide_wire(2)]
         for w in range(N_WINDOWS):
             if w + 3 <= N_WINDOWS:
-                staged.append(slide_arrays(w + 3))
-            xy_s, oid_s = staged.pop(0)
-            d_new = jdigest(xy_s, oid_s, valid_d, flags_d, q_d)
-            fired.append(jmerge((d_prev.seg_min, d_new.seg_min),
-                                (d_prev.rep, d_new.rep), bases, k=K))
-            d_prev = d_new  # the slide that stays in the next window
-        results = [int(r.num_valid) for r in jax.device_get(fired)]
+                staged.append(slide_wire(w + 3))
+            sp, rp, res = jstep(sp, rp, staged.pop(0), q_d)
+            fired.append(res.num_valid)
+        results = [int(v) for v in jax.device_get(fired)]
         return time.perf_counter() - t0, results
 
     with trace_ctx:
@@ -149,17 +176,63 @@ def main() -> None:
     # transferring during the window interval; what remains at window
     # close is digest + merge + result fetch).
     latencies = []
+    sp, rp = seg0, rep0
     for w in range(5):
-        xy_s, oid_s = slide_arrays(w + 1)
-        # Staged: BOTH buffers' ingest completed before window close.
-        jax.device_get((xy_s, oid_s))
+        wire_s = slide_wire(w + 1)
+        jax.device_get(wire_s[:1])  # staged before window close
         t0 = time.perf_counter()
-        d_new = jdigest(xy_s, oid_s, valid_d, flags_d, q_d)
-        res = jmerge((d_prev.seg_min, d_new.seg_min),
-                     (d_prev.rep, d_new.rep), bases, k=K)
+        sp, rp, res = jstep(sp, rp, wire_s, q_d)
         int(res.num_valid)
         latencies.append(time.perf_counter() - t0)
-        d_prev = d_new
+
+    # ---- Device-resident throughput: ingest off the critical path. ----
+    # Slides 1..N stay staged in HBM (60 MB of wire records); one
+    # compiled scan digests every slide, merges every window, and keeps
+    # each window's FULL top-50 result live (dist/segment/index/num_valid
+    # all fetched — nothing is dead code). Passes chain through the
+    # carried digest (a wrap-around continuous stream); one fetch at the
+    # end is the only sync. This is the silicon number comparable to the
+    # measured XLA:CPU in-RAM baseline.
+    wire_all = jax.device_put(
+        wire[SLIDE:].reshape(N_WINDOWS, SLIDE, 3), dev
+    )
+
+    def resident_pass(seg_prev, rep_prev, wire_r):
+        def body(carry, wire_s):
+            sp, rp, res = step(carry[0], carry[1], wire_s, q_d)
+            return (sp, rp), tuple(res)
+        carry, outs = jax.lax.scan(body, (seg_prev, rep_prev), wire_r)
+        return carry[0], carry[1], outs
+
+    jresident = jax.jit(resident_pass)
+
+    # Compile + force staging, then calibrate the pass count so a timed
+    # run spans ~2 s (amortizes the final fetch's tunnel round trip).
+    s, r, outs = jresident(seg0, rep0, wire_all)
+    jax.device_get(outs[-1])
+    t0 = time.perf_counter()
+    s, r, outs = jresident(seg0, rep0, wire_all)
+    fetched = jax.device_get(outs)
+    t_pass = time.perf_counter() - t0
+    resident_results = [int(v) for v in fetched[-1]]
+    passes = int(np.clip(np.ceil(2.0 / max(t_pass, 1e-4)), 2, 64))
+
+    def resident_run():
+        sp, rp = seg0, rep0
+        handles = []
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            sp, rp, outs = jresident(sp, rp, wire_all)
+            handles.append(outs)
+        all_out = jax.device_get(handles)  # the only true sync
+        return time.perf_counter() - t0, all_out
+
+    res_runs = [resident_run() for _ in range(5)]
+    t_res = float(np.median([t for t, _ in res_runs]))
+    resident_pps = passes * N_WINDOWS * SLIDE / t_res
+    for _, all_out in res_runs[-1:]:
+        for outs in all_out:
+            assert all(int(v) == K for v in outs[-1]), "resident underfill"
 
     # Ingest rate: distinct stream points consumed per second (each point
     # is ingested once, digested once, and evaluated in 2 overlapping
@@ -170,7 +243,9 @@ def main() -> None:
     distinct_points = SLIDE * N_WINDOWS
     points_per_sec = distinct_points / t_total
     p50_ms = float(np.percentile(latencies, 50) * 1000)
-    assert all(r == K for r in results), f"kNN underfilled: {results[:3]}"
+    assert all(v == K for v in results), f"kNN underfilled: {results[:3]}"
+    assert all(v == K for v in resident_results), \
+        f"resident kNN underfilled: {resident_results[:3]}"
 
     out = {
         "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
@@ -181,6 +256,10 @@ def main() -> None:
         "device": str(dev),
         "windows": N_WINDOWS,
         "k": K,
+        "wire_bytes_per_point": wf.bytes_per_point,
+        "device_resident_points_per_sec": round(resident_pps, 1),
+        "device_resident_passes": passes,
+        "device_resident_vs_baseline": round(resident_pps / BASELINE_EPS, 2),
     }
     # Measured CPU-backend throughput of the same fused program on this
     # host (bench_suite.py --cpu-baseline) — the measured counterpart to
@@ -191,11 +270,15 @@ def main() -> None:
         cpu = load_cpu_baseline().get("continuous_knn_k50_1M_window")
         if cpu:
             out["vs_measured_cpu"] = round(points_per_sec / cpu, 2)
-            # The CPU figure is the SAME fused kernel on XLA:CPU with data
-            # already in RAM (no ingest); the chip path here is bound by the
-            # ~28 MB/s measurement tunnel, not TPU silicon. See BASELINE.md
-            # "Measured CPU baseline" for the full interpretation.
-            out["measured_cpu_is"] = "same-kernel XLA:CPU in-RAM upper bound"
+            out["device_resident_vs_measured_cpu"] = round(
+                resident_pps / cpu, 2
+            )
+            # The CPU figure is the SAME program (build_headline_step) on
+            # XLA:CPU with the wire records already in RAM (no ingest):
+            # the honest comparator for device_resident_points_per_sec.
+            # The e2e `value` is bound by the ~20-30 MB/s measurement
+            # tunnel, not TPU silicon. See BASELINE.md.
+            out["measured_cpu_is"] = "same-program XLA:CPU in-RAM"
     except Exception:
         pass
     print(json.dumps(out))

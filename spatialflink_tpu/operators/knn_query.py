@@ -155,8 +155,8 @@ class _PointStreamKNNQuery(SpatialOperator):
         from spatialflink_tpu.operators.query_config import QueryType
         from spatialflink_tpu.ops.knn import (
             knn_merge_digest_list,
-            knn_pane_digest,
-            knn_pane_digest_geometry,
+            knn_pane_digest_compact,
+            knn_pane_digest_geometry_compact,
         )
 
         conf = self.conf
@@ -173,16 +173,28 @@ class _PointStreamKNNQuery(SpatialOperator):
         if size % slide != 0:
             raise ValueError("query_panes requires size % slide == 0")
 
-        flags_d = jnp.asarray(flags_for_queries(self.grid, radius, [query_obj]))
+        # Pane digests run the top-k-compacted kernels (ops/knn.py) with
+        # cell/flags=None: for IN-GRID points the radius test subsumes the
+        # grid pruning for a single query (bit-parity with the flagged
+        # scatter digest, tests/test_knn_compact.py), and skipping the
+        # per-point flag gather is the single biggest TPU win in this
+        # path. Out-of-extent points (cell == num_cells, whose flag entry
+        # is hard-coded 0 — the reference's key-never-matches semantics)
+        # are excluded HOST-side by and-ing them out of `valid` below.
         if self.query_kind == "point":
             q = self.device_q([query_obj.x, query_obj.y], dtype)
-            digest_fn = jitted(knn_pane_digest, "num_segments")
+            digest_fn = functools.partial(
+                jitted(knn_pane_digest_compact, "num_segments", "cand"),
+                cand=4096,
+            )
         else:
             verts, ev = pack_query_geometries([query_obj], np.float64)
             qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
             digest_fn = functools.partial(
-                jitted(knn_pane_digest_geometry, "num_segments", "query_polygonal"),
+                jitted(knn_pane_digest_geometry_compact,
+                       "num_segments", "query_polygonal", "cand"),
                 query_polygonal=self.query_kind == "polygon",
+                cand=4096,
             )
         merge = jitted(knn_merge_digest_list, "k")
         int_big = np.iinfo(np.int32).max
@@ -232,11 +244,12 @@ class _PointStreamKNNQuery(SpatialOperator):
                     continue
                 batch = self.point_batch(evs)
                 nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+                in_grid = batch.valid & (batch.cell < self.grid.num_cells)
                 args = (
                     self.device_xy(batch, dtype),
-                    jnp.asarray(batch.valid),
-                    jnp.asarray(batch.cell),
-                    flags_d,
+                    jnp.asarray(in_grid),
+                    None,  # cell/flags skipped — see comment above
+                    None,
                     jnp.asarray(batch.oid),
                 )
                 if self.query_kind == "point":
@@ -431,7 +444,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         from spatialflink_tpu.operators.base import device_point_args
         from spatialflink_tpu.ops.knn import (
             knn_merge_digest_list,
-            knn_pane_digest,
+            knn_pane_digest_compact,
         )
         from spatialflink_tpu.streams.soa import SoaWindowAssembler
 
@@ -444,9 +457,13 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         if size % slide != 0:
             raise ValueError("run_soa_panes requires size % slide == 0")
 
-        flags_d = jnp.asarray(flags_for_queries(self.grid, radius, [query_point]))
         q = self.device_q([query_point.x, query_point.y], dtype)
-        digest = jitted(knn_pane_digest, "num_segments")
+        # Compact digest, cell/flags=None; out-of-extent points excluded
+        # host-side via `valid` — see query_panes.
+        digest = functools.partial(
+            jitted(knn_pane_digest_compact, "num_segments", "cand"),
+            cand=4096,
+        )
         merge = jitted(knn_merge_digest_list, "k")
         ppw = size // slide
         no_bases = np.zeros(ppw, np.int32)  # indices unused by this yield
@@ -475,9 +492,10 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 xy_p, valid_p, cell_p, oid_p = device_point_args(
                     self.grid, xy64, win.arrays["oid"][lo:hi], dtype
                 )
+                in_grid = valid_p & (cell_p < self.grid.num_cells)
                 d = digest(
-                    jnp.asarray(xy_p), jnp.asarray(valid_p),
-                    jnp.asarray(cell_p), flags_d, jnp.asarray(oid_p),
+                    jnp.asarray(xy_p), jnp.asarray(in_grid),
+                    None, None, jnp.asarray(oid_p),
                     q, radius, np.int32(0), num_segments=num_segments,
                 )
                 panes[ps] = (d.seg_min, d.rep)

@@ -399,6 +399,242 @@ def geometry_geometry_join_kernel(
     return mask, d
 
 
+def _block_candidates(block_bbox, gbbox, gvalid, radius, cand: int):
+    """Block-level bbox pruning + per-block candidate compaction.
+
+    ``block_bbox``: (NB, 4) minx,miny,maxx,maxy per block (±inf when the
+    block is empty); ``gbbox``: (M, 4) per-geometry bboxes. A geometry is
+    a candidate for a block iff the bboxes overlap after expanding the
+    geometry's by ``radius``. Returns (gids (NB, cand) int32, cvalid
+    (NB, cand) bool, overflow () int32) — overflow counts candidates
+    dropped beyond ``cand`` (the caller's retry contract: exact iff 0).
+    """
+    gx0 = gbbox[:, 0] - radius
+    gy0 = gbbox[:, 1] - radius
+    gx1 = gbbox[:, 2] + radius
+    gy1 = gbbox[:, 3] + radius
+    ov = (
+        (block_bbox[:, 0:1] <= gx1[None, :])
+        & (block_bbox[:, 2:3] >= gx0[None, :])
+        & (block_bbox[:, 1:2] <= gy1[None, :])
+        & (block_bbox[:, 3:4] >= gy0[None, :])
+        & gvalid[None, :]
+    )  # (NB, M)
+    # Prefix-sum one-hot selection of the first ``cand`` set bits per row,
+    # ascending geometry id. lax.top_k did the same job 10× slower here
+    # (12 ms vs ~1 ms at (256, 1000)→64 on v5e — top_k lowers to a
+    # per-row sort); this is pure VPU compare/select/reduce.
+    m = ov.shape[1]
+    prefix = jnp.cumsum(ov.astype(jnp.int32), axis=1)  # (NB, M)
+    ncand = prefix[:, -1]
+    c_ids = jnp.arange(cand, dtype=jnp.int32)
+    hit = ov[:, :, None] & (prefix[:, :, None] == c_ids[None, None, :] + 1)
+    gids = jnp.sum(
+        hit * jnp.arange(m, dtype=jnp.int32)[None, :, None], axis=1
+    )  # (NB, cand)
+    cvalid = c_ids[None, :] < jnp.minimum(ncand, cand)[:, None]
+    overflow = jnp.sum(jnp.maximum(ncand - cand, 0))
+    return gids.astype(jnp.int32), cvalid, overflow
+
+
+def _masked_block_bbox(x, y, valid):
+    """(NB, B) coords + validity → (NB, 4) bbox over valid lanes."""
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    return jnp.stack([
+        jnp.min(jnp.where(valid, x, big), axis=1),
+        jnp.min(jnp.where(valid, y, big), axis=1),
+        jnp.max(jnp.where(valid, x, -big), axis=1),
+        jnp.max(jnp.where(valid, y, -big), axis=1),
+    ], axis=1)
+
+
+def _compact_pairs(mask, dmat, borig, gids, max_pairs: int):
+    """(NB, cand, B) mask/dists → CompactJoinResult-style flat pairs."""
+    nb, cand, b = mask.shape
+    flat = mask.reshape(-1)
+    count = jnp.sum(flat.astype(jnp.int32))
+    (hit,) = jnp.nonzero(flat, size=max_pairs, fill_value=-1)
+    found = hit >= 0
+    h = jnp.maximum(hit, 0)
+    bi = h // (cand * b)
+    ci = (h // b) % cand
+    li = h % b
+    left = jnp.where(found, borig[bi, li], -1)
+    right = jnp.where(found, gids[bi, ci], -1)
+    dist = jnp.where(found, dmat.reshape(-1)[h],
+                     jnp.asarray(jnp.inf, dmat.dtype))
+    return left, right, dist, count, found
+
+
+def point_geometry_join_pruned_kernel(
+    pxy: jnp.ndarray,
+    pvalid: jnp.ndarray,
+    gverts: jnp.ndarray,
+    gev: jnp.ndarray,
+    gvalid: jnp.ndarray,
+    gbbox: jnp.ndarray,
+    radius,
+    polygonal: bool,
+    block: int,
+    cand: int,
+    max_pairs: int,
+) -> CompactJoinResult:
+    """Grid-pruned point ⋈ geometry join, device-extracted.
+
+    The dense kernel (point_geometry_join_kernel) evaluates every
+    (point, geometry) V-vertex distance — O(N·M·V). This is the device-
+    side form of the reference's gridIDsSet replication
+    (join/JoinQuery.java:73-137) re-designed for TPU:
+
+      1. sort points by grid cell (spatial locality — one device argsort),
+      2. split into ``block``-point tiles; per tile, a 4-compare bbox test
+         against every geometry's radius-expanded bbox (O(N/B · M), cheap),
+      3. compact ≤ ``cand`` candidate geometries per tile (lax.top_k),
+      4. exact V-vertex distances tile × candidates — O(N·cand·V), a
+         M/cand-fold cut,
+      5. one jnp.nonzero compaction so only pairs cross the host boundary.
+
+    Exact iff ``overflow == 0`` (a tile had more than ``cand`` bbox-
+    overlapping geometries — the caller retries with a larger ``cand``;
+    at cand == M the prune is a no-op and overflow is structurally 0).
+    Pair set identical to the dense kernel (parity test
+    tests/test_join_pruned.py); JTS semantics kept (inside polygonal → 0).
+
+    The caller orders the points for spatial locality HOST-side (numpy
+    argsort by cell, ~1 ms at 131k and overlapped with device work — a
+    device argsort measured 13 ms on v5e, 2.5× the rest of this kernel);
+    ``left_index`` refers to input positions (map back through the host
+    order). Locality only affects pruning EFFICIENCY, never correctness.
+    """
+    from spatialflink_tpu.ops.distances import point_polyline_distance
+    from spatialflink_tpu.ops.polygon import points_in_polygon
+
+    n = pxy.shape[0]
+    nb = -(-n // block)
+    npad = nb * block
+    pad = npad - n
+    order = jnp.arange(n, dtype=jnp.int32)
+    sx = jnp.pad(pxy, ((0, pad), (0, 0)))
+    sv = jnp.pad(pvalid, (0, pad))
+    so = jnp.pad(order, (0, pad), constant_values=-1)
+    bx = sx.reshape(nb, block, 2)
+    bvalid = sv.reshape(nb, block)
+    borig = so.reshape(nb, block)
+
+    bbox = _masked_block_bbox(bx[:, :, 0], bx[:, :, 1], bvalid)
+    gids, cvalid, overflow = _block_candidates(
+        bbox, gbbox, gvalid, radius, cand
+    )
+
+    cgv = gverts[gids]  # (NB, cand, V, 2)
+    cge = gev[gids]  # (NB, cand, V-1)
+
+    def one_geom(bxy, verts, ev):
+        d = point_polyline_distance(bxy, verts, ev)
+        if polygonal:
+            inside = points_in_polygon(bxy, verts, ev)
+            d = jnp.where(inside, jnp.zeros((), d.dtype), d)
+        return d
+
+    dmat = jax.vmap(
+        lambda bxy, gv, ge: jax.vmap(lambda v, e: one_geom(bxy, v, e))(gv, ge)
+    )(bx, cgv, cge)  # (NB, cand, block)
+
+    mask = (
+        (dmat <= radius)
+        & bvalid[:, None, :]
+        & cvalid[:, :, None]
+    )
+    left, right, dist, count, _ = _compact_pairs(
+        mask, dmat, borig, gids, max_pairs
+    )
+    return CompactJoinResult(left, right, dist, count, overflow)
+
+
+def geometry_geometry_join_pruned_kernel(
+    averts: jnp.ndarray,
+    aev: jnp.ndarray,
+    avalid: jnp.ndarray,
+    abbox: jnp.ndarray,
+    bverts: jnp.ndarray,
+    bev: jnp.ndarray,
+    bvalid: jnp.ndarray,
+    bbbox: jnp.ndarray,
+    radius,
+    a_polygonal: bool,
+    b_polygonal: bool,
+    block: int,
+    cand: int,
+    max_pairs: int,
+) -> CompactJoinResult:
+    """Grid-pruned geometry ⋈ geometry join, device-extracted.
+
+    Same tile/candidate scheme as the point version: the caller orders
+    the left side for locality HOST-side (the operator sorts by quantized
+    bbox center — join_query._GeometryGeometryJoinQuery._window_pairs,
+    the single home of that key logic); tile bboxes are unioned over
+    member bboxes. ``left_index`` refers to input positions. Exact iff
+    ``overflow == 0`` (retry contract); parity with
+    geometry_geometry_join_kernel incl. overlap→0 distances
+    (tests/test_join_pruned.py).
+    """
+    from spatialflink_tpu.ops.range import geometry_pair_distance
+
+    la = averts.shape[0]
+    nb = -(-la // block)
+    npad = nb * block
+    order = jnp.arange(la, dtype=jnp.int32)
+    pad = npad - la
+
+    s_bbox = jnp.pad(abbox, ((0, pad), (0, 0)))
+    sv = jnp.pad(avalid, (0, pad))
+    so = jnp.pad(order, (0, pad), constant_values=-1)
+    t_bbox = s_bbox.reshape(nb, block, 4)
+    bval = sv.reshape(nb, block)
+    borig = so.reshape(nb, block)
+
+    big = jnp.asarray(jnp.finfo(t_bbox.dtype).max, t_bbox.dtype)
+    tile_bbox = jnp.stack([
+        jnp.min(jnp.where(bval, t_bbox[:, :, 0], big), axis=1),
+        jnp.min(jnp.where(bval, t_bbox[:, :, 1], big), axis=1),
+        jnp.max(jnp.where(bval, t_bbox[:, :, 2], -big), axis=1),
+        jnp.max(jnp.where(bval, t_bbox[:, :, 3], -big), axis=1),
+    ], axis=1)
+    gids, cvalid, overflow = _block_candidates(
+        tile_bbox, bbbox, bvalid, radius, cand
+    )
+
+    sav = jnp.pad(averts, ((0, pad), (0, 0), (0, 0)))
+    sae = jnp.pad(aev, ((0, pad), (0, 0)))
+    tav = sav.reshape(nb, block, averts.shape[1], 2)
+    tae = sae.reshape(nb, block, aev.shape[1])
+    cbv = bverts[gids]  # (NB, cand, Vb, 2)
+    cbe = bev[gids]
+
+    def pair_d(av, ae, bv, be):
+        return geometry_pair_distance(av, ae, bv, be, a_polygonal,
+                                      b_polygonal)
+
+    # (NB, cand, block): for each tile, candidate × member distances.
+    dmat = jax.vmap(
+        lambda avs, aes, bvs, bes: jax.vmap(
+            lambda bv, be: jax.vmap(
+                lambda av, ae: pair_d(av, ae, bv, be)
+            )(avs, aes)
+        )(bvs, bes)
+    )(tav, tae, cbv, cbe)
+
+    mask = (
+        (dmat <= radius)
+        & bval[:, None, :]
+        & cvalid[:, :, None]
+    )
+    left, right, dist, count, _ = _compact_pairs(
+        mask, dmat, borig, gids, max_pairs
+    )
+    return CompactJoinResult(left, right, dist, count, overflow)
+
+
 def cross_join_kernel(
     left_xy: jnp.ndarray,
     left_valid: jnp.ndarray,

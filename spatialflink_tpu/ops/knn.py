@@ -55,12 +55,26 @@ def _digest_from_point_dists(
     different panes (or shards) share one tie-break contract.
     """
     big = jnp.asarray(jnp.finfo(dist.dtype).max, dist.dtype)
-    mask = valid & (flags > 0) & (dist <= radius)
+    mask = valid & (dist <= radius)
+    if flags is not None:
+        # Grid pruning is a work-reduction device in the reference
+        # (HelperClass cell classification); in a dense masked kernel the
+        # radius test subsumes it for correctness (candidate cells cover
+        # the query circle), so single-query fast paths may pass None.
+        mask = mask & (flags > 0)
     masked = jnp.where(mask, dist, big)
 
-    seg_min = jax.ops.segment_min(
-        masked, oid, num_segments=num_segments, indices_are_sorted=False
-    )  # (U,) min dist per object; +inf where object absent/out of radius
+    # (U,) min dist per object; the `big` sentinel marks absent/out-of-
+    # radius objects. segment_min's identity for a segment with NO points
+    # at all is +inf — clamp it to `big` so every absent object carries
+    # ONE sentinel (the carry machinery pads with big, and the compact
+    # digest can then match this path bit-for-bit).
+    seg_min = jnp.minimum(
+        jax.ops.segment_min(
+            masked, oid, num_segments=num_segments, indices_are_sorted=False
+        ),
+        big,
+    )
     if axis_name is not None:
         seg_min = jax.lax.pmin(seg_min, axis_name=axis_name)
 
@@ -125,6 +139,100 @@ def knn_pane_digest(
     )
 
 
+def _digest_from_point_dists_compact(
+    dist, valid, flags, oid, radius, num_segments,
+    index_base=None, cand: int = 4096,
+) -> KnnPaneDigest:
+    """Top-``cand``-compacted digest — the TPU-fast form of
+    ``_digest_from_point_dists``.
+
+    The scatter digest pays two O(N)-update scatters plus two O(N)
+    gathers; on TPU those serialize badly (measured 33 Mpts/s at N=500k,
+    num_segments=16k on v5e). The radius cut typically leaves far fewer
+    than N finite distances, so: masked distances → ``lax.top_k`` of the
+    ``cand`` smallest (TPU-efficient, stable lowest-index tie-break, same
+    contract as the scatter path) → the identical segment-min digest over
+    ``cand`` elements (tiny scatters; measured 445 Mpts/s). Exactness: if
+    more than ``cand`` points are in radius, a ``lax.cond`` falls back to
+    the full scatter digest — results are ALWAYS bit-identical to
+    ``_digest_from_point_dists`` (parity test
+    tests/test_knn_compact.py)."""
+    if cand >= dist.shape[0]:
+        # Pane no larger than the compaction width: nothing to compact
+        # (static shapes, so this is a compile-time decision).
+        return _digest_from_point_dists(
+            dist, valid, flags, oid, radius, num_segments,
+            index_base=index_base,
+        )
+    big = jnp.asarray(jnp.finfo(dist.dtype).max, dist.dtype)
+    mask = valid & (dist <= radius)
+    if flags is not None:
+        mask = mask & (flags > 0)
+    masked = jnp.where(mask, dist, big)
+    n_in = jnp.sum(mask.astype(jnp.int32))
+    int_big = jnp.iinfo(jnp.int32).max
+
+    def compact(_):
+        negd, ci = jax.lax.top_k(-masked, cand)
+        cd = -negd  # ascending cand smallest distances (stable by index)
+        coid = oid[ci]
+        cvalid = cd < big
+        cm = jnp.where(cvalid, cd, big)
+        # Segments receiving no candidate get segment_min's identity
+        # (+inf); clamp to the scatter path's `big` sentinel for
+        # bit-parity (real distances are ≤ radius, far below big).
+        sm = jnp.minimum(
+            jax.ops.segment_min(cm, coid, num_segments=num_segments), big
+        )
+        idx = ci if index_base is None else ci + index_base
+        win = cvalid & (cm == sm[coid])
+        rep = jax.ops.segment_min(
+            jnp.where(win, idx, int_big), coid, num_segments=num_segments
+        )
+        return KnnPaneDigest(sm, rep)
+
+    def full(_):
+        return _digest_from_point_dists(
+            dist, valid, flags, oid, radius, num_segments,
+            index_base=index_base,
+        )
+
+    return jax.lax.cond(n_in <= cand, compact, full, None)
+
+
+def knn_pane_digest_compact(
+    xy, valid, cell, flags_table, oid, query_xy, radius, index_base,
+    num_segments: int, cand: int = 4096,
+) -> KnnPaneDigest:
+    """``knn_pane_digest`` via top-``cand`` compaction (TPU fast path).
+
+    Pass ``cell``/``flags_table`` as None to skip the per-point flag
+    gather: for a single point query the radius test subsumes the grid
+    pruning (candidate cells cover the query circle), and the gather is
+    the single most expensive op in the scatter digest on TPU. Bit-exact
+    vs ``knn_pane_digest`` either way (automatic scatter fallback when
+    over ``cand`` points are in radius)."""
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    dist = point_point_distance(xy, query_xy[None, :])
+    flags = (
+        None if flags_table is None else gather_cell_flags(cell, flags_table)
+    )
+    return _digest_from_point_dists_compact(
+        dist, valid, flags, oid, radius, num_segments,
+        index_base=index_base, cand=cand,
+    )
+
+
+def _geometry_query_dists(xy, query_verts, query_edge_valid,
+                          query_polygonal: bool):
+    edge_d = point_polyline_distance(xy, query_verts, query_edge_valid)
+    if query_polygonal:
+        inside = points_in_polygon(xy, query_verts, query_edge_valid)
+        return jnp.where(inside, jnp.zeros((), edge_d.dtype), edge_d)
+    return edge_d
+
+
 def knn_pane_digest_geometry(
     xy, valid, cell, flags_table, oid, query_verts, query_edge_valid,
     radius, index_base, num_segments: int, query_polygonal: bool,
@@ -132,15 +240,37 @@ def knn_pane_digest_geometry(
     """Pane digest for a polygon (containment → 0) or open-polyline query."""
     from spatialflink_tpu.ops.cells import gather_cell_flags
 
-    edge_d = point_polyline_distance(xy, query_verts, query_edge_valid)
-    if query_polygonal:
-        inside = points_in_polygon(xy, query_verts, query_edge_valid)
-        dist = jnp.where(inside, jnp.zeros((), edge_d.dtype), edge_d)
-    else:
-        dist = edge_d
+    dist = _geometry_query_dists(xy, query_verts, query_edge_valid,
+                                 query_polygonal)
     return _digest_from_point_dists(
         dist, valid, gather_cell_flags(cell, flags_table), oid, radius,
         num_segments, index_base=index_base,
+    )
+
+
+def knn_pane_digest_geometry_compact(
+    xy, valid, cell, flags_table, oid, query_verts, query_edge_valid,
+    radius, index_base, num_segments: int, query_polygonal: bool,
+    cand: int = 4096,
+) -> KnnPaneDigest:
+    """Geometry-query pane digest via top-``cand`` compaction.
+
+    Same exactness contract as ``knn_pane_digest_compact``; pass
+    ``cell``/``flags_table`` as None to skip the flag gather — the
+    candidate cells of ``neighbor_flags(radius, geometry cells)`` cover
+    every point within ``radius`` of the geometry (containment included:
+    an inside point lies in the geometry's own cells), so the radius test
+    subsumes the pruning flags for correctness."""
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    dist = _geometry_query_dists(xy, query_verts, query_edge_valid,
+                                 query_polygonal)
+    flags = (
+        None if flags_table is None else gather_cell_flags(cell, flags_table)
+    )
+    return _digest_from_point_dists_compact(
+        dist, valid, flags, oid, radius, num_segments,
+        index_base=index_base, cand=cand,
     )
 
 

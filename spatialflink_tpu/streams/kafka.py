@@ -1,13 +1,22 @@
-"""Kafka ingress/egress seam.
+"""Kafka ingress/egress.
 
 The reference's transport is Kafka (FlinkKafkaConsumer/Producer,
-StreamingJob.java:188-191,255; producers in Serialization.java). This
-environment ships no Kafka client library and no broker, so the connector
-is gated: if ``kafka-python`` (or ``confluent_kafka``) is importable the
-source/sink work as expected; otherwise construction raises with a clear
-message pointing at the file/socket equivalents (the record boundary —
-lines of GeoJSON/WKT/CSV — is identical, which is the actual seam the
-framework depends on).
+StreamingJob.java:188-191,255; producers in Serialization.java). The
+record boundary — lines of GeoJSON/WKT/CSV — is identical to the file/
+socket sources, so the transport layer only moves bytes.
+
+Backends, in order of preference:
+
+1. ``kafka-python`` / ``confluent_kafka`` if importable (full consumer-
+   group support);
+2. the BUILT-IN wire-protocol client (streams/kafka_wire.py — metadata/
+   produce/fetch/list-offsets over a raw socket, no pip; brokers
+   0.10–3.x, NOT 4.0+ whose KIP-896 removed these protocol versions —
+   a 4.0 broker surfaces a clear UNSUPPORTED_VERSION KafkaError).
+   Always available, so ``kafka_available()`` is unconditionally True;
+   partition assignment is explicit (all partitions of the topic,
+   round-robin) rather than group-coordinated — the reference likewise
+   relies on Flink's own partition assignment, not group rebalancing.
 """
 
 from __future__ import annotations
@@ -29,18 +38,15 @@ def _import_kafka():
 
         return "confluent", confluent_kafka
     except ImportError:
-        return None, None
+        pass
+    from spatialflink_tpu.streams import kafka_wire
+
+    return "wire", kafka_wire
 
 
 def kafka_available() -> bool:
+    """Always True: the built-in wire client needs no external library."""
     return _import_kafka()[0] is not None
-
-
-_MISSING = (
-    "No Kafka client library is available in this environment. Use "
-    "streams.sources.csv_source / socket_source (same line-record boundary) "
-    "or install kafka-python."
-)
 
 
 def kafka_source(
@@ -52,11 +58,11 @@ def kafka_source(
 ) -> Iterator[T]:
     """Consume a topic as parsed records (FlinkKafkaConsumer analog).
 
-    Fails at call time (not first iteration) when no client is available.
+    Unparseable records are skipped (the reference's deserializers drop
+    malformed lines the same way). With the built-in backend ``group_id``
+    only labels the client; partitions are explicitly assigned.
     """
     kind, mod = _import_kafka()
-    if kind is None:
-        raise RuntimeError(_MISSING)
     return _kafka_iter(kind, mod, topic, bootstrap_servers, parser,
                        group_id, from_earliest)
 
@@ -78,7 +84,7 @@ def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
                     continue
         finally:
             consumer.close()
-    else:  # confluent
+    elif kind == "confluent":
         consumer = mod.Consumer(
             {
                 "bootstrap.servers": bootstrap_servers,
@@ -105,29 +111,96 @@ def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
                     continue
         finally:
             consumer.close()
+    else:  # built-in wire client
+        import time as _time
+
+        client = mod.KafkaWireClient(bootstrap_servers, client_id=group_id)
+        try:
+            # A broker auto-creating the topic answers the first metadata
+            # request with UNKNOWN_TOPIC_OR_PARTITION / LEADER_NOT_AVAILABLE
+            # (dropped by metadata()); retry like the library consumers do.
+            parts: list = []
+            for attempt in range(25):
+                parts = client.metadata([topic]).get(topic, [])
+                if parts:
+                    break
+                _time.sleep(0.2)
+            if not parts:
+                raise RuntimeError(
+                    f"topic {topic!r} has no partitions (does it exist?)"
+                )
+            ts = mod.EARLIEST if from_earliest else mod.LATEST
+            offsets = {p: client.list_offset(topic, p, ts) for p in parts}
+            while True:
+                progressed = False
+                for p in parts:
+                    msgs, _hw = client.fetch(topic, p, offsets[p])
+                    for off, _ts, _key, value in msgs:
+                        offsets[p] = off + 1
+                        progressed = True
+                        if value is None:
+                            continue
+                        try:
+                            yield parser(value.decode())
+                        except (ValueError, IndexError):
+                            continue
+                if not progressed:
+                    # fetch() already long-polled max_wait_ms per partition;
+                    # loop again (a live stream source never terminates —
+                    # same contract as the library-backed branches).
+                    continue
+        finally:
+            client.close()
 
 
 class KafkaSink:
-    """Produce rendered records to a topic (Serialization.java producers)."""
+    """Produce rendered records to a topic (Serialization.java producers).
+
+    The built-in backend buffers records and produces one message set per
+    ``flush()`` (auto-flushes every ``batch`` records) — the analog of the
+    library producers' internal batching.
+    """
 
     def __init__(self, topic: str, bootstrap_servers: str,
-                 formatter: Callable = str):
+                 formatter: Callable = str, partition: int = 0,
+                 batch: int = 500):
         kind, mod = _import_kafka()
-        if kind is None:
-            raise RuntimeError(_MISSING)
         self.topic = topic
         self.formatter = formatter
+        self._kind = kind
         if kind == "kafka":
             self._producer = mod.KafkaProducer(
                 bootstrap_servers=bootstrap_servers.split(",")
             )
             self._send = lambda v: self._producer.send(self.topic, v)
-        else:
+        elif kind == "confluent":
             self._producer = mod.Producer({"bootstrap.servers": bootstrap_servers})
             self._send = lambda v: self._producer.produce(self.topic, v)
+        else:
+            self._client = mod.KafkaWireClient(bootstrap_servers)
+            self._partition = partition
+            self._batch = batch
+            self._buf: list = []
+            self._send = self._buffer_send
+
+    def _buffer_send(self, value: bytes) -> None:
+        import time as _time
+
+        self._buf.append((value, None, int(_time.time() * 1000)))
+        if len(self._buf) >= self._batch:
+            self.flush()
 
     def __call__(self, record):
         self._send(self.formatter(record).encode())
 
     def flush(self):
-        self._producer.flush()
+        if self._kind in ("kafka", "confluent"):
+            self._producer.flush()
+        elif self._buf:
+            self._client.produce(self.topic, self._partition, self._buf)
+            self._buf = []
+
+    def close(self):
+        self.flush()
+        if self._kind == "wire":
+            self._client.close()

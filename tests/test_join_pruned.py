@@ -1,0 +1,264 @@
+"""Grid-pruned geometry joins (ops/join.py pruned kernels +
+operators/join_query.py): pair sets must be identical to the dense masked
+evaluation — sparse, dense/overflow-retry, containment→0, SoA paths."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import LineString, Point, Polygon
+from spatialflink_tpu.operators import QueryConfiguration, QueryType
+from spatialflink_tpu.operators.join_query import (
+    LineStringLineStringJoinQuery,
+    PointPolygonJoinQuery,
+    PolygonPolygonJoinQuery,
+)
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+W = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=10)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(33)
+
+
+def _points(rng, n, t_span=9_000):
+    xy = rng.uniform(0, 10, (n, 2))
+    return [
+        Point(obj_id=f"p{i}", timestamp=int(i * t_span / n),
+              x=float(xy[i, 0]), y=float(xy[i, 1]))
+        for i in range(n)
+    ]
+
+
+def _square(cx, cy, r):
+    return np.array([
+        [cx - r, cy - r], [cx + r, cy - r], [cx + r, cy + r],
+        [cx - r, cy + r], [cx - r, cy - r],
+    ])
+
+
+def _polygons(rng, m, t_span=9_000, size=0.25):
+    out = []
+    for i in range(m):
+        cx, cy = rng.uniform(0.5, 9.5, 2)
+        out.append(Polygon(
+            obj_id=f"g{i}", timestamp=int(i * t_span / m),
+            rings=[_square(float(cx), float(cy), size)],
+        ))
+    return out
+
+
+def _linestrings(rng, m, t_span=9_000):
+    out = []
+    for i in range(m):
+        x0, y0 = rng.uniform(0.5, 9.0, 2)
+        pts = np.stack([
+            np.linspace(x0, x0 + 0.8, 5),
+            y0 + 0.2 * np.sin(np.linspace(0, 3, 5)),
+        ], axis=1)
+        out.append(LineString(obj_id=f"l{i}", timestamp=int(i * t_span / m),
+                              coords=pts))
+    return out
+
+
+def _dense_pairs_point_geom(op, pts, geoms, radius, polygonal):
+    """Reference pair set straight from the dense kernel."""
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.join import point_geometry_join_kernel
+
+    lb = op.point_batch(pts)
+    gb = op.geometry_batch(geoms)
+    kernel = jitted(point_geometry_join_kernel, "polygonal")
+    mask, d = kernel(
+        op.device_xy(lb, np.float64), jnp.asarray(lb.valid),
+        op.device_verts(gb.verts, np.float64), jnp.asarray(gb.edge_valid),
+        jnp.asarray(gb.valid), radius, polygonal=polygonal,
+    )
+    mask, d = np.asarray(mask), np.asarray(d)
+    return {
+        (pts[i].obj_id, geoms[m].obj_id, round(float(d[m, i]), 12))
+        for m in range(len(geoms)) for i in range(len(pts)) if mask[m, i]
+    }
+
+
+def _op_pairs(results):
+    return {
+        (a.obj_id, b.obj_id, round(float(d), 12))
+        for res in results for a, b, d in res.pairs
+    }
+
+
+def test_point_polygon_pruned_matches_dense(rng):
+    pts = _points(rng, 3_000)
+    polys = _polygons(rng, 120)
+    r = 0.15
+    op = PointPolygonJoinQuery(W, GRID)
+    got = _op_pairs(op.run(iter(pts), iter(polys), r))
+    expect = _dense_pairs_point_geom(
+        PointPolygonJoinQuery(W, GRID), pts, polys, r, True
+    )
+    assert got == expect
+    assert len(got) > 50  # non-trivial workload
+
+
+def test_point_polygon_containment_zero_dist(rng):
+    pts = [Point(obj_id="in", timestamp=0, x=5.0, y=5.0),
+           Point(obj_id="out", timestamp=1, x=9.9, y=9.9)]
+    polys = [Polygon(obj_id="g", timestamp=0, rings=[_square(5.0, 5.0, 1.0)])]
+    op = PointPolygonJoinQuery(W, GRID)
+    got = _op_pairs(op.run(iter(pts), iter(polys), 0.05))
+    assert got == {("in", "g", 0.0)}
+
+
+def test_point_polygon_overflow_retry_exact(rng):
+    """cand=1 start with clustered polygons forces overflow growth; the
+    retry contract must converge to the exact dense pair set."""
+    pts = _points(rng, 800)
+    # 40 polygons stacked in one corner: every point tile near the corner
+    # has >> 1 candidate.
+    polys = []
+    for i in range(40):
+        cx, cy = 2.0 + 0.02 * i, 2.0 + 0.015 * i
+        polys.append(Polygon(obj_id=f"g{i}", timestamp=i * 200,
+                             rings=[_square(cx, cy, 0.4)]))
+    r = 0.2
+    op = PointPolygonJoinQuery(W, GRID)
+    op._cand = 1
+    got = _op_pairs(op.run(iter(pts), iter(polys), r))
+    expect = _dense_pairs_point_geom(
+        PointPolygonJoinQuery(W, GRID), pts, polys, r, True
+    )
+    assert got == expect
+    assert op._cand > 1  # growth actually happened
+
+
+def test_point_linestring_pruned_matches_dense(rng):
+    from spatialflink_tpu.operators.join_query import PointLineStringJoinQuery
+
+    pts = _points(rng, 2_000)
+    lines = _linestrings(rng, 80)
+    r = 0.1
+    got = _op_pairs(
+        PointLineStringJoinQuery(W, GRID).run(iter(pts), iter(lines), r)
+    )
+    expect = _dense_pairs_point_geom(
+        PointLineStringJoinQuery(W, GRID), pts, lines, r, False
+    )
+    assert got == expect
+    assert got
+
+
+def test_polygon_polygon_pruned_matches_dense(rng):
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.join import geometry_geometry_join_kernel
+
+    left = _polygons(rng, 90, size=0.3)
+    right = _polygons(np.random.default_rng(7), 70, size=0.35)
+    r = 0.2
+    op = PolygonPolygonJoinQuery(W, GRID)
+    got = _op_pairs(op.run(iter(left), iter(right), r))
+
+    la = op.geometry_batch(left)
+    ra = op.geometry_batch(right)
+    kernel = jitted(geometry_geometry_join_kernel, "a_polygonal", "b_polygonal")
+    mask, d = kernel(
+        op.device_verts(la.verts, np.float64), jnp.asarray(la.edge_valid),
+        jnp.asarray(la.valid),
+        op.device_verts(ra.verts, np.float64), jnp.asarray(ra.edge_valid),
+        jnp.asarray(ra.valid), r, a_polygonal=True, b_polygonal=True,
+    )
+    mask, d = np.asarray(mask), np.asarray(d)
+    expect = {
+        (left[i].obj_id, right[j].obj_id, round(float(d[i, j]), 12))
+        for i in range(len(left)) for j in range(len(right)) if mask[i, j]
+    }
+    assert got == expect
+    # Overlapping polygons exist at these densities → some 0-distance pairs.
+    assert any(p[2] == 0.0 for p in got)
+
+
+def test_linestring_linestring_pruned_matches_dense(rng):
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.join import geometry_geometry_join_kernel
+
+    left = _linestrings(rng, 60)
+    right = _linestrings(np.random.default_rng(8), 50)
+    r = 0.15
+    op = LineStringLineStringJoinQuery(W, GRID)
+    got = _op_pairs(op.run(iter(left), iter(right), r))
+    la = op.geometry_batch(left)
+    ra = op.geometry_batch(right)
+    kernel = jitted(geometry_geometry_join_kernel, "a_polygonal", "b_polygonal")
+    mask, d = kernel(
+        op.device_verts(la.verts, np.float64), jnp.asarray(la.edge_valid),
+        jnp.asarray(la.valid),
+        op.device_verts(ra.verts, np.float64), jnp.asarray(ra.edge_valid),
+        jnp.asarray(ra.valid), r, a_polygonal=False, b_polygonal=False,
+    )
+    mask, d = np.asarray(mask), np.asarray(d)
+    expect = {
+        (left[i].obj_id, right[j].obj_id, round(float(d[i, j]), 12))
+        for i in range(len(left)) for j in range(len(right)) if mask[i, j]
+    }
+    assert got == expect
+
+
+def _point_chunks(pts, chunk=500):
+    for lo in range(0, len(pts), chunk):
+        sl = pts[lo:lo + chunk]
+        yield {
+            "ts": np.asarray([p.timestamp for p in sl], np.int64),
+            "x": np.asarray([p.x for p in sl]),
+            "y": np.asarray([p.y for p in sl]),
+            "oid": np.arange(lo, lo + len(sl), dtype=np.int32),
+        }
+
+
+def _geom_chunks(geoms, chunk=40):
+    for lo in range(0, len(geoms), chunk):
+        sl = geoms[lo:lo + chunk]
+        verts = [np.asarray(g.rings[0] if isinstance(g, Polygon)
+                            else g.coords, np.float64) for g in sl]
+        yield {
+            "ts": np.asarray([g.timestamp for g in sl], np.int64),
+            "oid": np.arange(lo, lo + len(sl), dtype=np.int32),
+            "lengths": np.asarray([len(v) for v in verts], np.int64),
+            "verts": np.concatenate(verts, axis=0),
+        }
+
+
+def test_point_polygon_run_soa_matches_run(rng):
+    pts = _points(rng, 2_000)
+    polys = _polygons(rng, 60)
+    r = 0.15
+    obj = _op_pairs(
+        PointPolygonJoinQuery(W, GRID).run(iter(pts), iter(polys), r)
+    )
+    soa_pairs = set()
+    for start, end, li, ri, dd, count in PointPolygonJoinQuery(
+        W, GRID
+    ).run_soa(_point_chunks(pts), _geom_chunks(polys), r):
+        for a, b, d in zip(li, ri, dd):
+            soa_pairs.add((pts[int(a)].obj_id, polys[int(b)].obj_id,
+                           round(float(d), 12)))
+    assert soa_pairs == obj
+
+
+def test_polygon_polygon_run_soa_matches_run(rng):
+    left = _polygons(rng, 60, size=0.3)
+    right = _polygons(np.random.default_rng(9), 50, size=0.3)
+    r = 0.2
+    obj = _op_pairs(
+        PolygonPolygonJoinQuery(W, GRID).run(iter(left), iter(right), r)
+    )
+    soa_pairs = set()
+    for start, end, li, ri, dd, count in PolygonPolygonJoinQuery(
+        W, GRID
+    ).run_soa(_geom_chunks(left), _geom_chunks(right), r):
+        for a, b, d in zip(li, ri, dd):
+            soa_pairs.add((left[int(a)].obj_id, right[int(b)].obj_id,
+                           round(float(d), 12)))
+    assert soa_pairs == obj

@@ -59,13 +59,12 @@ def fake_kafka(monkeypatch):
     BROKER.clear()
 
 
-def test_gate_reports_unavailable_without_client():
-    from spatialflink_tpu.streams.kafka import kafka_available, kafka_source
+def test_kafka_always_available_via_builtin_client():
+    """The built-in wire client (streams/kafka_wire.py) removed the old
+    gate: kafka_available() is True in this image with no pip installs."""
+    from spatialflink_tpu.streams.kafka import kafka_available
 
-    assert "kafka" not in sys.modules or not kafka_available()
-    if not kafka_available():
-        with pytest.raises(RuntimeError, match="No Kafka client"):
-            kafka_source("t", "localhost:9092", str)
+    assert kafka_available()
 
 
 def test_kafka_roundtrip_geojson_points(fake_kafka):

@@ -1,0 +1,143 @@
+"""knn_pane_digest_compact must be bit-identical to the scatter digest:
+sparse (compact path), dense (automatic scatter fallback), ties, flags
+on/off, and through the window merge."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.ops.cells import assign_cells
+from spatialflink_tpu.ops.knn import (
+    knn_merge_digest_list,
+    knn_pane_digest,
+    knn_pane_digest_compact,
+)
+
+NSEG = 512
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return UniformGrid(100, min_x=0.0, max_x=10.0, min_y=0.0, max_y=10.0)
+
+
+def _pane(rng, n, grid, spread=10.0):
+    xy = np.stack([rng.uniform(0, spread, n), rng.uniform(0, spread, n)],
+                  axis=1).astype(np.float32)
+    oid = rng.integers(0, NSEG, n).astype(np.int32)
+    valid = np.ones(n, bool)
+    cell = grid.assign_cells_np(xy.astype(np.float64))
+    return xy, valid, cell, oid
+
+
+def _digests(grid, xy, valid, cell, oid, q, radius, flags, cand):
+    args = (
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        None if flags is None else jnp.asarray(flags),
+        jnp.asarray(oid), jnp.asarray(q), np.float32(radius),
+        jnp.int32(0),
+    )
+    d_full = jax.jit(knn_pane_digest, static_argnames="num_segments")(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        jnp.asarray(flags if flags is not None
+                    else np.ones(grid.num_cells + 1, np.uint8)),
+        jnp.asarray(oid), jnp.asarray(q), np.float32(radius), jnp.int32(0),
+        num_segments=NSEG,
+    )
+    d_cmp = jax.jit(
+        knn_pane_digest_compact, static_argnames=("num_segments", "cand")
+    )(*args, num_segments=NSEG, cand=cand)
+    return d_full, d_cmp
+
+
+def _assert_same(d_full, d_cmp):
+    assert np.array_equal(np.asarray(d_full.seg_min), np.asarray(d_cmp.seg_min))
+    assert np.array_equal(np.asarray(d_full.rep), np.asarray(d_cmp.rep))
+
+
+def test_compact_sparse_matches_scatter(grid):
+    """Few in-radius points (< cand): the compact path runs and matches."""
+    rng = np.random.default_rng(1)
+    xy, valid, cell, oid = _pane(rng, 50_000, grid)
+    q = np.asarray([5.0, 5.0], np.float32)
+    radius = 0.2  # ~60 points in radius
+    flags = grid.neighbor_flags(radius, [grid.flat_cell(*q)])
+    d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, radius, flags,
+                             cand=1024)
+    _assert_same(d_full, d_cmp)
+    assert int(np.sum(np.asarray(d_cmp.seg_min) < np.finfo(np.float32).max)) > 0
+
+
+def test_compact_dense_falls_back(grid):
+    """More in-radius points than cand: the lax.cond fallback must produce
+    the scatter digest bit-for-bit."""
+    rng = np.random.default_rng(2)
+    xy, valid, cell, oid = _pane(rng, 20_000, grid)
+    q = np.asarray([5.0, 5.0], np.float32)
+    radius = 8.0  # nearly everything in radius — far more than cand=256
+    flags = grid.neighbor_flags(1.0, [grid.flat_cell(*q)])
+    flags = np.ones_like(flags)  # all cells candidates at this radius
+    d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, radius, flags,
+                             cand=256)
+    _assert_same(d_full, d_cmp)
+
+
+def test_compact_no_flags_matches_flagged(grid):
+    """flags_table=None (gather skipped): identical digest — the radius
+    test subsumes single-query grid pruning."""
+    rng = np.random.default_rng(3)
+    xy, valid, cell, oid = _pane(rng, 50_000, grid)
+    q = np.asarray([3.0, 7.0], np.float32)
+    radius = 0.3
+    flags = grid.neighbor_flags(radius, [grid.flat_cell(*q)])
+    d_flag, d_noflag = (
+        _digests(grid, xy, valid, cell, oid, q, radius, flags, cand=2048)[1],
+        _digests(grid, xy, valid, cell, oid, q, radius, None, cand=2048)[1],
+    )
+    _assert_same(d_flag, d_noflag)
+
+
+def test_compact_tie_break_first_seen(grid):
+    """Duplicate coordinates (equal distances) must keep the lowest index
+    as representative — the scatter path's contract."""
+    xy = np.asarray(
+        [[5.1, 5.0]] * 4 + [[5.2, 5.0]] * 3 + [[9.0, 9.0]], np.float32
+    )
+    oid = np.asarray([7, 7, 3, 7, 3, 3, 7, 1], np.int32)
+    valid = np.ones(len(xy), bool)
+    cell = grid.assign_cells_np(xy.astype(np.float64))
+    q = np.asarray([5.0, 5.0], np.float32)
+    d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, 1.0, None,
+                             cand=4)  # in-radius (7) > cand → fallback
+    _assert_same(d_full, d_cmp)
+    d_full2, d_cmp2 = _digests(grid, xy, valid, cell, oid, q, 1.0, None,
+                               cand=8)
+    _assert_same(d_full2, d_cmp2)
+    rep = np.asarray(d_cmp2.rep)
+    assert rep[7] == 0 and rep[3] == 2  # first-seen at the min distance
+
+
+def test_compact_through_merge(grid):
+    """Two panes digested compactly, merged: same KnnResult as scatter
+    digests merged (the carry pipeline is unchanged downstream)."""
+    rng = np.random.default_rng(4)
+    q = np.asarray([5.0, 5.0], np.float32)
+    radius, k = 1.0, 16
+    panes_full, panes_cmp = [], []
+    for seed in (10, 11):
+        xy, valid, cell, oid = _pane(np.random.default_rng(seed), 30_000, grid)
+        flags = grid.neighbor_flags(radius, [grid.flat_cell(*q)])
+        d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, radius,
+                                 flags, cand=4096)
+        panes_full.append(d_full)
+        panes_cmp.append(d_cmp)
+    bases = np.asarray([0, 30_000], np.int32)
+    merge = jax.jit(knn_merge_digest_list, static_argnames="k")
+    r_full = merge(tuple(d.seg_min for d in panes_full),
+                   tuple(d.rep for d in panes_full), bases, k=k)
+    r_cmp = merge(tuple(d.seg_min for d in panes_cmp),
+                  tuple(d.rep for d in panes_cmp), bases, k=k)
+    for a, b in zip(r_full, r_cmp):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -403,6 +403,33 @@ def test_pane_knn_empty_panes_float32(rng):
         assert all(d < 1e30 for _, d, _ in neighbors)
 
 
+def test_pane_knn_excludes_out_of_extent_points(rng):
+    """Points outside the grid extent carry cell == num_cells, whose flag
+    entry is hard-coded 0 (the reference's key-never-matches semantics,
+    HelperClass.assignGridCellID). The flag-less compact pane path must
+    exclude them exactly like run() — regression for the host-side
+    in-grid mask."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10, slide_step=5)
+    pts = synth_points(rng, n=200)
+    outside = [
+        Point(obj_id=f"out{i}", timestamp=i * 400, x=10.2 + 0.01 * i, y=5.0)
+        for i in range(20)
+    ]
+    stream = sorted(pts + outside, key=lambda p: p.timestamp)
+    q = Point(x=9.9, y=5.0)  # out-of-extent points are within radius
+    r, k = 2.0, 8
+    full = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).run(iter(stream), q, r, k)
+    )
+    pane = _knn_result_key(
+        PointPointKNNQuery(conf, GRID).query_panes(iter(stream), q, r, k)
+    )
+    assert full == pane
+    assert not any(
+        oid.startswith("out") for nb in pane.values() for oid, _, _ in nb
+    )
+
+
 def test_pane_knn_polygon_query(rng):
     """Pane carry through the polygon-query digest (containment → 0)."""
     from spatialflink_tpu.operators import PointPolygonKNNQuery

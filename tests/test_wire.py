@@ -1,0 +1,125 @@
+"""Wire-format exactness: the 6 B/pt quantized ingest path must add zero
+error on top of quantization — device upcast == host reference upcast,
+bitwise, and the full kNN digest program fed wire records must equal the
+same program fed the host-dequantized f32 coords."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.streams.wire import U16_MAX, WireFormat, wire_scale
+
+BEIJING = dict(min_x=115.5, max_x=117.6, min_y=39.6, max_y=41.1)
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return UniformGrid(100, **BEIJING)
+
+
+def test_wire_scale_contract():
+    for span in (2.1, 1.5, 0.001, 360.0, 1e-6, 123.456):
+        s = wire_scale(span)
+        # Covers the span end to end.
+        assert s * U16_MAX >= span
+        # The 8-bit mantissa ceiling costs at most 1/128 relative slack.
+        assert s * U16_MAX <= span * (1 + 1 / 127) + s
+        # m×2^e with m ≤ 8 bits: strip trailing powers of two until the
+        # mantissa is an odd integer; it must fit in 8 bits.
+        m, e = s, 0
+        while m != math.floor(m) or (m >= 2 and m % 2 == 0):
+            m = m * 2 if m != math.floor(m) else m / 2
+            e += 1
+            assert e < 400
+        assert 1 <= m <= 255
+
+
+def test_dequantize_device_matches_host_bitwise(grid):
+    rng = np.random.default_rng(5)
+    wf = WireFormat.for_grid(grid)
+    xy = np.stack([
+        rng.uniform(BEIJING["min_x"], BEIJING["max_x"], 50_000),
+        rng.uniform(BEIJING["min_y"], BEIJING["max_y"], 50_000),
+    ], axis=1)
+    q = wf.quantize(xy)
+    host = wf.dequantize_np(q)
+    dev = np.asarray(jax.jit(wf.dequantize)(jnp.asarray(q)))
+    assert host.dtype == np.float32 and dev.dtype == np.float32
+    # Bit-identical: the product uint16×(8-bit m×2^e) is exact in f32, so
+    # FMA vs separate mul+add cannot round differently.
+    assert np.array_equal(host.view(np.uint32), dev.view(np.uint32))
+
+
+def test_quantization_error_below_one_step(grid):
+    rng = np.random.default_rng(6)
+    wf = WireFormat.for_grid(grid)
+    xy = np.stack([
+        rng.uniform(BEIJING["min_x"], BEIJING["max_x"], 10_000),
+        rng.uniform(BEIJING["min_y"], BEIJING["max_y"], 10_000),
+    ], axis=1)
+    back = wf.dequantize_np(wf.quantize(xy)).astype(np.float64)
+    err = np.abs(back - xy)
+    # One lattice step, plus the single f32 rounding of origin + q*scale
+    # (ulp/2 at coordinate magnitude ~128 is 3.8e-6) and the origin's own
+    # f32 rounding.
+    f32_round = 8e-6
+    assert float(err[:, 0].max()) <= float(wf.scale[0]) + f32_round
+    assert float(err[:, 1].max()) <= float(wf.scale[1]) + f32_round
+
+
+def test_knn_digest_parity_wire_vs_f32(grid):
+    """The full fused pane-digest program fed 6-byte wire records must
+    produce bit-identical digests to the same program fed pre-dequantized
+    f32 coordinates (the device upcast is exact, so quantization is the
+    ONLY precision event — and it happens at the producer)."""
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.knn import knn_pane_digest
+
+    rng = np.random.default_rng(7)
+    n, nseg = 20_000, 1024
+    wf = WireFormat.for_grid(grid)
+    xy = np.stack([
+        rng.uniform(BEIJING["min_x"], BEIJING["max_x"], n),
+        rng.uniform(BEIJING["min_y"], BEIJING["max_y"], n),
+    ], axis=1)
+    q16 = wf.quantize(xy)
+    oid16 = rng.integers(0, nseg, n).astype(np.int16)
+    qp = np.asarray([116.40, 40.19], np.float32)
+    flags = grid.neighbor_flags(0.05, [grid.flat_cell(*qp)])
+    valid = np.ones(n, bool)
+
+    def digest_wire(xyq, oid, flags_table, query_xy):
+        xy_f = wf.dequantize(xyq)
+        cell = assign_cells(
+            xy_f, grid.min_x, grid.min_y, grid.cell_length, grid.n
+        )
+        return knn_pane_digest(
+            xy_f, jnp.asarray(valid), cell, flags_table,
+            oid.astype(jnp.int32), query_xy, np.float32(0.05),
+            jnp.int32(0), num_segments=nseg,
+        )
+
+    def digest_f32(xy_f, oid, flags_table, query_xy):
+        cell = assign_cells(
+            xy_f, grid.min_x, grid.min_y, grid.cell_length, grid.n
+        )
+        return knn_pane_digest(
+            xy_f, jnp.asarray(valid), cell, flags_table,
+            oid.astype(jnp.int32), query_xy, np.float32(0.05),
+            jnp.int32(0), num_segments=nseg,
+        )
+
+    d_wire = jax.jit(digest_wire)(
+        jnp.asarray(q16), jnp.asarray(oid16), jnp.asarray(flags),
+        jnp.asarray(qp),
+    )
+    d_f32 = jax.jit(digest_f32)(
+        jnp.asarray(wf.dequantize_np(q16)), jnp.asarray(oid16),
+        jnp.asarray(flags), jnp.asarray(qp),
+    )
+    assert np.array_equal(np.asarray(d_wire.seg_min), np.asarray(d_f32.seg_min))
+    assert np.array_equal(np.asarray(d_wire.rep), np.asarray(d_f32.rep))
