@@ -536,6 +536,114 @@ def bench_point_polygon_join(jax, jnp, grid, quick):
     )
 
 
+def bench_tjoin_sliding(jax, jnp, grid, quick):
+    """tJoin (trajectory join) through 10s/1s sliding windows — the
+    run_soa program end to end on device: per window fire, grid-hash
+    point join (dense bucket planes, roll-shift neighbor lookup) + per-
+    trajectory-pair min-distance dedup (traj_pair_dedup_kernel), over a
+    rolling 10-slide window whose slides stay device-resident (each point
+    ships ONCE in the 6 B/pt wire format and is re-joined in 10 window
+    fires). Rate = distinct ingested points (both streams) / wall time.
+    """
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.join import (
+        join_window_bucketed,
+        pallas_join_supported,
+    )
+    from spatialflink_tpu.ops.trajectory import traj_pair_dedup_kernel
+    from spatialflink_tpu.streams.wire import WireFormat
+
+    if pallas_join_supported():
+        # Hit extraction in time ∝ matches — the XLA nonzero compaction
+        # over the span²·cells·cap² domain costs seconds per window at
+        # these shapes (the pallas_join design rationale).
+        from spatialflink_tpu.ops.pallas_join import join_window_pallas as _join
+    else:
+        _join = join_window_bucketed
+
+    ppw = 10  # slides per window (10s window / 1s slide)
+    slide_pts = 10_240 if quick else 20_480
+    n_slides = 14 if quick else 30
+    n_obj = 512
+    radius = np.float32(0.001)  # ≈110 m proximity
+    # ~20 pts/cell avg: cap 64 holds the tail at 200k-pt windows (overflow
+    # asserted 0). The Pallas extraction cost scales with matches, so the
+    # budgets are sized to the ~40k pairs this radius produces.
+    cap, max_pairs, max_tpairs = 64, 65_536, 65_536
+    wf = WireFormat.for_grid(grid)
+    dev = jax.devices()[0]
+    total = slide_pts * n_slides
+
+    def mk_wire(seed):
+        r = np.random.default_rng(seed)
+        xyq = wf.quantize(np.stack(
+            [r.uniform(115.5, 117.6, total), r.uniform(39.6, 41.1, total)],
+            axis=1,
+        ))
+        oid = r.integers(0, n_obj, total).astype(np.uint16)
+        return np.concatenate([xyq, oid[:, None]], axis=1)
+
+    wire_l, wire_r = mk_wire(31), mk_wire(32)
+    ones = jax.device_put(jnp.asarray(np.ones(slide_pts * ppw, bool)), dev)
+
+    def window_step(l_slides, r_slides):
+        lw = jnp.concatenate(l_slides)
+        rw = jnp.concatenate(r_slides)
+        lxy = wf.dequantize(lw[:, :2])
+        rxy = wf.dequantize(rw[:, :2])
+        lcell = assign_cells(lxy, grid.min_x, grid.min_y, grid.cell_length,
+                             grid.n)
+        rcell = assign_cells(rxy, grid.min_x, grid.min_y, grid.cell_length,
+                             grid.n)
+        res = _join(
+            lxy, ones, lcell, rxy, ones, rcell,
+            grid_n=grid.n, layers=grid.candidate_layers(float(radius)),
+            radius=radius, cap_left=cap, cap_right=cap, max_pairs=max_pairs,
+        )
+        tp = traj_pair_dedup_kernel(
+            res.left_index, res.right_index, res.dist,
+            lw[:, 2].astype(jnp.int32), rw[:, 2].astype(jnp.int32),
+            num_left=n_obj, num_right=n_obj, max_tpairs=max_tpairs,
+        )
+        return tp.count, res.count, res.overflow
+
+    jstep = jax.jit(window_step)
+
+    def slide_pair(i):
+        sl = slice(i * slide_pts, (i + 1) * slide_pts)
+        return (jax.device_put(wire_l[sl], dev),
+                jax.device_put(wire_r[sl], dev))
+
+    # Pre-stage + warm the first window (outside the timed region).
+    ring_l = [slide_pair(i)[0] for i in range(ppw)]
+    ring_r = [slide_pair(i)[1] for i in range(ppw)]
+    warm = jstep(tuple(ring_l), tuple(ring_r))
+    jax.device_get(warm)
+
+    state = {"l": list(ring_l), "r": list(ring_r)}
+
+    def dispatch(pair):
+        sl, sr = pair
+        state["l"] = state["l"][1:] + [sl]
+        state["r"] = state["r"][1:] + [sr]
+        return jstep(tuple(state["l"]), tuple(state["r"]))
+
+    def reset():
+        state["l"], state["r"] = list(ring_l), list(ring_r)
+
+    out, dt, t_min, t_max = _pipelined(
+        jax, n_slides - ppw, lambda i: slide_pair(i + ppw), dispatch,
+        reset=reset,
+    )
+    assert sum(int(o) for _, _, o in out) == 0, "cell cap overflow"
+    assert all(int(c) <= max_pairs for _, c, _ in out), "pair budget"
+    assert all(int(t) <= max_tpairs for t, _, _ in out), "tpair budget"
+    return _result(
+        "tjoin_10s_1s_sliding", 2 * slide_pts * (n_slides - ppw), dt,
+        {"traj_pairs_last": int(out[-1][0])}, spread=(t_min, t_max),
+    )
+
+
 def bench_tstats_pane(jax, jnp, grid, quick):
     """tStats through the reference's extreme-overlap 10s/10ms sliding
     config (Q2_BrakeMonitor-style) via pane decomposition
@@ -691,6 +799,7 @@ def main():
         bench_polygon_range(jax, jnp, grid, args.quick),
         bench_join(jax, jnp, grid, args.quick),
         bench_point_polygon_join(jax, jnp, grid, args.quick),
+        bench_tjoin_sliding(jax, jnp, grid, args.quick),
         bench_tknn(jax, jnp, grid, args.quick),
         bench_tstats_pane(jax, jnp, grid, args.quick),
         bench_knn_multi_query(jax, jnp, grid, args.quick),

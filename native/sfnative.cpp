@@ -12,7 +12,9 @@
 // PCFA@3, PCFF@4, speed@11, lat@12, lon@13; unparseable numerics -> 0).
 // Exposed via a C ABI for ctypes (no pybind11 in this environment).
 
+#include <algorithm>
 #include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -340,8 +342,167 @@ int64_t sf_parse_wkt_geoms(void* interner_h, const char* buf, int64_t len,
   return rows;
 }
 
+// Pane-decomposed sliding trajectory statistics — the native form of
+// streams/panes.py:traj_stats_sliding's hot path (tStats through the
+// reference's extreme-overlap 10s/10ms configs,
+// tStats/TStatsQuery.java:148-189 window walks). Input events must be
+// ts-sorted; the function counting-sorts them stably by oid (preserving
+// ts order per trajectory), bins consecutive same-trajectory segments
+// into the pane of their later point, and emits per-(window, oid)
+// spatial/temporal/count matrices with the start-boundary corrections.
+//
+// BIT PARITY with the numpy reference: float additions run in the same
+// association order (per-(pane,oid) accumulation in ts order; prefix-sum
+// -difference window sums; prefix-summed correction subtraction), so the
+// outputs are identical to the numpy path (tests/test_native.py).
+//
+// Outputs are row-major (n_starts, num_oids), caller-allocated and
+// ZEROED by this function. Returns n_starts, or -1 if an oid is out of
+// [0, num_oids).
+int64_t sf_traj_stats(
+    const int64_t* ts, const double* x, const double* y, const int32_t* oid,
+    int64_t n, int32_t num_oids, int64_t size_ms, int64_t slide_ms,
+    double* out_spatial, int64_t* out_temporal, int64_t* out_count) {
+  auto fdiv = [](int64_t a, int64_t b) {
+    int64_t q = a / b;
+    return (a % b != 0 && ((a < 0) != (b < 0))) ? q - 1 : q;
+  };
+  const int64_t ppw = size_ms / slide_ms;
+  if (n <= 0) return 0;
+  const int64_t p_lo = fdiv(ts[0], slide_ms);
+  const int64_t p_hi = fdiv(ts[n - 1], slide_ms);
+  const int64_t n_panes = p_hi - p_lo + 1;
+  const int64_t n_starts = n_panes + ppw - 1;
+  const int64_t base = p_lo - (ppw - 1);  // absolute pane of start index 0
+
+  for (int64_t i = 0; i < n; ++i)
+    if (oid[i] < 0 || oid[i] >= num_oids) return -1;
+
+  // Stable counting sort by oid (ts order preserved per trajectory).
+  std::vector<int64_t> counts(static_cast<size_t>(num_oids) + 1, 0);
+  for (int64_t i = 0; i < n; ++i) ++counts[static_cast<size_t>(oid[i]) + 1];
+  for (int32_t k = 0; k < num_oids; ++k) counts[k + 1] += counts[k];
+  std::vector<int64_t> pos(static_cast<size_t>(n));
+  {
+    std::vector<int64_t> cursor(counts.begin(), counts.end() - 1);
+    for (int64_t i = 0; i < n; ++i)
+      pos[static_cast<size_t>(cursor[oid[i]]++)] = i;
+  }
+
+  std::memset(out_spatial, 0,
+              sizeof(double) * static_cast<size_t>(n_starts) * num_oids);
+  std::memset(out_temporal, 0,
+              sizeof(int64_t) * static_cast<size_t>(n_starts) * num_oids);
+  std::memset(out_count, 0,
+              sizeof(int64_t) * static_cast<size_t>(n_starts) * num_oids);
+
+  // Reused per-oid rows (touched entries re-zeroed after each oid).
+  std::vector<double> pane_d(static_cast<size_t>(n_panes), 0.0);
+  std::vector<int64_t> pane_dt(static_cast<size_t>(n_panes), 0);
+  std::vector<int64_t> pane_cnt(static_cast<size_t>(n_panes), 0);
+  std::vector<double> diff_d(static_cast<size_t>(n_starts) + 1, 0.0);
+  std::vector<int64_t> diff_dt(static_cast<size_t>(n_starts) + 1, 0);
+  std::vector<double> pre_d(static_cast<size_t>(n_panes) + 1);
+  std::vector<int64_t> pre_dt(static_cast<size_t>(n_panes) + 1);
+  std::vector<int64_t> pre_cnt(static_cast<size_t>(n_panes) + 1);
+
+  for (int32_t o = 0; o < num_oids; ++o) {
+    const int64_t lo = counts[o], hi = counts[o + 1];
+    if (lo == hi) continue;
+    int64_t first_pane = n_panes, last_pane = -1;
+    int64_t first_si = n_starts + 1, last_si = -1;
+    int64_t prev_t = 0;
+    double prev_x = 0.0, prev_y = 0.0;
+    bool has_prev = false;
+    for (int64_t s = lo; s < hi; ++s) {
+      const int64_t i = pos[static_cast<size_t>(s)];
+      const int64_t t = ts[i];
+      const int64_t pane_abs = fdiv(t, slide_ms);
+      const int64_t pane = pane_abs - p_lo;
+      ++pane_cnt[static_cast<size_t>(pane)];
+      if (pane < first_pane) first_pane = pane;
+      if (pane > last_pane) last_pane = pane;
+      if (has_prev) {
+        const double d = std::hypot(x[i] - prev_x, y[i] - prev_y);
+        const int64_t dt = t - prev_t;
+        pane_d[static_cast<size_t>(pane)] += d;
+        pane_dt[static_cast<size_t>(pane)] += dt;
+        const int64_t fb =
+            std::max(fdiv(prev_t, slide_ms) + 1, pane_abs - ppw + 1);
+        if (fb <= pane_abs) {
+          const int64_t si0 = fb - base, si1 = pane_abs - base + 1;
+          diff_d[static_cast<size_t>(si0)] += d;
+          diff_d[static_cast<size_t>(si1)] -= d;
+          diff_dt[static_cast<size_t>(si0)] += dt;
+          diff_dt[static_cast<size_t>(si1)] -= dt;
+          if (si0 < first_si) first_si = si0;
+          if (si1 > last_si) last_si = si1;
+        }
+      }
+      prev_t = t;
+      prev_x = x[i];
+      prev_y = y[i];
+      has_prev = true;
+    }
+
+    // Window sums: prefix-sum difference over panes (numpy's cumsum
+    // association), minus the prefix-summed corrections.
+    // Window [b, b+ppw) sum = prefix(clip(b+ppw)) - prefix(clip(b)) —
+    // the numpy cumsum-difference association, bit for bit.
+    double cum_d = 0.0, corr_d = 0.0;
+    int64_t cum_dt = 0, corr_dt = 0, cum_cnt = 0;
+    pre_d[0] = 0.0;
+    pre_dt[0] = 0;
+    pre_cnt[0] = 0;
+    for (int64_t p = 0; p < n_panes; ++p) {
+      cum_d += pane_d[static_cast<size_t>(p)];
+      cum_dt += pane_dt[static_cast<size_t>(p)];
+      cum_cnt += pane_cnt[static_cast<size_t>(p)];
+      pre_d[static_cast<size_t>(p) + 1] = cum_d;
+      pre_dt[static_cast<size_t>(p) + 1] = cum_dt;
+      pre_cnt[static_cast<size_t>(p) + 1] = cum_cnt;
+    }
+    for (int64_t b = 0; b < n_starts; ++b) {
+      const int64_t w0 = b - (ppw - 1);  // window start pane (relative)
+      int64_t r_lo = w0 < 0 ? 0 : (w0 > n_panes ? n_panes : w0);
+      int64_t r_hi = w0 + ppw;
+      r_hi = r_hi < 0 ? 0 : (r_hi > n_panes ? n_panes : r_hi);
+      corr_d += diff_d[static_cast<size_t>(b)];
+      corr_dt += diff_dt[static_cast<size_t>(b)];
+      const int64_t cnt_w = pre_cnt[static_cast<size_t>(r_hi)] -
+                            pre_cnt[static_cast<size_t>(r_lo)];
+      if (cnt_w == 0 && corr_d == 0.0 && corr_dt == 0) continue;
+      const size_t slot =
+          static_cast<size_t>(b) * num_oids + static_cast<size_t>(o);
+      out_spatial[slot] = (pre_d[static_cast<size_t>(r_hi)] -
+                           pre_d[static_cast<size_t>(r_lo)]) -
+                          corr_d;
+      out_temporal[slot] = (pre_dt[static_cast<size_t>(r_hi)] -
+                            pre_dt[static_cast<size_t>(r_lo)]) -
+                           corr_dt;
+      out_count[slot] = cnt_w;
+    }
+
+    // Re-zero only the touched spans for the next oid.
+    if (last_pane >= 0) {
+      const size_t a = static_cast<size_t>(first_pane);
+      const size_t cnt_span = static_cast<size_t>(last_pane - first_pane) + 1;
+      std::memset(&pane_d[a], 0, sizeof(double) * cnt_span);
+      std::memset(&pane_dt[a], 0, sizeof(int64_t) * cnt_span);
+      std::memset(&pane_cnt[a], 0, sizeof(int64_t) * cnt_span);
+    }
+    if (last_si >= 0) {
+      const size_t a = static_cast<size_t>(first_si);
+      const size_t cnt_span = static_cast<size_t>(last_si - first_si) + 1;
+      std::memset(&diff_d[a], 0, sizeof(double) * cnt_span);
+      std::memset(&diff_dt[a], 0, sizeof(int64_t) * cnt_span);
+    }
+  }
+  return n_starts;
+}
+
 // Bump whenever any exported signature changes; native.py refuses to bind
 // a library whose version differs (stale prebuilt .so protection).
-int32_t sf_abi_version() { return 2; }
+int32_t sf_abi_version() { return 3; }
 
 }  // extern "C"

@@ -11,8 +11,18 @@ not load files from untrusted sources).
 Snapshottable components:
   - WindowAssembler: open window buffers, fired flags, max event-time,
     late-drop count;
+  - SoA sliding assemblers (streams/soa.py): buffered chunks + watermark
+    state machine;
   - TAggregateQuery: the per-(cell, objID) min/max timestamp MapState;
   - TStatsQuery: per-objID running spatial/temporal state;
+  - kNN pane-digest carry (query_panes / run_soa_panes) and join
+    pane-block carry (query_panes) — the incremental sliding-window
+    state, the ListState-carry analog of
+    range/PointPointRangeQuery.java:234-246. Device digests are pulled
+    to numpy at snapshot time; a resumed operator continues the stream
+    mid-window with identical output (tests/test_checkpoint_panes.py —
+    pass ``flush_at_end=False`` so a killed source doesn't flush open
+    windows);
   - Interner: the objID vocabulary (so dense ids stay stable on resume).
 """
 
@@ -51,6 +61,40 @@ def restore_assembler(asm: WindowAssembler, state: Dict[str, Any]) -> None:
     asm.dropped_late = state["dropped_late"]
 
 
+def soa_assembler_state(asm) -> Dict[str, Any]:
+    """Snapshot a streams/soa.py sliding assembler — the point assembler
+    (payload in ``_chunks``) or the ragged-geometry one (payload in
+    ``_rows``/``_verts``/``_edges``)."""
+    out: Dict[str, Any] = {
+        "max_ts": asm._max_ts,
+        "next_start": asm._next_start,
+        "dropped_late": asm.dropped_late,
+    }
+    if hasattr(asm, "_chunks"):  # SoaWindowAssembler
+        out["chunks"] = [
+            {k: np.asarray(v) for k, v in c.items()} for c in asm._chunks
+        ]
+    else:  # RaggedSoaWindowAssembler
+        out["rows"] = [dict(r) for r in asm._rows]
+        out["verts"] = list(asm._verts)
+        out["edges"] = None if asm._edges is None else list(asm._edges)
+        out["edge_mode"] = asm._edge_mode
+    return out
+
+
+def restore_soa_assembler(asm, state: Dict[str, Any]) -> None:
+    asm._max_ts = state["max_ts"]
+    asm._next_start = state["next_start"]
+    asm.dropped_late = state["dropped_late"]
+    if "chunks" in state:
+        asm._chunks = [dict(c) for c in state["chunks"]]
+    else:
+        asm._rows = [dict(r) for r in state["rows"]]
+        asm._verts = list(state["verts"])
+        asm._edges = None if state["edges"] is None else list(state["edges"])
+        asm._edge_mode = state["edge_mode"]
+
+
 def interner_state(interner: Interner) -> Dict[str, Any]:
     return {"table": list(interner._to_key)}
 
@@ -61,7 +105,10 @@ def restore_interner(interner: Interner, state: Dict[str, Any]) -> None:
 
 
 def operator_state(op) -> Dict[str, Any]:
-    """Snapshot the known stateful fields of an operator instance."""
+    """Snapshot the known stateful fields of an operator instance.
+
+    Pane-carry digests live on device during the run; they're pulled to
+    numpy here (a checkpoint is a host/disk artifact by definition)."""
     out: Dict[str, Any] = {"interner": interner_state(op.interner)}
     if hasattr(op, "_skeys"):  # TAggregateQuery MapState (sorted arrays)
         out["agg_state"] = {
@@ -71,6 +118,35 @@ def operator_state(op) -> Dict[str, Any]:
         }
     if hasattr(op, "_running"):  # TStatsQuery ValueState
         out["running"] = dict(op._running)
+    if getattr(op, "checkpoint_assembler", None) is not None:
+        out["assembler"] = assembler_state(op.checkpoint_assembler)
+    if getattr(op, "checkpoint_soa_assembler", None) is not None:
+        out["soa_assembler"] = soa_assembler_state(op.checkpoint_soa_assembler)
+    pane = getattr(op, "_pane_carry", None)
+    if pane is not None:  # kNN query_panes digests
+        out["knn_pane_carry"] = {
+            ps: None if v is None else
+            (int(v[0]), np.asarray(v[1]), np.asarray(v[2]), list(v[3]))
+            for ps, v in pane.items()
+        }
+    soa_pane = getattr(op, "_pane_carry_soa", None)
+    if soa_pane is not None:  # kNN run_soa_panes digests
+        out["knn_pane_carry_soa"] = {
+            ps: None if v is None else (np.asarray(v[0]), np.asarray(v[1]))
+            for ps, v in soa_pane.items()
+        }
+    jcarry = getattr(op, "_join_pane_carry", None)
+    if jcarry is not None:  # join query_panes pane events + pair blocks
+        out["join_pane_carry"] = {
+            "panes": {
+                ps: (list(v[0]), list(v[1]))
+                for ps, v in jcarry["panes"].items()
+            },
+            "blocks": {
+                key: (list(pairs), over)
+                for key, (pairs, over) in jcarry["blocks"].items()
+            },
+        }
     return out
 
 
@@ -97,6 +173,38 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
         op._smax = np.asarray(agg["max"], np.int64)
     if "running" in state and hasattr(op, "_running"):
         op._running = dict(state["running"])
+    if "assembler" in state:
+        op._restored_assembler = state["assembler"]
+    if "soa_assembler" in state:
+        op._restored_soa_assembler = state["soa_assembler"]
+    if "knn_pane_carry" in state:
+        op._pane_carry = {
+            ps: None if v is None else (v[0], v[1], v[2], list(v[3]))
+            for ps, v in state["knn_pane_carry"].items()
+        }
+    if "knn_pane_carry_soa" in state:
+        op._pane_carry_soa = {
+            ps: None if v is None else (v[0], v[1])
+            for ps, v in state["knn_pane_carry_soa"].items()
+        }
+    if "join_pane_carry" in state:
+        # Pane batches are derived data — rebuild through the operator's
+        # own batcher (the interner restored above keeps ids stable).
+        op._join_pane_carry = {
+            "panes": {
+                ps: (
+                    list(lev), list(rev),
+                    op.point_batch(lev) if lev else None,
+                    op.point_batch(rev) if rev else None,
+                )
+                for ps, (lev, rev) in state["join_pane_carry"]["panes"].items()
+            },
+            "blocks": {
+                key: (list(pairs), over)
+                for key, (pairs, over)
+                in state["join_pane_carry"]["blocks"].items()
+            },
+        }
 
 
 def save_checkpoint(path: str, **components) -> None:

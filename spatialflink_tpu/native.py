@@ -22,7 +22,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libsfnative.so")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 _abi_mismatch = False
-_ABI_VERSION = 2  # must match sf_abi_version() in sfnative.cpp
+_ABI_VERSION = 3  # must match sf_abi_version() in sfnative.cpp
 
 
 def ensure_built(quiet: bool = True) -> bool:
@@ -98,12 +98,54 @@ def _load() -> Optional[ctypes.CDLL]:
         np.ctypeslib.ndpointer(np.int64, shape=(1,), flags="C_CONTIGUOUS"),
     ]
     lib.sf_parse_wkt_geoms.restype = ctypes.c_int64
+    lib.sf_traj_stats.argtypes = [
+        i64_p, dbl_p, dbl_p, i32_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64, dbl_p, i64_p, i64_p,
+    ]
+    lib.sf_traj_stats.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def traj_stats_native(ts, x, y, oid, num_oids: int, size_ms: int,
+                      slide_ms: int):
+    """Single-pass pane-decomposed sliding trajectory stats
+    (sf_traj_stats) — the native engine behind
+    streams/panes.py:traj_stats_sliding. ``ts`` must be ascending.
+    Returns (n_starts, spatial, temporal, count) as full
+    (n_starts, num_oids) matrices, or None when the library is
+    unavailable. Bit-identical to the numpy path (same float association
+    order; tests/test_native.py)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ts = np.ascontiguousarray(ts, np.int64)
+    x = np.ascontiguousarray(x, np.float64)
+    y = np.ascontiguousarray(y, np.float64)
+    oid32 = np.ascontiguousarray(oid, np.int32)
+    n = len(ts)
+    ppw = size_ms // slide_ms
+    if n == 0:
+        return 0, *(np.zeros((0, num_oids), d)
+                    for d in (np.float64, np.int64, np.int64))
+    p_lo = int(np.floor_divide(int(ts[0]), slide_ms))
+    p_hi = int(np.floor_divide(int(ts[-1]), slide_ms))
+    n_starts = (p_hi - p_lo + 1) + ppw - 1
+    spatial = np.empty((n_starts, num_oids), np.float64)
+    temporal = np.empty((n_starts, num_oids), np.int64)
+    count = np.empty((n_starts, num_oids), np.int64)
+    rc = lib.sf_traj_stats(
+        ts, x, y, oid32, n, num_oids, size_ms, slide_ms,
+        spatial.reshape(-1), temporal.reshape(-1), count.reshape(-1),
+    )
+    if rc < 0:
+        raise ValueError(f"oid out of [0, {num_oids}) in traj_stats_native")
+    assert rc == n_starts
+    return n_starts, spatial, temporal, count
 
 
 class _NativeInternerParser:

@@ -97,6 +97,46 @@ class SpatialOperator:
         else:
             yield from self._assembler().stream(stream)
 
+    def _checkpointable_windows(self, stream, flush_at_end: bool = True):
+        """Event-time windows with checkpoint hooks — the single home of
+        the pane-carry assembler plumbing (kNN/join query_panes):
+
+        - the assembler is exposed as ``self.checkpoint_assembler``
+          (snapshotted by checkpoint.operator_state);
+        - a state restored by checkpoint.restore_operator is consumed
+          before the first event;
+        - ``flush_at_end=False`` treats end-of-source as a KILL point
+          (open windows stay buffered for the resumed run) instead of
+          end-of-stream.
+        """
+        asm = self._assembler()
+        if getattr(self, "_restored_assembler", None):
+            from spatialflink_tpu.checkpoint import restore_assembler
+
+            restore_assembler(asm, self._restored_assembler)
+            self._restored_assembler = None
+        self.checkpoint_assembler = asm
+        for ev in stream:
+            yield from asm.feed(ev)
+        if flush_at_end:
+            yield from asm.flush()
+
+    def _checkpointable_soa_windows(self, asm, chunks,
+                                    flush_at_end: bool = True):
+        """SoA twin of ``_checkpointable_windows`` (caller supplies the
+        soa.py assembler; point and ragged both snapshot through
+        checkpoint.soa_assembler_state)."""
+        if getattr(self, "_restored_soa_assembler", None):
+            from spatialflink_tpu.checkpoint import restore_soa_assembler
+
+            restore_soa_assembler(asm, self._restored_soa_assembler)
+            self._restored_soa_assembler = None
+        self.checkpoint_soa_assembler = asm
+        for chunk in chunks:
+            yield from asm.feed(chunk)
+        if flush_at_end:
+            yield from asm.flush()
+
     # -- batch building -------------------------------------------------------
 
     def point_batch(self, events: Sequence[Point]) -> PointBatch:

@@ -312,6 +312,7 @@ class PointPointJoinQuery(SpatialOperator):
         query_stream: Iterable[Point],
         radius: float,
         dtype=np.float64,
+        flush_at_end: bool = True,
     ) -> Iterator[JoinWindowResult]:
         """Incremental sliding-window join via pane-block carry.
 
@@ -350,10 +351,15 @@ class PointPointJoinQuery(SpatialOperator):
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
         )
         offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
-        panes: dict = {}  # ps → (left_ev, right_ev, lb|None, rb|None)
-        blocks: dict = {}  # (p, q) → (pairs list, overflow)
+        # Operator-owned, checkpointable carry (checkpoint.py): pane event
+        # lists + computed pair blocks — the join's ListState analog. One
+        # logical stream pair per operator instance.
+        if getattr(self, "_join_pane_carry", None) is None:
+            self._join_pane_carry = {"panes": {}, "blocks": {}}
+        panes: dict = self._join_pane_carry["panes"]
+        blocks: dict = self._join_pane_carry["blocks"]
 
-        for win in self.windows(merged):
+        for win in self._checkpointable_windows(merged, flush_at_end):
             starts = list(range(win.start, win.end, slide))
             fresh = {ps for ps in starts if ps not in panes}
             if fresh:
@@ -524,21 +530,30 @@ class _PrunedGeomJoinRetry:
     def _pruned_block_pairs(self, call, m_cap: int):
         """call(cand, max_pairs) → CompactJoinResult; returns host
         (left_idx, right_idx, dist) with exactness guaranteed (retries
-        until overflow == 0 — at cand == m_cap the prune is a no-op)."""
+        until overflow == 0 — at cand == m_cap the prune is a no-op).
+        Handles both the single-device result (scalar count) and the
+        sharded one (per-shard count vector; max_pairs is per shard)."""
         while True:
             cand = min(self._cand, m_cap)
             res = call(cand, self._geom_max_pairs)
-            count = int(res.count)
-            if count > self._geom_max_pairs:
-                self._geom_max_pairs = int(2 ** np.ceil(np.log2(count)))
+            counts = np.asarray(res.count)
+            worst = int(counts.max()) if counts.ndim else int(counts)
+            if worst > self._geom_max_pairs:
+                self._geom_max_pairs = int(2 ** np.ceil(np.log2(worst)))
                 continue
             if int(res.overflow) > 0 and cand < m_cap:
                 self._cand = min(self._cand * 2, m_cap)
                 continue
             break
-        li = np.asarray(res.left_index)[:count]
-        ri = np.asarray(res.right_index)[:count]
-        dd = np.asarray(res.dist)[:count]
+        if counts.ndim:  # sharded: -1-padded per-shard segments, no slice
+            li = np.asarray(res.left_index)
+            ri = np.asarray(res.right_index)
+            dd = np.asarray(res.dist)
+        else:
+            count = int(counts)
+            li = np.asarray(res.left_index)[:count]
+            ri = np.asarray(res.right_index)[:count]
+            dd = np.asarray(res.dist)[:count]
         keep = li >= 0
         return li[keep], ri[keep], dd[keep]
 
@@ -566,7 +581,9 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         query_stream: Iterable[Polygon | LineString],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[JoinWindowResult]:
+        mesh = mesh if mesh is not None else self.mesh
         merged = (
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
@@ -587,6 +604,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
 
             # Locality sort HOST-side (numpy ~1 ms vs 13 ms device argsort
             # at 131k on v5e); kernel indices map back through ho.
+            # Contiguous sharding of the sorted points preserves locality.
             ho = np.argsort(lb.cell, kind="stable")
             args = (
                 jnp.asarray(center_coords(self.grid, lb.xy[ho], dtype)),
@@ -596,13 +614,24 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 jnp.asarray(gb.valid),
                 jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype)),
             )
-            li, ri, dd = self._pruned_block_pairs(
-                lambda cand, mp: kernel(
-                    *args, radius, polygonal=self.polygonal,
-                    block=self._point_block, cand=cand, max_pairs=mp,
-                ),
-                gb.capacity,
-            )
+            if mesh is not None:
+                from spatialflink_tpu.parallel.sharded import (
+                    sharded_point_geometry_join_pruned,
+                )
+
+                def call(cand, mp):
+                    return sharded_point_geometry_join_pruned(
+                        mesh, *args, radius, polygonal=self.polygonal,
+                        block=self._point_block, cand=cand, max_pairs=mp,
+                    )
+            else:
+                def call(cand, mp):
+                    return kernel(
+                        *args, radius, polygonal=self.polygonal,
+                        block=self._point_block, cand=cand, max_pairs=mp,
+                    )
+
+            li, ri, dd = self._pruned_block_pairs(call, gb.capacity)
             pairs = [
                 (left_ev[int(ho[int(a)])], right_ev[int(b)], float(d))
                 for a, b, d in zip(li, ri, dd)
@@ -698,9 +727,11 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
     right_polygonal = True
     _geom_block = 32
 
-    def _window_pairs(self, kernel, la, ra, radius, dtype):
+    def _window_pairs(self, kernel, la, ra, radius, dtype, mesh=None):
         """Host locality sort of the left side (quantized bbox centers) +
-        pruned kernel with presorted=True; returns ORIGINAL-index pairs."""
+        pruned kernel; returns ORIGINAL-index pairs. With ``mesh``, the
+        sorted left side shards contiguously over ``data`` (locality
+        preserved), the right side replicates."""
         cx = (la.bbox[:, 0] + la.bbox[:, 2]) * 0.5
         cy = (la.bbox[:, 1] + la.bbox[:, 3]) * 0.5
         with np.errstate(invalid="ignore", divide="ignore"):
@@ -726,15 +757,28 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             jnp.asarray(ra.valid),
             jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype)),
         )
-        li, ri, dd = self._pruned_block_pairs(
-            lambda cand, mp: kernel(
-                *args, radius,
-                a_polygonal=self.left_polygonal,
-                b_polygonal=self.right_polygonal,
-                block=self._geom_block, cand=cand, max_pairs=mp,
-            ),
-            ra.capacity,
-        )
+        if mesh is not None:
+            from spatialflink_tpu.parallel.sharded import (
+                sharded_geometry_geometry_join_pruned,
+            )
+
+            def call(cand, mp):
+                return sharded_geometry_geometry_join_pruned(
+                    mesh, *args, radius,
+                    a_polygonal=self.left_polygonal,
+                    b_polygonal=self.right_polygonal,
+                    block=self._geom_block, cand=cand, max_pairs=mp,
+                )
+        else:
+            def call(cand, mp):
+                return kernel(
+                    *args, radius,
+                    a_polygonal=self.left_polygonal,
+                    b_polygonal=self.right_polygonal,
+                    block=self._geom_block, cand=cand, max_pairs=mp,
+                )
+
+        li, ri, dd = self._pruned_block_pairs(call, ra.capacity)
         return ho[li].astype(np.int32), ri, dd
 
     def run(
@@ -743,7 +787,9 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         query_stream: Iterable[Polygon | LineString],
         radius: float,
         dtype=np.float64,
+        mesh=None,
     ) -> Iterator[JoinWindowResult]:
+        mesh = mesh if mesh is not None else self.mesh
         merged = (
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
@@ -760,7 +806,8 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 continue
             la = self.geometry_batch(left_ev)
             ra = self.geometry_batch(right_ev)
-            li, ri, dd = self._window_pairs(kernel, la, ra, radius, dtype)
+            li, ri, dd = self._window_pairs(kernel, la, ra, radius, dtype,
+                                            mesh=mesh)
             pairs = [
                 (left_ev[int(a)], right_ev[int(b)], float(d))
                 for a, b, d in zip(li, ri, dd)
@@ -820,9 +867,11 @@ class PolygonPointJoinQuery(_PointGeometryJoinQuery):
 
     polygonal = True
 
-    def run(self, ordinary, query_stream, radius, dtype=np.float64):
+    def run(self, ordinary, query_stream, radius, dtype=np.float64,
+            mesh=None):
         # Reference semantics: ordinary = polygons, query = points.
-        for res in super().run(query_stream, ordinary, radius, dtype=dtype):
+        for res in super().run(query_stream, ordinary, radius, dtype=dtype,
+                               mesh=mesh):
             res.pairs = [(b, a, d) for (a, b, d) in res.pairs]
             yield res
 

@@ -136,6 +136,7 @@ class _PointStreamKNNQuery(SpatialOperator):
         radius: float,
         k: int,
         dtype=np.float64,
+        flush_at_end: bool = True,
     ) -> Iterator[KnnWindowResult]:
         """Incremental sliding-window kNN via pane-digest carry.
 
@@ -200,11 +201,17 @@ class _PointStreamKNNQuery(SpatialOperator):
         int_big = np.iinfo(np.int32).max
         zero = np.int32(0)
 
-        # pane start → (nseg, seg_min_dev, rep_dev, events) | None (empty).
+        # pane start → (nseg, seg_min, rep, events) | None (empty).
         # Digests hold pane-LOCAL representative indices; window-local base
         # offsets are applied inside the jitted merge, so carried indices
         # never grow with the stream (unbounded-stream-safe).
-        panes: dict = {}
+        # The dict is OPERATOR-OWNED state — the pane-carry analog of the
+        # reference's ListState (range/PointPointRangeQuery.java:234-246) —
+        # so checkpoint.py can snapshot/restore it (with the window
+        # assembler below); one logical stream per operator instance.
+        if getattr(self, "_pane_carry", None) is None:
+            self._pane_carry = {}
+        panes: dict = self._pane_carry
         empties: dict = {}  # nseg → cached empty digest (one-time device op)
 
         def empty_digest(nseg):
@@ -233,7 +240,7 @@ class _PointStreamKNNQuery(SpatialOperator):
                 evs,
             )
 
-        for win in self.windows(stream):
+        for win in self._checkpointable_windows(stream, flush_at_end):
             starts = range(win.start, win.end, slide)
             for ps in starts:
                 if ps in panes:
@@ -436,6 +443,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         k: int,
         num_segments: int,
         dtype=np.float64,
+        flush_at_end: bool = True,
     ):
         """SoA pane-digest carry: ``run_soa``'s contract (yields
         (start, end, oids, dists, num_valid) per window) at O(pane) device
@@ -468,10 +476,14 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         ppw = size // slide
         no_bases = np.zeros(ppw, np.int32)  # indices unused by this yield
 
-        panes: dict = {}  # pane start → (seg_min, rep) | None (empty pane)
+        # Operator-owned, checkpointable — see query_panes.
+        if getattr(self, "_pane_carry_soa", None) is None:
+            self._pane_carry_soa = {}
+        panes: dict = self._pane_carry_soa
         emt = None
         asm = SoaWindowAssembler(size, slide, ooo_ms=0)
-        for win in asm.stream(chunks):
+        for win in self._checkpointable_soa_windows(asm, chunks,
+                                                    flush_at_end):
             ts = np.asarray(win.arrays["ts"], np.int64)
             for ps in range(win.start, win.end, slide):
                 if ps in panes:

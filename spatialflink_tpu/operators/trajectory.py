@@ -336,19 +336,23 @@ class TJoinQuery(SpatialOperator):
                 self._max_tpairs = int(2 ** np.ceil(np.log2(int(tp.count))))
             lgroups = group_by_oid(left_ev)
             rgroups = group_by_oid(right_ev)
+            # Vectorized pair decode — the dedup'd pair list is the only
+            # thing that crosses into Python (no per-point-pair loop).
             keys = np.asarray(tp.pair_key)
-            dists = np.asarray(tp.dist)
-            found: List[Tuple[str, str, float]] = []
-            for pk, d in zip(keys, dists):
-                if pk < 0:
-                    continue
-                a = self.interner.lookup(int(l_uniq[pk // num_r]))
-                b = self.interner.lookup(int(r_uniq[pk % num_r]))
-                found.append((a, b, float(d)))
+            hit = keys >= 0
+            kk = keys[hit]
+            l_ids = l_uniq[kk // num_r]
+            r_ids = r_uniq[kk % num_r]
+            dists = np.asarray(tp.dist)[hit]
+            found: List[Tuple[str, str, float]] = sorted(
+                (self.interner.lookup(int(a)), self.interner.lookup(int(b)),
+                 float(d))
+                for a, b, d in zip(l_ids, r_ids, dists)
+            )
             pairs = [
                 (sub_trajectory(lgroups[a], a, win.start),
                  sub_trajectory(rgroups[b], b, win.start), d)
-                for a, b, d in sorted(found)
+                for a, b, d in found
             ]
             yield TJoinResult(win.start, win.end, pairs, len(win.events))
 
@@ -360,6 +364,113 @@ class TJoinQuery(SpatialOperator):
                 (a, b, d) for a, b, d in res.pairs if a.obj_id != b.obj_id
             ]
             yield res
+
+    def run_soa(
+        self,
+        left_chunks,
+        right_chunks,
+        radius: float,
+        num_segments: int,
+        max_pairs: int = 262_144,
+        dtype=np.float64,
+    ):
+        """SoA fast path for tJoin: two point chunk streams
+        {"ts","x","y","oid"} (dense int32 oids in [0, num_segments)) →
+        per-window RAW trajectory-pair arrays
+        (start, end, left_oids, right_oids, min_dists, count, overflow) —
+        the reference's windowBased tJoin
+        (tJoin/PointPointTJoinQuery.java:183+) with zero per-point-pair
+        Python: grid-hash point join and per-trajectory-pair min-distance
+        dedup both run on device (ops/trajectory.py:
+        traj_pair_dedup_kernel); the host only relabels window-local
+        trajectory ranks (one vectorized np.unique per side) and decodes
+        the dedup'd pair list. Exact iff ``overflow == 0`` (per-cell cap,
+        same contract as run()). Windows align on the shared slide grid;
+        one-sided windows yield zero pairs."""
+        from spatialflink_tpu.operators.base import (
+            check_oid_range,
+            soa_point_batches,
+        )
+        from spatialflink_tpu.operators.join_query import _aligned_soa_windows
+        from spatialflink_tpu.ops.join import (
+            join_window_bucketed,
+            pallas_join_supported,
+        )
+        from spatialflink_tpu.utils.padding import next_bucket as _nb
+
+        def kernel_for(budget):
+            if pallas_join_supported():
+                from spatialflink_tpu.ops.pallas_join import (
+                    PALLAS_JOIN_MAX_PAIRS,
+                    join_window_pallas,
+                )
+
+                if budget <= PALLAS_JOIN_MAX_PAIRS:
+                    return join_window_pallas
+            return jitted(
+                join_window_bucketed,
+                "grid_n", "layers", "cap_left", "cap_right", "max_pairs",
+            )
+
+        dedup = jitted(
+            traj_pair_dedup_kernel, "num_left", "num_right", "max_tpairs"
+        )
+        layers = self.grid.candidate_layers(radius)
+        gen_l = soa_point_batches(self.grid, left_chunks, self.conf, dtype)
+        gen_r = soa_point_batches(self.grid, right_chunks, self.conf, dtype)
+        budget = max_pairs
+        empty = (np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0))
+        for kind, wl, wr in _aligned_soa_windows(
+            gen_l, gen_r, lambda w: w[0].start, lambda w: w[0].start
+        ):
+            if kind != "both":
+                w = wl[0] if kind == "left" else wr[0]
+                yield (w.start, w.end, *empty, 0, 0)
+                continue
+            win, lxy, lvalid, lcell, loid = wl
+            rwin, rxy, rvalid, rcell, roid = wr
+            check_oid_range(loid[:win.count], num_segments)
+            check_oid_range(roid[:rwin.count], num_segments)
+            # Window-local dense trajectory ranks (vectorized host).
+            l_uniq, l_inv = np.unique(loid[:win.count], return_inverse=True)
+            r_uniq, r_inv = np.unique(roid[:rwin.count], return_inverse=True)
+            l_loc = np.zeros(len(loid), np.int32)
+            l_loc[:win.count] = l_inv
+            r_loc = np.zeros(len(roid), np.int32)
+            r_loc[:rwin.count] = r_inv
+            num_l = int(_nb(max(len(l_uniq), 1), minimum=16))
+            num_r = int(_nb(max(len(r_uniq), 1), minimum=16))
+            while True:
+                fn = kernel_for(budget)
+                res = fn(
+                    jnp.asarray(lxy), jnp.asarray(lvalid), jnp.asarray(lcell),
+                    jnp.asarray(rxy), jnp.asarray(rvalid), jnp.asarray(rcell),
+                    grid_n=self.grid.n, layers=layers, radius=radius,
+                    cap_left=self.cap, cap_right=self.cap, max_pairs=budget,
+                )
+                if int(res.count) <= budget:
+                    break
+                budget = int(2 ** np.ceil(np.log2(int(res.count))))
+            while True:
+                tp = dedup(
+                    res.left_index, res.right_index, res.dist,
+                    jnp.asarray(l_loc), jnp.asarray(r_loc),
+                    num_left=num_l, num_right=num_r,
+                    max_tpairs=self._max_tpairs,
+                )
+                if int(tp.count) <= self._max_tpairs:
+                    break
+                self._max_tpairs = int(2 ** np.ceil(np.log2(int(tp.count))))
+            keys = np.asarray(tp.pair_key)
+            hit = keys >= 0
+            kk = keys[hit]
+            yield (
+                win.start, win.end,
+                l_uniq[kk // num_r].astype(np.int32),
+                r_uniq[kk % num_r].astype(np.int32),
+                np.asarray(tp.dist)[hit],
+                int(hit.sum()), int(res.overflow),
+            )
 
 
 class PointPointTJoinQuery(TJoinQuery):

@@ -493,3 +493,106 @@ def sharded_join(
         right_xy_sorted, right_valid_sorted, right_cells_sorted, right_order,
         neighbor_offsets,
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_pg_join(mesh: Mesh, polygonal: bool, block: int,
+                            cand: int, max_pairs: int):
+    from spatialflink_tpu.ops.join import (
+        CompactJoinResult,
+        point_geometry_join_pruned_kernel,
+    )
+
+    def local(pxy, pvalid, gverts, gev, gvalid, gbbox, radius):
+        res = point_geometry_join_pruned_kernel(
+            pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
+            polygonal=polygonal, block=block, cand=cand,
+            max_pairs=max_pairs,
+        )
+        base = jax.lax.axis_index("data") * pxy.shape[0]
+        left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
+        return CompactJoinResult(
+            left, res.right_index, res.dist,
+            res.count[None],  # (1,) per shard → (n_shards,) stacked
+            jax.lax.psum(res.overflow, "data"),
+        )
+
+    return jax.jit(shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P(), P(), P(), P(), P()),
+        out_specs=CompactJoinResult(
+            P("data"), P("data"), P("data"), P("data"), P()
+        ),
+        check_vma=False,
+    ))
+
+
+def sharded_point_geometry_join_pruned(
+    mesh: Mesh,
+    pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
+    polygonal: bool, block: int, cand: int, max_pairs: int,
+):
+    """Multi-chip grid-pruned point ⋈ geometry join: the (host-locality-
+    sorted) point side shards over ``data``, the geometry batch
+    replicates; each shard runs point_geometry_join_pruned_kernel on its
+    contiguous slice (sorted order is preserved by contiguous sharding,
+    so tile locality survives) and compacts its own pairs.
+
+    ``left_index`` entries are global input positions; ``count`` comes
+    back as a per-shard (n_shards,) vector (``max_pairs`` is PER SHARD —
+    a shard truncates when its own count exceeds it); ``overflow`` is
+    psum-replicated. Bit-parity with single-device up to pair order
+    (tests/test_parallel_operators.py)."""
+    return _cached_sharded_pg_join(mesh, polygonal, block, cand, max_pairs)(
+        pxy, pvalid, gverts, gev, gvalid, gbbox, radius
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
+                            block: int, cand: int, max_pairs: int):
+    from spatialflink_tpu.ops.join import (
+        CompactJoinResult,
+        geometry_geometry_join_pruned_kernel,
+    )
+
+    def local(averts, aev, avalid, abbox, bverts, bev, bvalid, bbox, radius):
+        res = geometry_geometry_join_pruned_kernel(
+            averts, aev, avalid, abbox, bverts, bev, bvalid, bbox, radius,
+            a_polygonal=a_polygonal, b_polygonal=b_polygonal,
+            block=block, cand=cand, max_pairs=max_pairs,
+        )
+        base = jax.lax.axis_index("data") * averts.shape[0]
+        left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
+        return CompactJoinResult(
+            left, res.right_index, res.dist, res.count[None],
+            jax.lax.psum(res.overflow, "data"),
+        )
+
+    return jax.jit(shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("data"), P("data"), P("data"), P("data"),
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=CompactJoinResult(
+            P("data"), P("data"), P("data"), P("data"), P()
+        ),
+        check_vma=False,
+    ))
+
+
+def sharded_geometry_geometry_join_pruned(
+    mesh: Mesh,
+    averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius,
+    a_polygonal: bool, b_polygonal: bool,
+    block: int, cand: int, max_pairs: int,
+):
+    """Multi-chip grid-pruned geometry ⋈ geometry join — left side (host-
+    locality-sorted) sharded over ``data``, right side replicated; same
+    contracts as sharded_point_geometry_join_pruned."""
+    return _cached_sharded_gg_join(
+        mesh, a_polygonal, b_polygonal, block, cand, max_pairs
+    )(averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius)

@@ -213,7 +213,44 @@ def traj_stats_sliding(
             empty.astype(np.int64), _size_ms=size_ms,
         )
 
-    if len(ts) > 1 and bool(np.all(ts[1:] >= ts[:-1])):
+    ts_sorted = len(ts) <= 1 or bool(np.all(ts[1:] >= ts[:-1]))
+
+    # Native single-pass engine (native/sfnative.cpp:sf_traj_stats):
+    # counting sort + segment binning + prefix-sum windows fused per
+    # trajectory, cache-resident — bit-identical to the numpy path below
+    # (same float association order; parity test tests/test_native.py).
+    try:
+        from spatialflink_tpu import native as _native
+
+        native_ok = _native.available()
+    except Exception:  # pragma: no cover - import/build failure
+        native_ok = False
+    if native_ok:
+        if ts_sorted:
+            ts_s, xy_s, oid_s = ts, xy, oid
+        else:
+            order = np.argsort(ts, kind="stable")
+            ts_s, xy_s, oid_s = ts[order], xy[order], oid[order]
+        out = _native.traj_stats_native(
+            ts_s, xy_s[:, 0], xy_s[:, 1], oid_s, num_oids, size_ms,
+            slide_ms,
+        )
+        if out is not None:
+            n_starts, w_d, w_dt, w_cnt = out
+            p_lo = int(np.floor_divide(int(ts_s[0]), slide_ms))
+            alive = w_cnt.sum(axis=1) > 0
+            starts = (
+                (np.arange(n_starts) + p_lo - (ppw - 1)) * slide_ms
+            )[alive]
+            return TrajPaneWindows(
+                starts=starts.astype(np.int64),
+                spatial=w_d[alive],
+                temporal=w_dt[alive],
+                count=w_cnt[alive],
+                _size_ms=size_ms,
+            )
+
+    if ts_sorted:
         # Stream order is usually ts-sorted already: a stable radix sort
         # on oid alone preserves the ts order within each trajectory —
         # ~2× cheaper than the general two-key lexsort.

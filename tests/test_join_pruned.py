@@ -206,6 +206,50 @@ def test_linestring_linestring_pruned_matches_dense(rng):
     assert got == expect
 
 
+def test_point_polygon_mesh_matches_single(rng):
+    """mesh= shards the locality-sorted point side contiguously; pair set
+    must equal single-device (the pruned kernel runs per shard)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    assert devs.size == 8
+    mesh = Mesh(devs.reshape(8), ("data",))
+    pts = _points(rng, 4_000)
+    polys = _polygons(rng, 100)
+    r = 0.15
+
+    def run(m):
+        return _op_pairs(
+            PointPolygonJoinQuery(W, GRID).run(iter(pts), iter(polys), r,
+                                               mesh=m)
+        )
+
+    single = run(None)
+    sharded = run(mesh)
+    assert single == sharded
+    assert single
+
+
+def test_polygon_polygon_mesh_matches_single(rng):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(8), ("data",))
+    left = _polygons(rng, 120, size=0.3)
+    right = _polygons(np.random.default_rng(13), 80, size=0.3)
+    r = 0.2
+
+    def run(m):
+        return _op_pairs(
+            PolygonPolygonJoinQuery(W, GRID).run(iter(left), iter(right), r,
+                                                 mesh=m)
+        )
+
+    assert run(None) == run(mesh)
+
+
 def _point_chunks(pts, chunk=500):
     for lo in range(0, len(pts), chunk):
         sl = pts[lo:lo + chunk]

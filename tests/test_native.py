@@ -266,3 +266,46 @@ def test_wkt_holes_through_geometry_soa_pipeline(rng):
     # The donut's hole keeps the query point OUT: dist = 1.0 to the hole
     # ring, not 0 (containment would make it 0).
     assert obj_res[0] == [("donut", 1.0)]
+
+
+def test_traj_stats_native_bit_identical_to_numpy(rng):
+    """sf_traj_stats must reproduce the numpy pane path BIT-FOR-BIT
+    (same float association order), sorted and unsorted inputs, including
+    the start-boundary corrections."""
+    import unittest.mock as mock
+
+    import spatialflink_tpu.native as native
+    from spatialflink_tpu.streams import panes
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    n = 60_000
+    ts = np.sort(rng.integers(0, 12_000, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    oid = rng.integers(0, 65, n).astype(np.int64)
+
+    for shuffle in (False, True):
+        if shuffle:
+            perm = rng.permutation(n)
+            t_in, xy_in, o_in = ts[perm], xy[perm], oid[perm]
+        else:
+            t_in, xy_in, o_in = ts, xy, oid
+        got = panes.traj_stats_sliding(t_in, xy_in, o_in, 128, 3_000, 10)
+        with mock.patch.object(native, "available", lambda: False):
+            ref = panes.traj_stats_sliding(t_in, xy_in, o_in, 128, 3_000, 10)
+        assert np.array_equal(got.starts, ref.starts)
+        assert np.array_equal(got.count, ref.count)
+        assert np.array_equal(got.temporal, ref.temporal)
+        assert np.array_equal(got.spatial, ref.spatial)  # bitwise
+
+
+def test_traj_stats_native_rejects_out_of_range_oid(rng):
+    import spatialflink_tpu.native as native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    with pytest.raises(ValueError, match="oid out of"):
+        native.traj_stats_native(
+            np.asarray([0, 10], np.int64), np.zeros(2), np.zeros(2),
+            np.asarray([0, 99], np.int32), 8, 1_000, 100,
+        )

@@ -164,6 +164,45 @@ def test_tjoin_device_dedup_matches_bruteforce(rng):
     assert any(res.pairs for res in results)
 
 
+def test_tjoin_run_soa_matches_object_path(rng):
+    """run_soa's raw (left_oid, right_oid, min_dist) arrays == the object
+    path's dedup'd pair set per window, through sliding windows — the
+    round-2 gap: tJoin was the one trajectory operator with no SoA path."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
+                              slide_step=5)
+    lts, lxs, lys, loids = _stream(rng, 6_000, n_obj=8)
+    rng2 = np.random.default_rng(5)
+    rts, rxs, rys, roids = _stream(rng2, 5_000, n_obj=6)
+    r = 0.3
+    left = _points(lts, lxs, lys, loids)
+    right = _points(rts, rxs, rys, roids)
+
+    obj = {}
+    for res in TJoinQuery(conf, GRID, cap=256).run(iter(left), iter(right), r):
+        obj[(res.start, res.end)] = {
+            (a.obj_id, b.obj_id, round(d, 9)) for a, b, d in res.pairs
+        }
+
+    soa = {}
+    for start, end, lo, ro, dd, count, overflow in TJoinQuery(
+        conf, GRID, cap=256
+    ).run_soa(
+        _chunks(lts, lxs, lys, loids), _chunks(rts, rxs, rys, roids), r,
+        num_segments=16,
+    ):
+        assert overflow == 0
+        soa[(start, end)] = {
+            (str(int(a)), str(int(b)), round(float(d), 9))
+            for a, b, d in zip(lo, ro, dd)
+        }
+    # The object path skips windows where one side is empty only if BOTH
+    # generators agree; compare on the union of spans with pairs.
+    spans = set(obj) | set(soa)
+    for span in spans:
+        assert obj.get(span, set()) == soa.get(span, set()), span
+    assert any(soa.values())
+
+
 def test_traj_stats_sliding_matches_operator(rng):
     """Pane-decomposed tStats (10s/2s, 5x overlap) == the operator's
     per-window recompute, including start-boundary segment truncation."""
