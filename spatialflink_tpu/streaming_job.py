@@ -45,9 +45,49 @@ from spatialflink_tpu.streams.sources import (
 
 def build_source(params: Params, source_arg: str) -> Iterator[Point]:
     """``--source`` forms: ``csv:<path>``, ``geojson:<path>``,
-    ``socket:<host>:<port>``, ``synthetic[:eps[:seconds]]``."""
+    ``socket:<host>:<port>``, ``synthetic[:eps[:seconds]]``, or
+    ``kafka[:<topic>[@<bootstrap>]]`` — the reference's DEFAULT transport
+    (StreamingJob.java:188-191), consumed through the built-in wire
+    client (streams/kafka_wire.py); topic/bootstrap default to the yml's
+    ``inputStream1.topicName`` / ``kafkaBootStrapServers``, the record
+    format to ``inputStream1.format``."""
     sc = params.input_stream1
     kind, _, rest = source_arg.partition(":")
+    if kind == "kafka":
+        from spatialflink_tpu.streams.kafka import kafka_source
+
+        topic, _, bootstrap = rest.partition("@")
+        topic = topic or sc.topic_name
+        bootstrap = bootstrap or params.kafka_bootstrap_servers
+        if not topic or not bootstrap:
+            raise ValueError(
+                "kafka source needs a topic and bootstrap servers (CLI "
+                "kafka:<topic>@<bootstrap> or yml inputStream1.topicName "
+                "+ kafkaBootStrapServers)"
+            )
+        if sc.format == "GeoJSON":
+            def parse(line):
+                return parse_geojson(
+                    line,
+                    timestamp_property=sc.geojson_schema_attr[1],
+                    objid_property=sc.geojson_schema_attr[0],
+                    date_format=sc.date_format,
+                )
+        elif sc.format in ("CSV", "TSV"):
+            def parse(line):
+                return parse_csv_point(
+                    line, schema=sc.csv_tsv_schema_attr,
+                    delimiter=sc.delimiter, date_format=sc.date_format,
+                )
+        else:
+            # Fail up front: kafka_source silently skips unparseable
+            # records, so a wrong parser would hang forever with zero
+            # output instead of erroring.
+            raise ValueError(
+                f"kafka source supports GeoJSON/CSV/TSV records for point "
+                f"streams, not inputStream1.format={sc.format!r}"
+            )
+        return kafka_source(topic, bootstrap, parse)
     if kind == "csv":
         return csv_source(
             rest,
@@ -202,14 +242,48 @@ def main(argv=None) -> int:
     ap.add_argument("--config", required=True, help="geoflink-conf.yml path")
     ap.add_argument(
         "--source", default="synthetic",
-        help="csv:<path> | geojson:<path> | socket:<host>:<port> | synthetic[:eps[:secs]]",
+        help="csv:<path> | geojson:<path> | socket:<host>:<port> | "
+             "synthetic[:eps[:secs]] | kafka[:<topic>[@<bootstrap>]]",
     )
-    ap.add_argument("--output", default=None, help="output CSV path (default stdout)")
+    ap.add_argument(
+        "--output", default=None,
+        help="output CSV path, or kafka[:<topic>[@<bootstrap>]] (the "
+             "reference's producer side, StreamingJob.java:255; defaults "
+             "from the yml's outputStream); default stdout",
+    )
+    ap.add_argument(
+        "--max-records", type=int, default=None,
+        help="stop after N input records (unbounded sources like kafka/"
+             "socket run forever otherwise)",
+    )
     args = ap.parse_args(argv)
 
     params = Params.load(args.config)
     source = build_source(params, args.source)
-    if args.output:
+    if args.max_records is not None:
+        import itertools
+
+        source = itertools.islice(source, args.max_records)
+    if args.output and (args.output == "kafka"
+                        or args.output.startswith("kafka:")):
+        from spatialflink_tpu.streams.kafka import KafkaSink
+
+        rest = args.output.partition(":")[2]
+        topic, _, bootstrap = rest.partition("@")
+        topic = topic or params.output_topic
+        bootstrap = bootstrap or params.kafka_bootstrap_servers
+        if not topic or not bootstrap:
+            raise ValueError(
+                "kafka output needs a topic and bootstrap servers (CLI "
+                "kafka:<topic>@<bootstrap> or yml outputStream.topicName "
+                "+ kafkaBootStrapServers)"
+            )
+        sink = KafkaSink(topic, bootstrap)
+        try:
+            n = run_job(params, source, sink)
+        finally:
+            sink.close()
+    elif args.output:
         with CsvFileSink(args.output) as sink:
             n = run_job(params, source, sink)
     else:

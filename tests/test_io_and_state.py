@@ -1,5 +1,7 @@
 """Shapefile round-trip, StreamingJob CLI, checkpoint/resume, helpers."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -236,3 +238,76 @@ def test_checkpoint_restores_round1_agg_format(tmp_path):
     np.testing.assert_array_equal(op2._skeys, op._skeys)
     np.testing.assert_array_equal(op2._smin, op._smin)
     np.testing.assert_array_equal(op2._smax, op._smax)
+
+
+def test_streaming_job_cli_kafka_to_kafka(tmp_path, monkeypatch):
+    """End to end through the reference's DEFAULT transport: CSV records
+    produced to a broker topic → --source kafka → windowed range query →
+    --output kafka → results fetched back from the output topic. Runs the
+    REAL wire protocol over a real socket (tests/test_kafka_wire.py's
+    broker), not a monkeypatched client."""
+    import builtins
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(__file__))
+    from test_kafka_wire import FakeBroker
+
+    from spatialflink_tpu.streaming_job import main
+    from spatialflink_tpu.streams import kafka_wire as kw
+
+    real_import = builtins.__import__
+
+    def no_libs(name, *a, **k):
+        if name in ("kafka", "confluent_kafka"):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_libs)
+
+    broker = FakeBroker()
+    bs = f"127.0.0.1:{broker.port}"
+    try:
+        producer = kw.KafkaWireClient(bs)
+        lines = []
+        for i in range(100):
+            x, y = (5.0, 5.0) if i % 4 == 0 else (0.5 + (i % 9), 0.5)
+            lines.append((f"d{i % 7},{i * 100},{x},{y}".encode(), None, 0))
+        producer.produce("gps-in", 0, lines)
+        producer.close()
+
+        conf = tmp_path / "conf.yml"
+        conf.write_text(
+            """
+inputStream1:
+  topicName: gps-in
+  format: CSV
+  csvTsvSchemaAttr: [0, 1, 2, 3]
+  gridBBox: [0.0, 0.0, 10.0, 10.0]
+  numGridCells: 20
+  delimiter: ","
+outputStream:
+  topicName: results-out
+kafkaBootStrapServers: "%s"
+query:
+  option: 1
+  radius: 2.0
+  k: 3
+  queryPoints:
+    - [5.0, 5.0]
+window:
+  type: "TIME"
+  interval: 10
+  step: 10
+""" % bs
+        )
+        rc = main([
+            "--config", str(conf), "--source", "kafka",
+            "--output", "kafka", "--max-records", "100",
+        ])
+        assert rc == 0
+        consumer = kw.KafkaWireClient(bs)
+        msgs, _ = consumer.fetch("results-out", 0, 0)
+        consumer.close()
+        assert len(msgs) == 25  # every 4th point sits on the query point
+    finally:
+        broker.close()

@@ -32,7 +32,8 @@ def _pane(rng, n, grid, spread=10.0):
     return xy, valid, cell, oid
 
 
-def _digests(grid, xy, valid, cell, oid, q, radius, flags, cand):
+def _digests(grid, xy, valid, cell, oid, q, radius, flags, cand,
+             selection="auto"):
     args = (
         jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
         None if flags is None else jnp.asarray(flags),
@@ -47,8 +48,9 @@ def _digests(grid, xy, valid, cell, oid, q, radius, flags, cand):
         num_segments=NSEG,
     )
     d_cmp = jax.jit(
-        knn_pane_digest_compact, static_argnames=("num_segments", "cand")
-    )(*args, num_segments=NSEG, cand=cand)
+        knn_pane_digest_compact,
+        static_argnames=("num_segments", "cand", "selection"),
+    )(*args, num_segments=NSEG, cand=cand, selection=selection)
     return d_full, d_cmp
 
 
@@ -57,17 +59,38 @@ def _assert_same(d_full, d_cmp):
     assert np.array_equal(np.asarray(d_full.rep), np.asarray(d_cmp.rep))
 
 
-def test_compact_sparse_matches_scatter(grid):
-    """Few in-radius points (< cand): the compact path runs and matches."""
+@pytest.mark.parametrize("selection", ["topk", "blocked"])
+def test_compact_sparse_matches_scatter(grid, selection):
+    """Few in-radius points (< cand): BOTH selection strategies (the
+    CPU-best top_k sort and the TPU-best blocked prefix select) must
+    produce the scatter digest bit-for-bit."""
     rng = np.random.default_rng(1)
     xy, valid, cell, oid = _pane(rng, 50_000, grid)
     q = np.asarray([5.0, 5.0], np.float32)
     radius = 0.2  # ~60 points in radius
     flags = grid.neighbor_flags(radius, [grid.flat_cell(*q)])
     d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, radius, flags,
-                             cand=1024)
+                             cand=1024, selection=selection)
     _assert_same(d_full, d_cmp)
     assert int(np.sum(np.asarray(d_cmp.seg_min) < np.finfo(np.float32).max)) > 0
+
+
+@pytest.mark.parametrize("selection", ["topk", "blocked"])
+def test_compact_blocked_overflow_falls_back(grid, selection):
+    """A block crammed with in-radius points (or n_in > cand for topk)
+    must take the exact scatter fallback."""
+    rng = np.random.default_rng(12)
+    n = 4_096
+    # Every point in radius and packed into the low blocks.
+    xy = np.full((n, 2), 5.0, np.float32) + rng.normal(0, 0.01, (n, 2)).astype(
+        np.float32)
+    oid = rng.integers(0, NSEG, n).astype(np.int32)
+    valid = np.ones(n, bool)
+    cell = grid.assign_cells_np(xy.astype(np.float64))
+    q = np.asarray([5.0, 5.0], np.float32)
+    d_full, d_cmp = _digests(grid, xy, valid, cell, oid, q, 1.0, None,
+                             cand=64, selection=selection)
+    _assert_same(d_full, d_cmp)
 
 
 def test_compact_dense_falls_back(grid):
