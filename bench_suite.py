@@ -462,8 +462,9 @@ def bench_point_polygon_join(jax, jnp, grid, quick):
         res = point_geometry_join_pruned_kernel(
             xy_w, valid, pv, pe, gval, pb, radius,
             polygonal=True, block=256, cand=64, max_pairs=262_144,
+            pair_cap=8,
         )
-        return res.count, res.overflow
+        return res.count, res.cand_overflow, res.pair_overflow
 
     def dense(xy_w, valid, pv, pe, gval):
         mask, _ = point_geometry_join_kernel(
@@ -481,8 +482,11 @@ def bench_point_polygon_join(jax, jnp, grid, quick):
         return jax.device_put(sl[ho], dev)
 
     w0 = win_xy(0)
-    c0, o0 = jax.device_get(jpruned(w0, valid_d, qv, qe, bbox_d, gvalid_d))
-    assert int(o0) == 0, "candidate overflow: raise cand"
+    c0, co0, po0 = jax.device_get(
+        jpruned(w0, valid_d, qv, qe, bbox_d, gvalid_d)
+    )
+    assert int(co0) == 0, "candidate overflow: raise cand"
+    assert int(po0) == 0, "per-point pair overflow: raise pair_cap"
     dense_count = int(jax.device_get(jdense(w0, valid_d, qv, qe, gvalid_d)))
     assert int(c0) == dense_count, "pruned/dense pair-count parity failed"
     # vs_dense: BOTH kernels timed device-resident on the same staged
@@ -527,10 +531,12 @@ def bench_point_polygon_join(jax, jnp, grid, quick):
         jax, n_win, win_xy,
         lambda xy_w: jpruned(xy_w, valid_d, qv, qe, bbox_d, gvalid_d),
     )
-    assert sum(int(o) for _, o in out) == 0
+    assert sum(int(co) for _, co, _ in out) == 0, "candidate overflow: raise cand"
+    assert sum(int(po) for _, _, po in out) == 0, \
+        "per-point pair overflow: raise pair_cap"
     return _result(
         f"join_point_{n_polys}polygons", n_win * win_pts, dt,
-        {"pairs": sum(int(c) for c, _ in out),
+        {"pairs": sum(int(c) for c, _, _ in out),
          "vs_dense": round(dense_t / pruned_t, 2)},
         spread=(t_min, t_max),
     )

@@ -521,28 +521,35 @@ def _centered_bbox(grid, bbox: np.ndarray, dtype) -> np.ndarray:
 
 class _PrunedGeomJoinRetry:
     """Shared retry state for the pruned geometry joins: ``cand`` (block
-    candidate width) grows on overflow, ``max_pairs`` on count truncation;
-    both persist across windows (the range/join overflow-retry idiom)."""
+    candidate width) grows on cand_overflow, ``pair_cap`` (matches per
+    left item) on pair_overflow, ``max_pairs`` on count truncation; all
+    persist across windows (the range/join overflow-retry idiom)."""
 
     _cand = 32
+    _pair_cap = 8
     _geom_max_pairs = 4096
 
     def _pruned_block_pairs(self, call, m_cap: int):
-        """call(cand, max_pairs) → CompactJoinResult; returns host
-        (left_idx, right_idx, dist) with exactness guaranteed (retries
-        until overflow == 0 — at cand == m_cap the prune is a no-op).
-        Handles both the single-device result (scalar count) and the
-        sharded one (per-shard count vector; max_pairs is per shard)."""
+        """call(cand, pair_cap, max_pairs) → PrunedJoinPairs; returns
+        host (left_idx, right_idx, dist) with exactness guaranteed: at
+        cand == m_cap the prune is a no-op, and pair_cap == cand bounds
+        any item's matches. Handles both the single-device result
+        (scalar count) and the sharded one (per-shard count vector;
+        max_pairs is per shard)."""
         while True:
             cand = min(self._cand, m_cap)
-            res = call(cand, self._geom_max_pairs)
+            pair_cap = min(self._pair_cap, cand)
+            res = call(cand, pair_cap, self._geom_max_pairs)
             counts = np.asarray(res.count)
             worst = int(counts.max()) if counts.ndim else int(counts)
             if worst > self._geom_max_pairs:
                 self._geom_max_pairs = int(2 ** np.ceil(np.log2(worst)))
                 continue
-            if int(res.overflow) > 0 and cand < m_cap:
+            if int(res.cand_overflow) > 0 and cand < m_cap:
                 self._cand = min(self._cand * 2, m_cap)
+                continue
+            if int(res.pair_overflow) > 0 and pair_cap < cand:
+                self._pair_cap = min(self._pair_cap * 2, m_cap)
                 continue
             break
         if counts.ndim:  # sharded: -1-padded per-shard segments, no slice
@@ -590,7 +597,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         )
         kernel = jitted(
             point_geometry_join_pruned_kernel,
-            "polygonal", "block", "cand", "max_pairs",
+            "polygonal", "block", "cand", "max_pairs", "pair_cap",
         )
         for win in self.windows(merged):
             left_ev = [t.event for t in win.events if t.tag == 0]
@@ -619,16 +626,18 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                     sharded_point_geometry_join_pruned,
                 )
 
-                def call(cand, mp):
+                def call(cand, pair_cap, mp):
                     return sharded_point_geometry_join_pruned(
                         mesh, *args, radius, polygonal=self.polygonal,
                         block=self._point_block, cand=cand, max_pairs=mp,
+                        pair_cap=pair_cap,
                     )
             else:
-                def call(cand, mp):
+                def call(cand, pair_cap, mp):
                     return kernel(
                         *args, radius, polygonal=self.polygonal,
                         block=self._point_block, cand=cand, max_pairs=mp,
+                        pair_cap=pair_cap,
                     )
 
             li, ri, dd = self._pruned_block_pairs(call, gb.capacity)
@@ -656,7 +665,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
 
         kernel = jitted(
             point_geometry_join_pruned_kernel,
-            "polygonal", "block", "cand", "max_pairs",
+            "polygonal", "block", "cand", "max_pairs", "pair_cap",
         )
         gen_l = soa_point_batches(self.grid, point_chunks, self.conf, dtype)
         asm_r = RaggedSoaWindowAssembler(
@@ -689,9 +698,10 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype)),
             )
             li, ri, dd = self._pruned_block_pairs(
-                lambda cand, mp: kernel(
+                lambda cand, pair_cap, mp: kernel(
                     *args, radius, polygonal=self.polygonal,
                     block=self._point_block, cand=cand, max_pairs=mp,
+                    pair_cap=pair_cap,
                 ),
                 gb.capacity,
             )
@@ -762,20 +772,22 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 sharded_geometry_geometry_join_pruned,
             )
 
-            def call(cand, mp):
+            def call(cand, pair_cap, mp):
                 return sharded_geometry_geometry_join_pruned(
                     mesh, *args, radius,
                     a_polygonal=self.left_polygonal,
                     b_polygonal=self.right_polygonal,
                     block=self._geom_block, cand=cand, max_pairs=mp,
+                    pair_cap=pair_cap,
                 )
         else:
-            def call(cand, mp):
+            def call(cand, pair_cap, mp):
                 return kernel(
                     *args, radius,
                     a_polygonal=self.left_polygonal,
                     b_polygonal=self.right_polygonal,
                     block=self._geom_block, cand=cand, max_pairs=mp,
+                    pair_cap=pair_cap,
                 )
 
         li, ri, dd = self._pruned_block_pairs(call, ra.capacity)
@@ -797,6 +809,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         kernel = jitted(
             geometry_geometry_join_pruned_kernel,
             "a_polygonal", "b_polygonal", "block", "cand", "max_pairs",
+            "pair_cap",
         )
         for win in self.windows(merged):
             left_ev = [t.event for t in win.events if t.tag == 0]
@@ -831,6 +844,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         kernel = jitted(
             geometry_geometry_join_pruned_kernel,
             "a_polygonal", "b_polygonal", "block", "cand", "max_pairs",
+            "pair_cap",
         )
 
         def gen(chunks):

@@ -420,21 +420,20 @@ def _block_candidates(block_bbox, gbbox, gvalid, radius, cand: int):
         & (block_bbox[:, 3:4] >= gy0[None, :])
         & gvalid[None, :]
     )  # (NB, M)
-    # Prefix-sum one-hot selection of the first ``cand`` set bits per row,
-    # ascending geometry id. lax.top_k did the same job 10× slower here
-    # (12 ms vs ~1 ms at (256, 1000)→64 on v5e — top_k lowers to a
-    # per-row sort); this is pure VPU compare/select/reduce.
+    # Sort-free first-cand selection per row, ascending geometry id
+    # (ops/select.py — lax.top_k did the same job 10× slower here: it
+    # lowers to a per-row sort).
+    from spatialflink_tpu.ops.select import first_k_onehot
+
     m = ov.shape[1]
-    prefix = jnp.cumsum(ov.astype(jnp.int32), axis=1)  # (NB, M)
-    ncand = prefix[:, -1]
-    c_ids = jnp.arange(cand, dtype=jnp.int32)
-    hit = ov[:, :, None] & (prefix[:, :, None] == c_ids[None, None, :] + 1)
+    hit, ncand, overflow = first_k_onehot(ov, cand)  # (NB, M, cand)
     gids = jnp.sum(
-        hit * jnp.arange(m, dtype=jnp.int32)[None, :, None], axis=1
+        hit * jnp.arange(m, dtype=jnp.int32)[None, :, None], axis=1,
+        dtype=jnp.int32,
     )  # (NB, cand)
+    c_ids = jnp.arange(cand, dtype=jnp.int32)
     cvalid = c_ids[None, :] < jnp.minimum(ncand, cand)[:, None]
-    overflow = jnp.sum(jnp.maximum(ncand - cand, 0))
-    return gids.astype(jnp.int32), cvalid, overflow
+    return gids, cvalid, overflow
 
 
 def _masked_block_bbox(x, y, valid):
@@ -448,22 +447,68 @@ def _masked_block_bbox(x, y, valid):
     ], axis=1)
 
 
-def _compact_pairs(mask, dmat, borig, gids, max_pairs: int):
-    """(NB, cand, B) mask/dists → CompactJoinResult-style flat pairs."""
+class PrunedJoinPairs(NamedTuple):
+    """Output of the pruned geometry joins: compacted pairs + the TWO
+    exactness counters of the retry contract — ``cand_overflow`` (a tile
+    had more than ``cand`` bbox-overlapping geometries; grow ``cand``)
+    and ``pair_overflow`` (a single left item matched more than
+    ``pair_cap`` geometries; grow ``pair_cap``). Exact iff both are 0.
+    """
+
+    left_index: jnp.ndarray
+    right_index: jnp.ndarray
+    dist: jnp.ndarray
+    count: jnp.ndarray
+    cand_overflow: jnp.ndarray
+    pair_overflow: jnp.ndarray
+
+
+def _compact_pairs(mask, dmat, borig, gids, pair_cap: int, max_pairs: int):
+    """(NB, cand, B) mask/dists → flat pairs via PER-ITEM selection.
+
+    A single jnp.nonzero over the full NB·cand·B domain costs ~9 ns/lane
+    on TPU (~86 ms at 131k-point windows) — the same pathology the
+    Pallas join avoids. Instead: a prefix-sum one-hot select keeps up to
+    ``pair_cap`` matches per left item (domain NB·cand·B, but pure VPU
+    compare/select — no serialization), then the final nonzero runs over
+    only N·pair_cap lanes (cand/pair_cap-fold smaller). Items matching
+    more than ``pair_cap`` geometries report pair_overflow (retry).
+    Returns (left, right, dist, count, pair_overflow).
+    """
+    from spatialflink_tpu.ops.select import first_k_onehot
+
     nb, cand, b = mask.shape
-    flat = mask.reshape(-1)
-    count = jnp.sum(flat.astype(jnp.int32))
-    (hit,) = jnp.nonzero(flat, size=max_pairs, fill_value=-1)
-    found = hit >= 0
-    h = jnp.maximum(hit, 0)
-    bi = h // (cand * b)
-    ci = (h // b) % cand
-    li = h % b
+    # Per-item selection along the candidate axis (moved last for the
+    # shared helper; XLA fuses the transpose into the cumsum chain).
+    mask_t = jnp.moveaxis(mask, 1, -1)  # (NB, B, cand)
+    hit, per_item, pair_overflow = first_k_onehot(mask_t, pair_cap)
+    # hit: (NB, B, cand, pair_cap); one-hot sums select exactly one term
+    # — bit-exact for the distance.
+    gsel = jnp.sum(
+        hit * gids[:, None, :, None], axis=2, dtype=jnp.int32
+    )  # (NB, B, pair_cap)
+    dmat_t = jnp.moveaxis(dmat, 1, -1)  # (NB, B, cand)
+    dsel = jnp.sum(
+        jnp.where(hit, dmat_t[:, :, :, None], jnp.zeros((), dmat.dtype)),
+        axis=2,
+    )
+    slots = jnp.arange(pair_cap, dtype=jnp.int32)
+    svalid = (
+        slots[None, None, :] < jnp.minimum(per_item, pair_cap)[:, :, None]
+    )  # (NB, B, pair_cap)
+
+    flat = svalid.reshape(-1)
+    count = jnp.sum(per_item, dtype=jnp.int32)
+    (hit_i,) = jnp.nonzero(flat, size=max_pairs, fill_value=-1)
+    found = hit_i >= 0
+    h = jnp.maximum(hit_i, 0)
+    bi = h // (b * pair_cap)
+    li = (h // pair_cap) % b
     left = jnp.where(found, borig[bi, li], -1)
-    right = jnp.where(found, gids[bi, ci], -1)
-    dist = jnp.where(found, dmat.reshape(-1)[h],
+    right = jnp.where(found, gsel.reshape(-1)[h], -1)
+    dist = jnp.where(found, dsel.reshape(-1)[h],
                      jnp.asarray(jnp.inf, dmat.dtype))
-    return left, right, dist, count, found
+    return left, right, dist, count, pair_overflow
 
 
 def point_geometry_join_pruned_kernel(
@@ -478,7 +523,8 @@ def point_geometry_join_pruned_kernel(
     block: int,
     cand: int,
     max_pairs: int,
-) -> CompactJoinResult:
+    pair_cap: int = 8,
+) -> PrunedJoinPairs:
     """Grid-pruned point ⋈ geometry join, device-extracted.
 
     The dense kernel (point_geometry_join_kernel) evaluates every
@@ -492,12 +538,13 @@ def point_geometry_join_pruned_kernel(
       3. compact ≤ ``cand`` candidate geometries per tile (lax.top_k),
       4. exact V-vertex distances tile × candidates — O(N·cand·V), a
          M/cand-fold cut,
-      5. one jnp.nonzero compaction so only pairs cross the host boundary.
+      5. per-item selection (≤ ``pair_cap`` matches per point) + one
+         small jnp.nonzero so only pairs cross the host boundary.
 
-    Exact iff ``overflow == 0`` (a tile had more than ``cand`` bbox-
-    overlapping geometries — the caller retries with a larger ``cand``;
-    at cand == M the prune is a no-op and overflow is structurally 0).
-    Pair set identical to the dense kernel (parity test
+    Exact iff BOTH overflow counters are 0 (PrunedJoinPairs: grow
+    ``cand`` on cand_overflow — at cand == M the prune is a no-op — and
+    ``pair_cap`` on pair_overflow — at pair_cap == cand a point cannot
+    exceed it). Pair set identical to the dense kernel (parity test
     tests/test_join_pruned.py); JTS semantics kept (inside polygonal → 0).
 
     The caller orders the points for spatial locality HOST-side (numpy
@@ -545,10 +592,10 @@ def point_geometry_join_pruned_kernel(
         & bvalid[:, None, :]
         & cvalid[:, :, None]
     )
-    left, right, dist, count, _ = _compact_pairs(
-        mask, dmat, borig, gids, max_pairs
+    left, right, dist, count, pair_over = _compact_pairs(
+        mask, dmat, borig, gids, pair_cap, max_pairs
     )
-    return CompactJoinResult(left, right, dist, count, overflow)
+    return PrunedJoinPairs(left, right, dist, count, overflow, pair_over)
 
 
 def geometry_geometry_join_pruned_kernel(
@@ -566,7 +613,8 @@ def geometry_geometry_join_pruned_kernel(
     block: int,
     cand: int,
     max_pairs: int,
-) -> CompactJoinResult:
+    pair_cap: int = 8,
+) -> PrunedJoinPairs:
     """Grid-pruned geometry ⋈ geometry join, device-extracted.
 
     Same tile/candidate scheme as the point version: the caller orders
@@ -629,10 +677,10 @@ def geometry_geometry_join_pruned_kernel(
         & bval[:, None, :]
         & cvalid[:, :, None]
     )
-    left, right, dist, count, _ = _compact_pairs(
-        mask, dmat, borig, gids, max_pairs
+    left, right, dist, count, pair_over = _compact_pairs(
+        mask, dmat, borig, gids, pair_cap, max_pairs
     )
-    return CompactJoinResult(left, right, dist, count, overflow)
+    return PrunedJoinPairs(left, right, dist, count, overflow, pair_over)
 
 
 def cross_join_kernel(

@@ -157,6 +157,11 @@ def _digest_from_point_dists_compact(
     the full scatter digest — results are ALWAYS bit-identical to
     ``_digest_from_point_dists`` (parity test
     tests/test_knn_compact.py)."""
+    if selection not in ("auto", "blocked", "topk"):
+        raise ValueError(
+            f"selection must be 'auto', 'blocked' or 'topk', "
+            f"got {selection!r}"
+        )
     if cand >= dist.shape[0]:
         # Pane no larger than the compaction width: nothing to compact
         # (static shapes, so this is a compile-time decision).
@@ -189,11 +194,6 @@ def _digest_from_point_dists_compact(
         selection = (
             "blocked" if jax.default_backend() in ("tpu", "axon") else "topk"
         )
-    if selection not in ("blocked", "topk"):
-        raise ValueError(
-            f"selection must be 'auto', 'blocked' or 'topk', "
-            f"got {selection!r}"
-        )
 
     def _finish(ci, cvalid):
         coid = oid[ci]
@@ -218,21 +218,22 @@ def _digest_from_point_dists_compact(
         )
 
     if selection == "blocked":
+        from spatialflink_tpu.ops.select import first_k_onehot
+
         lane_block = 512
         n = masked.shape[0]
         nb = -(-n // lane_block)
         per_block = int(min(lane_block, max(16, cand // max(nb, 1))))
         npad = nb * lane_block
         m2 = jnp.pad(mask, (0, npad - n)).reshape(nb, lane_block)
-        prefix = jnp.cumsum(m2.astype(jnp.int32), axis=1)
-        cnt = prefix[:, -1]
+        # Only the cheap counts decide the branch; the large one-hot is
+        # built INSIDE compact() so the scatter fallback never pays it
+        # (branch closures become cond operands, evaluated eagerly).
+        cnt = jnp.sum(m2.astype(jnp.int32), axis=1)
         block_overflow = jnp.sum(jnp.maximum(cnt - per_block, 0))
 
         def compact(_):
-            slots = jnp.arange(per_block, dtype=jnp.int32)
-            hit = m2[:, :, None] & (
-                prefix[:, :, None] == slots[None, None, :] + 1
-            )
+            hit, _cnt, _of = first_k_onehot(m2, per_block)
             lanes = jnp.arange(lane_block, dtype=jnp.int32)
             in_block = jnp.sum(
                 hit * lanes[None, :, None], axis=1, dtype=jnp.int32
@@ -241,6 +242,7 @@ def _digest_from_point_dists_compact(
             ci = jnp.minimum(
                 (in_block + base).reshape(-1), jnp.int32(n - 1)
             )
+            slots = jnp.arange(per_block, dtype=jnp.int32)
             cvalid = (
                 slots[None, :] < jnp.minimum(cnt, per_block)[:, None]
             ).reshape(-1)

@@ -1,0 +1,35 @@
+"""Sort-free first-k selection — the shared TPU selection primitive.
+
+``lax.top_k`` lowers to a full sort on TPU; when only set-MEMBERSHIP
+matters (the consumer's reduction is order-independent, e.g. min), the
+first k set bits per row can be selected with a prefix-sum one-hot —
+pure VPU compare/select/reduce, measured ~10× faster than top_k at the
+shapes the kernels use. One implementation, three consumers:
+
+- ops/join.py:_block_candidates (candidate geometries per tile),
+- ops/join.py:_compact_pairs (matches per left item),
+- ops/knn.py blocked candidate select (in-radius points per lane block).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def first_k_onehot(mask: jnp.ndarray, k: int):
+    """Select the first ``k`` set bits along the LAST axis, ascending.
+
+    Returns ``(hit, count, overflow)``: ``hit`` is a (..., C, k) one-hot
+    bool tensor (slot ``s`` marks the (s+1)-th set bit of the row —
+    consumers reduce it against index or value tensors; a one-hot sum
+    selects exactly one term, so value selection is bit-exact),
+    ``count`` the (...,) per-row set-bit totals, and ``overflow`` the
+    scalar total of set bits beyond ``k`` (the callers' retry contract:
+    selection is complete iff 0).
+    """
+    prefix = jnp.cumsum(mask.astype(jnp.int32), axis=-1)
+    count = prefix[..., -1]
+    slots = jnp.arange(k, dtype=jnp.int32)
+    hit = mask[..., None] & (prefix[..., None] == slots + 1)
+    overflow = jnp.sum(jnp.maximum(count - k, 0))
+    return hit, count, overflow

@@ -497,9 +497,9 @@ def sharded_join(
 
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_pg_join(mesh: Mesh, polygonal: bool, block: int,
-                            cand: int, max_pairs: int):
+                            cand: int, max_pairs: int, pair_cap: int):
     from spatialflink_tpu.ops.join import (
-        CompactJoinResult,
+        PrunedJoinPairs,
         point_geometry_join_pruned_kernel,
     )
 
@@ -507,22 +507,23 @@ def _cached_sharded_pg_join(mesh: Mesh, polygonal: bool, block: int,
         res = point_geometry_join_pruned_kernel(
             pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
             polygonal=polygonal, block=block, cand=cand,
-            max_pairs=max_pairs,
+            max_pairs=max_pairs, pair_cap=pair_cap,
         )
         base = jax.lax.axis_index("data") * pxy.shape[0]
         left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
-        return CompactJoinResult(
+        return PrunedJoinPairs(
             left, res.right_index, res.dist,
             res.count[None],  # (1,) per shard → (n_shards,) stacked
-            jax.lax.psum(res.overflow, "data"),
+            jax.lax.psum(res.cand_overflow, "data"),
+            jax.lax.psum(res.pair_overflow, "data"),
         )
 
     return jax.jit(shard_map(
         local,
         mesh=mesh,
         in_specs=(P("data"), P("data"), P(), P(), P(), P(), P()),
-        out_specs=CompactJoinResult(
-            P("data"), P("data"), P("data"), P("data"), P()
+        out_specs=PrunedJoinPairs(
+            P("data"), P("data"), P("data"), P("data"), P(), P()
         ),
         check_vma=False,
     ))
@@ -532,6 +533,7 @@ def sharded_point_geometry_join_pruned(
     mesh: Mesh,
     pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
     polygonal: bool, block: int, cand: int, max_pairs: int,
+    pair_cap: int = 8,
 ):
     """Multi-chip grid-pruned point ⋈ geometry join: the (host-locality-
     sorted) point side shards over ``data``, the geometry batch
@@ -541,19 +543,20 @@ def sharded_point_geometry_join_pruned(
 
     ``left_index`` entries are global input positions; ``count`` comes
     back as a per-shard (n_shards,) vector (``max_pairs`` is PER SHARD —
-    a shard truncates when its own count exceeds it); ``overflow`` is
-    psum-replicated. Bit-parity with single-device up to pair order
-    (tests/test_parallel_operators.py)."""
-    return _cached_sharded_pg_join(mesh, polygonal, block, cand, max_pairs)(
-        pxy, pvalid, gverts, gev, gvalid, gbbox, radius
-    )
+    a shard truncates when its own count exceeds it); both overflow
+    counters are psum-replicated. Bit-parity with single-device up to
+    pair order (tests/test_join_pruned.py)."""
+    return _cached_sharded_pg_join(
+        mesh, polygonal, block, cand, max_pairs, pair_cap
+    )(pxy, pvalid, gverts, gev, gvalid, gbbox, radius)
 
 
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
-                            block: int, cand: int, max_pairs: int):
+                            block: int, cand: int, max_pairs: int,
+                            pair_cap: int):
     from spatialflink_tpu.ops.join import (
-        CompactJoinResult,
+        PrunedJoinPairs,
         geometry_geometry_join_pruned_kernel,
     )
 
@@ -561,13 +564,14 @@ def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
         res = geometry_geometry_join_pruned_kernel(
             averts, aev, avalid, abbox, bverts, bev, bvalid, bbox, radius,
             a_polygonal=a_polygonal, b_polygonal=b_polygonal,
-            block=block, cand=cand, max_pairs=max_pairs,
+            block=block, cand=cand, max_pairs=max_pairs, pair_cap=pair_cap,
         )
         base = jax.lax.axis_index("data") * averts.shape[0]
         left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
-        return CompactJoinResult(
+        return PrunedJoinPairs(
             left, res.right_index, res.dist, res.count[None],
-            jax.lax.psum(res.overflow, "data"),
+            jax.lax.psum(res.cand_overflow, "data"),
+            jax.lax.psum(res.pair_overflow, "data"),
         )
 
     return jax.jit(shard_map(
@@ -577,8 +581,8 @@ def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
             P("data"), P("data"), P("data"), P("data"),
             P(), P(), P(), P(), P(),
         ),
-        out_specs=CompactJoinResult(
-            P("data"), P("data"), P("data"), P("data"), P()
+        out_specs=PrunedJoinPairs(
+            P("data"), P("data"), P("data"), P("data"), P(), P()
         ),
         check_vma=False,
     ))
@@ -588,11 +592,11 @@ def sharded_geometry_geometry_join_pruned(
     mesh: Mesh,
     averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius,
     a_polygonal: bool, b_polygonal: bool,
-    block: int, cand: int, max_pairs: int,
+    block: int, cand: int, max_pairs: int, pair_cap: int = 8,
 ):
     """Multi-chip grid-pruned geometry ⋈ geometry join — left side (host-
     locality-sorted) sharded over ``data``, right side replicated; same
     contracts as sharded_point_geometry_join_pruned."""
     return _cached_sharded_gg_join(
-        mesh, a_polygonal, b_polygonal, block, cand, max_pairs
+        mesh, a_polygonal, b_polygonal, block, cand, max_pairs, pair_cap
     )(averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius)

@@ -135,6 +135,30 @@ def test_point_polygon_overflow_retry_exact(rng):
     assert op._cand > 1  # growth actually happened
 
 
+def test_point_polygon_pair_cap_retry_exact(rng):
+    """A point inside many stacked polygons exceeds pair_cap=1; the
+    per-item selection must retry with a grown cap and still produce the
+    exact dense pair set."""
+    pts = _points(rng, 400)
+    polys = [Polygon(obj_id=f"g{i}", timestamp=i * 400,
+                     rings=[_square(5.0, 5.0, 2.0 + 0.05 * i)])
+             for i in range(12)]  # concentric: central points match all 12
+    r = 0.1
+    op = PointPolygonJoinQuery(W, GRID)
+    op._pair_cap = 1
+    got = _op_pairs(op.run(iter(pts), iter(polys), r))
+    expect = _dense_pairs_point_geom(
+        PointPolygonJoinQuery(W, GRID), pts, polys, r, True
+    )
+    assert got == expect
+    assert op._pair_cap > 1  # growth actually happened
+    # Central points really do match many polygons.
+    from collections import Counter
+
+    per_point = Counter(a for a, _, _ in got)
+    assert max(per_point.values()) == 12
+
+
 def test_point_linestring_pruned_matches_dense(rng):
     from spatialflink_tpu.operators.join_query import PointLineStringJoinQuery
 
