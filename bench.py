@@ -85,6 +85,34 @@ def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
 
 
 def main() -> None:
+    import os as _os
+    import threading
+
+    # Device-init watchdog: the tunnel's site hook dials the device while
+    # jax initializes; a down tunnel hangs that C call forever (observed
+    # outage 2026-07-30). Emit an honest one-line record and exit instead
+    # of hanging the driver — a hung benchmark records nothing.
+    _init_ok = threading.Event()
+
+    def _watchdog():
+        # 600 s is ~20× a cold plugin start — far past any healthy init,
+        # even on a congested tunnel (first compiles happen later and
+        # are not under this timer).
+        if not _init_ok.wait(600):
+            if _init_ok.is_set():  # lost the race at the boundary
+                return
+            print(json.dumps({
+                "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+                "value": 0,
+                "unit": "points/s",
+                "vs_baseline": 0,
+                "error": "device tunnel unreachable (init hang > 600 s)",
+            }))
+            sys.stdout.flush()
+            _os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
 
@@ -94,6 +122,7 @@ def main() -> None:
     from __graft_entry__ import BEIJING_GRID_ARGS, QUERY_POINT
 
     dev = jax.devices()[0]
+    _init_ok.set()  # device reachable — disarm the watchdog
     grid = UniformGrid(**BEIJING_GRID_ARGS)
     wf = WireFormat.for_grid(grid)
     q = np.asarray(QUERY_POINT, np.float32)

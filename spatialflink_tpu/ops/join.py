@@ -399,6 +399,12 @@ def geometry_geometry_join_kernel(
     return mask, d
 
 
+def _onehot_select_preferred() -> bool:
+    from spatialflink_tpu.ops.select import onehot_select_preferred
+
+    return onehot_select_preferred()
+
+
 def _block_candidates(block_bbox, gbbox, gvalid, radius, cand: int):
     """Block-level bbox pruning + per-block candidate compaction.
 
@@ -420,17 +426,24 @@ def _block_candidates(block_bbox, gbbox, gvalid, radius, cand: int):
         & (block_bbox[:, 3:4] >= gy0[None, :])
         & gvalid[None, :]
     )  # (NB, M)
-    # Sort-free first-cand selection per row, ascending geometry id
-    # (ops/select.py — lax.top_k did the same job 10× slower here: it
-    # lowers to a per-row sort).
-    from spatialflink_tpu.ops.select import first_k_onehot
-
+    # First-cand selection per row, ascending geometry id — strategy per
+    # backend (identical results; see _onehot_select_preferred).
     m = ov.shape[1]
-    hit, ncand, overflow = first_k_onehot(ov, cand)  # (NB, M, cand)
-    gids = jnp.sum(
-        hit * jnp.arange(m, dtype=jnp.int32)[None, :, None], axis=1,
-        dtype=jnp.int32,
-    )  # (NB, cand)
+    if _onehot_select_preferred():
+        from spatialflink_tpu.ops.select import first_k_onehot
+
+        hit, ncand, overflow = first_k_onehot(ov, cand)  # (NB, M, cand)
+        gids = jnp.sum(
+            hit * jnp.arange(m, dtype=jnp.int32)[None, :, None], axis=1,
+            dtype=jnp.int32,
+        )  # (NB, cand)
+    else:
+        ncand = jnp.sum(ov.astype(jnp.int32), axis=1)
+        overflow = jnp.sum(jnp.maximum(ncand - cand, 0))
+        # top_k over the 0/1 mask: ones first, ties by ascending index —
+        # the indices ARE the candidate geometry ids.
+        _vals, gids = jax.lax.top_k(ov.astype(jnp.int32), cand)
+        gids = gids.astype(jnp.int32)
     c_ids = jnp.arange(cand, dtype=jnp.int32)
     cvalid = c_ids[None, :] < jnp.minimum(ncand, cand)[:, None]
     return gids, cvalid, overflow
@@ -475,24 +488,38 @@ def _compact_pairs(mask, dmat, borig, gids, pair_cap: int, max_pairs: int):
     more than ``pair_cap`` geometries report pair_overflow (retry).
     Returns (left, right, dist, count, pair_overflow).
     """
-    from spatialflink_tpu.ops.select import first_k_onehot
-
-    nb, cand, b = mask.shape
+    b = mask.shape[2]
     # Per-item selection along the candidate axis (moved last for the
-    # shared helper; XLA fuses the transpose into the cumsum chain).
+    # shared selection primitives).
     mask_t = jnp.moveaxis(mask, 1, -1)  # (NB, B, cand)
-    hit, per_item, pair_overflow = first_k_onehot(mask_t, pair_cap)
-    # hit: (NB, B, cand, pair_cap); one-hot sums select exactly one term
-    # — bit-exact for the distance.
-    gsel = jnp.sum(
-        hit * gids[:, None, :, None], axis=2, dtype=jnp.int32
-    )  # (NB, B, pair_cap)
     dmat_t = jnp.moveaxis(dmat, 1, -1)  # (NB, B, cand)
-    dsel = jnp.sum(
-        jnp.where(hit, dmat_t[:, :, :, None], jnp.zeros((), dmat.dtype)),
-        axis=2,
-    )
     slots = jnp.arange(pair_cap, dtype=jnp.int32)
+    if _onehot_select_preferred():
+        from spatialflink_tpu.ops.select import first_k_onehot
+
+        hit, per_item, pair_overflow = first_k_onehot(mask_t, pair_cap)
+        # hit: (NB, B, cand, pair_cap); one-hot sums select exactly one
+        # term — bit-exact for the distance.
+        gsel = jnp.sum(
+            hit * gids[:, None, :, None], axis=2, dtype=jnp.int32
+        )  # (NB, B, pair_cap)
+        dsel = jnp.sum(
+            jnp.where(hit, dmat_t[:, :, :, None],
+                      jnp.zeros((), dmat.dtype)),
+            axis=2,
+        )
+    else:
+        # CPU & friends: top_k over the 0/1 mask (the one-hot tensor is
+        # measurably slower than the vectorized sort on XLA:CPU — same
+        # per-backend gate as ops/knn.py's compact digest; identical
+        # selection, ties broken by ascending candidate slot).
+        per_item = jnp.sum(mask_t.astype(jnp.int32), axis=-1)
+        pair_overflow = jnp.sum(jnp.maximum(per_item - pair_cap, 0))
+        _vals, csel = jax.lax.top_k(mask_t.astype(jnp.int8), pair_cap)
+        gsel = jnp.take_along_axis(
+            jnp.broadcast_to(gids[:, None, :], mask_t.shape), csel, axis=-1
+        ).astype(jnp.int32)
+        dsel = jnp.take_along_axis(dmat_t, csel, axis=-1)
     svalid = (
         slots[None, None, :] < jnp.minimum(per_item, pair_cap)[:, :, None]
     )  # (NB, B, pair_cap)
@@ -556,6 +583,11 @@ def point_geometry_join_pruned_kernel(
     from spatialflink_tpu.ops.distances import point_polyline_distance
     from spatialflink_tpu.ops.polygon import points_in_polygon
 
+    # Static clamps: cand cannot exceed the geometry count, pair_cap
+    # cannot exceed cand (an item's matches come from its tile's cand
+    # list) — unclamped values would crash only on the top_k backends.
+    cand = min(cand, gverts.shape[0])
+    pair_cap = min(pair_cap, cand)
     n = pxy.shape[0]
     nb = -(-n // block)
     npad = nb * block
@@ -622,12 +654,15 @@ def geometry_geometry_join_pruned_kernel(
     bbox center — join_query._GeometryGeometryJoinQuery._window_pairs,
     the single home of that key logic); tile bboxes are unioned over
     member bboxes. ``left_index`` refers to input positions. Exact iff
-    ``overflow == 0`` (retry contract); parity with
-    geometry_geometry_join_kernel incl. overlap→0 distances
+    BOTH ``cand_overflow`` AND ``pair_overflow`` are 0 (PrunedJoinPairs
+    retry contract — grow ``cand`` / ``pair_cap`` respectively); parity
+    with geometry_geometry_join_kernel incl. overlap→0 distances
     (tests/test_join_pruned.py).
     """
     from spatialflink_tpu.ops.range import geometry_pair_distance
 
+    cand = min(cand, bverts.shape[0])  # see point kernel's clamps
+    pair_cap = min(pair_cap, cand)
     la = averts.shape[0]
     nb = -(-la // block)
     npad = nb * block

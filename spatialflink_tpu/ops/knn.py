@@ -191,9 +191,9 @@ def _digest_from_point_dists_compact(
     #     baseline), so each backend gets its best program and the
     #     CPU-vs-TPU comparison stays honest.
     if selection == "auto":
-        selection = (
-            "blocked" if jax.default_backend() in ("tpu", "axon") else "topk"
-        )
+        from spatialflink_tpu.ops.select import onehot_select_preferred
+
+        selection = "blocked" if onehot_select_preferred() else "topk"
 
     def _finish(ci, cvalid):
         coid = oid[ci]

@@ -1,19 +1,39 @@
-"""Sort-free first-k selection — the shared TPU selection primitive.
+"""Sort-free first-k selection — the shared TPU selection primitive and
+the per-backend strategy gate.
 
 ``lax.top_k`` lowers to a full sort on TPU; when only set-MEMBERSHIP
 matters (the consumer's reduction is order-independent, e.g. min), the
 first k set bits per row can be selected with a prefix-sum one-hot —
 pure VPU compare/select/reduce, measured ~10× faster than top_k at the
-shapes the kernels use. One implementation, three consumers:
+shapes the kernels use. On XLA:CPU the relation inverts (the vectorized
+sort wins; the one-hot tensor measured ~9× slower on the kNN headline),
+so every consumer gates on ``onehot_select_preferred()``:
 
 - ops/join.py:_block_candidates (candidate geometries per tile),
 - ops/join.py:_compact_pairs (matches per left item),
-- ops/knn.py blocked candidate select (in-radius points per lane block).
+- ops/knn.py compact-digest candidate select.
+
+The top_k alternative stays at each call site rather than behind one
+index-returning API: the TPU consumers reduce the one-hot tensor
+directly (sums — no gathers, which are the TPU-slow op this module
+exists to avoid), while the CPU consumers gather by the top_k indices.
+Both strategies select the identical set (ascending position, ties by
+index) — parity-tested per consumer.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+
+def onehot_select_preferred() -> bool:
+    """True on backends where the prefix-sum one-hot select beats
+    top_k — the ONE backend list every consumer shares."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover - backend init failure
+        return False
 
 
 def first_k_onehot(mask: jnp.ndarray, k: int):

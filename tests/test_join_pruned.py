@@ -159,6 +159,57 @@ def test_point_polygon_pair_cap_retry_exact(rng):
     assert max(per_point.values()) == 12
 
 
+def test_pruned_kernel_onehot_branch_matches_topk(rng, monkeypatch):
+    """The per-backend selection gate picks top_k on CPU; force the
+    one-hot branch (the TPU strategy) and assert the pair set is
+    identical — both selection strategies implement one contract."""
+    import spatialflink_tpu.ops.join as oj
+
+    pts = _points(rng, 2_000)
+    polys = _polygons(rng, 80)
+    r = 0.15
+    op = PointPolygonJoinQuery(W, GRID)
+    lb = op.point_batch(pts)
+    gb = op.geometry_batch(polys)
+    ho = np.argsort(lb.cell, kind="stable")
+    from spatialflink_tpu.operators.base import center_coords
+    from spatialflink_tpu.operators.join_query import _centered_bbox
+
+    args = (
+        jnp.asarray(center_coords(GRID, lb.xy[ho], np.float64)),
+        jnp.asarray(lb.valid[ho]),
+        jnp.asarray(op.device_verts(gb.verts, np.float64)),
+        jnp.asarray(gb.edge_valid),
+        jnp.asarray(gb.valid),
+        jnp.asarray(_centered_bbox(GRID, gb.bbox, np.float64)),
+        np.float64(r),
+    )
+
+    def run(force_onehot):
+        monkeypatch.setattr(oj, "_onehot_select_preferred",
+                            lambda: force_onehot)
+        import jax
+
+        res = jax.jit(
+            oj.point_geometry_join_pruned_kernel,
+            static_argnames=("polygonal", "block", "cand", "max_pairs",
+                            "pair_cap"),
+        )(*args, polygonal=True, block=256, cand=64, max_pairs=16_384,
+          pair_cap=8)
+        assert int(res.cand_overflow) == 0 and int(res.pair_overflow) == 0
+        n = int(res.count)
+        return {
+            (int(a), int(b), round(float(d), 12))
+            for a, b, d in zip(np.asarray(res.left_index)[:n],
+                               np.asarray(res.right_index)[:n],
+                               np.asarray(res.dist)[:n])
+            if a >= 0
+        }
+
+    assert run(True) == run(False)
+    assert run(False)
+
+
 def test_point_linestring_pruned_matches_dense(rng):
     from spatialflink_tpu.operators.join_query import PointLineStringJoinQuery
 
