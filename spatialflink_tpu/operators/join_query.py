@@ -73,7 +73,7 @@ class _TaggedEvent:
 
 def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
                            max_pairs=None, dtype=np.float64, backend=None,
-                           mesh=None):
+                           mesh=None, filter_radius=None):
     """Run the grid-hash join kernel over two cell-assigned PointBatches.
 
     Shared by PointPointJoinQuery and TJoinQuery. With ``max_pairs`` set,
@@ -81,9 +81,17 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
     the host boundary — the dense mask path transfers O(N·K·cap) per
     window. ``backend``: None=auto (Pallas extraction on TPU — hit
     compaction in time ∝ matches; XLA elsewhere), or one of
-    'xla' | 'pallas' | 'pallas_interpret' (tests)."""
+    'xla' | 'pallas' | 'pallas_interpret' (tests).
+
+    ``filter_radius`` (default = ``radius``) decouples the distance
+    predicate from the candidate-cell neighborhood: approximate point
+    joins pass ``inf`` so every grid candidate is emitted while the
+    replication neighborhood stays that of the TRUE radius — the
+    reference's "all the candidate neighbors are sent to output"
+    semantics (join/PointPointJoinQuery.java:164-166)."""
     from spatialflink_tpu.operators.base import center_coords
 
+    fr = radius if filter_radius is None else filter_radius
     if max_pairs is not None:
         layers = grid.candidate_layers(radius)
         if mesh is not None:
@@ -103,7 +111,7 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
                 jnp.asarray(center_coords(grid, right_batch.xy, dtype)),
                 jnp.asarray(right_batch.valid),
                 jnp.asarray(right_batch.cell),
-                offsets, grid_n=grid.n, radius=radius, cap=cap,
+                offsets, grid_n=grid.n, radius=fr, cap=cap,
                 max_pairs=max_pairs,
             )
         if backend is None:
@@ -130,7 +138,7 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
                 jnp.asarray(center_coords(grid, right_batch.xy, np.float32)),
                 jnp.asarray(right_batch.valid),
                 jnp.asarray(right_batch.cell),
-                grid_n=grid.n, layers=layers, radius=radius,
+                grid_n=grid.n, layers=layers, radius=fr,
                 cap_left=cap, cap_right=cap, max_pairs=max_pairs,
                 interpret=backend == "pallas_interpret",
             )
@@ -152,7 +160,7 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
                 jnp.asarray(right_batch.valid),
                 jnp.asarray(right_batch.cell),
                 grid_n=grid.n, layers=layers,
-                radius=radius, cap_left=cap, cap_right=cap,
+                radius=fr, cap_left=cap, cap_right=cap,
                 max_pairs=max_pairs,
             )
         # High per-cell capacity: gather-based join (memory O(N·span²·cap)).
@@ -166,7 +174,7 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
             jnp.asarray(right_batch.valid),
             jnp.asarray(right_batch.cell),
             offsets,
-            grid_n=grid.n, radius=radius, cap=cap, max_pairs=max_pairs,
+            grid_n=grid.n, radius=fr, cap=cap, max_pairs=max_pairs,
         )
     left_ci = grid.cell_xy_indices_np(left_batch.xy)
     # Reference semantics: out-of-grid points carry keys that never match a
@@ -189,10 +197,10 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
         from spatialflink_tpu.parallel.sharded import sharded_join
 
         return sharded_join(
-            mesh, *args, grid_n=grid.n, radius=radius, cap=cap
+            mesh, *args, grid_n=grid.n, radius=fr, cap=cap
         )
     jk = jitted(join_kernel, "grid_n", "cap")
-    return jk(*args, grid_n=grid.n, radius=radius, cap=cap)
+    return jk(*args, grid_n=grid.n, radius=fr, cap=cap)
 
 
 class PointPointJoinQuery(SpatialOperator):
@@ -212,6 +220,16 @@ class PointPointJoinQuery(SpatialOperator):
         self.cap = cap
         self.join_backend = join_backend  # None=auto, 'xla', 'pallas[_interpret]'
         self._max_pairs = 0  # grown budget persists across windows
+
+    def _filter_radius(self, radius):
+        """Distance-predicate radius: in approximate mode every grid
+        candidate is emitted (the reference's "all the candidate
+        neighbors are sent to output", join/PointPointJoinQuery.java:
+        164-166, incl. the RealTimeNaive branch :216) — expressed as an
+        infinite filter radius while the candidate neighborhood stays
+        that of the true radius. Reported pair distances remain the real
+        point distances (the reference emits no distance at all here)."""
+        return np.inf if self.conf.approximate_query else radius
 
     def run(
         self,
@@ -255,7 +273,8 @@ class PointPointJoinQuery(SpatialOperator):
             if naive:
                 res = ck(
                     self.device_xy(lb, dtype), jnp.asarray(lb.valid),
-                    self.device_xy(rb, dtype), jnp.asarray(rb.valid), radius,
+                    self.device_xy(rb, dtype), jnp.asarray(rb.valid),
+                    self._filter_radius(radius),
                 )
                 pm = np.asarray(res.pair_mask)
                 ri = np.asarray(res.right_index)
@@ -295,6 +314,7 @@ class PointPointJoinQuery(SpatialOperator):
                 self.grid, lb, rb, radius, self.cap, offsets,
                 max_pairs=self._max_pairs, dtype=dtype,
                 backend=self.join_backend, mesh=mesh,
+                filter_radius=self._filter_radius(radius),
             )
             count = int(res.count)
             if count <= self._max_pairs:
@@ -449,6 +469,7 @@ class PointPointJoinQuery(SpatialOperator):
             )
 
         layers = self.grid.candidate_layers(radius)
+        fr = self._filter_radius(radius)
         gen_l = soa_point_batches(self.grid, left_chunks, self.conf, dtype)
         gen_r = soa_point_batches(self.grid, right_chunks, self.conf, dtype)
         budget = max_pairs  # grown budget persists across windows
@@ -473,7 +494,7 @@ class PointPointJoinQuery(SpatialOperator):
                 res = fn(
                     jnp.asarray(lxy), jnp.asarray(lvalid), jnp.asarray(lcell),
                     jnp.asarray(rxy), jnp.asarray(rvalid), jnp.asarray(rcell),
-                    grid_n=self.grid.n, layers=layers, radius=radius,
+                    grid_n=self.grid.n, layers=layers, radius=fr,
                     cap_left=self.cap, cap_right=self.cap, max_pairs=budget,
                 )
                 count = int(res.count)
@@ -581,6 +602,81 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
 
     polygonal = True
     _point_block = 256
+    # Approximate semantics differ by which side is the POINT stream in
+    # the reference: point-ordinary families emit ALL grid candidates
+    # (join/PointPolygonJoinQuery.java:131 "all the candidate neighbors
+    # are sent to output"); geometry-ordinary families (PolygonPoint /
+    # LineStringPoint, which swap into this class) use the point →
+    # geometry-bbox min distance (join/PolygonPointJoinQuery.java:
+    # getPointPolygonBBoxMinEuclideanDistance).
+    approx_emit_all = True
+
+    def _approx_cell_space(self, cells_sorted, valid_sorted, gb, radius):
+        """Kernel-space inputs for the point-ordinary approximate mode.
+
+        The reference's candidate set is cell membership: cell(p) inside
+        the geometry's bbox-cell rectangle expanded by
+        ``candidate_layers(radius)`` (UniformGrid guaranteed ∪ candidate
+        cells — a rectangle expanded by L layers stays a rectangle).
+        Expressed for the pruned kernel's ``approx`` mode as: coords =
+        (xi, yi) CELL indices, per-geometry "bbox" = the layer-expanded
+        cell rectangle, radius = 0 (point-in-box ⇔
+        bbox_point_min_distance == 0). Reported pair distance is 0 —
+        the reference emits no distance in this mode. Out-of-grid
+        points never join (key-never-matches semantics)."""
+        g = self.grid
+        cells = np.asarray(cells_sorted)
+        xi = (cells // g.n).astype(np.float64)
+        yi = (cells % g.n).astype(np.float64)
+        pxy = np.stack([xi, yi], axis=1)
+        pvalid = np.asarray(valid_sorted) & (cells < g.num_cells)
+        L = g.candidate_layers(radius)
+        bb = np.asarray(gb.bbox, np.float64)
+        bx1 = np.floor((bb[:, 0] - g.min_x) / g.cell_length) - L
+        by1 = np.floor((bb[:, 1] - g.min_y) / g.cell_length) - L
+        bx2 = np.floor((bb[:, 2] - g.min_x) / g.cell_length) + L
+        by2 = np.floor((bb[:, 3] - g.min_y) / g.cell_length) + L
+        gbbox = np.stack([bx1, by1, bx2, by2], axis=1)
+        return pxy, pvalid, gbbox
+
+    def _point_side_args(self, pxy_centered, pvalid, pcell, gb, radius,
+                         dtype):
+        """(args, r_call) for the pruned kernel — ONE home for the
+        approximate routing, shared by run() and run_soa().
+
+        ``pxy_centered``/``pvalid``/``pcell``: the locality-sorted point
+        side (coords already centered). In both approximate modes the
+        kernel reads only bboxes, so dummy (M, 2, 2) verts/edge masks
+        ship instead of the real boundary arrays (saves O(M·V) per
+        window over the tunnel; the kernel's cand clamp keys on gbbox).
+        """
+        approx = self.conf.approximate_query
+        if approx:
+            geom = (
+                jnp.zeros((gb.capacity, 2, 2), np.float32),
+                jnp.zeros((gb.capacity, 1), bool),
+                jnp.asarray(gb.valid),
+            )
+        else:
+            geom = (
+                self.device_verts(gb.verts, dtype),
+                jnp.asarray(gb.edge_valid),
+                jnp.asarray(gb.valid),
+            )
+        if approx and self.approx_emit_all:
+            pxy_k, pvalid_k, gbbox_k = self._approx_cell_space(
+                pcell, pvalid, gb, radius
+            )
+            return (
+                (jnp.asarray(pxy_k), jnp.asarray(pvalid_k), *geom,
+                 jnp.asarray(gbbox_k)),
+                0.0,
+            )
+        return (
+            (jnp.asarray(pxy_centered), jnp.asarray(pvalid), *geom,
+             jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype))),
+            radius,
+        )
 
     def run(
         self,
@@ -595,9 +691,10 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
         )
+        approx = self.conf.approximate_query
         kernel = jitted(
             point_geometry_join_pruned_kernel,
-            "polygonal", "block", "cand", "max_pairs", "pair_cap",
+            "polygonal", "block", "cand", "max_pairs", "pair_cap", "approx",
         )
         for win in self.windows(merged):
             left_ev = [t.event for t in win.events if t.tag == 0]
@@ -613,13 +710,9 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             # at 131k on v5e); kernel indices map back through ho.
             # Contiguous sharding of the sorted points preserves locality.
             ho = np.argsort(lb.cell, kind="stable")
-            args = (
-                jnp.asarray(center_coords(self.grid, lb.xy[ho], dtype)),
-                jnp.asarray(lb.valid[ho]),
-                self.device_verts(gb.verts, dtype),
-                jnp.asarray(gb.edge_valid),
-                jnp.asarray(gb.valid),
-                jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype)),
+            args, r_call = self._point_side_args(
+                center_coords(self.grid, lb.xy[ho], dtype),
+                lb.valid[ho], lb.cell[ho], gb, radius, dtype,
             )
             if mesh is not None:
                 from spatialflink_tpu.parallel.sharded import (
@@ -628,16 +721,16 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
 
                 def call(cand, pair_cap, mp):
                     return sharded_point_geometry_join_pruned(
-                        mesh, *args, radius, polygonal=self.polygonal,
+                        mesh, *args, r_call, polygonal=self.polygonal,
                         block=self._point_block, cand=cand, max_pairs=mp,
-                        pair_cap=pair_cap,
+                        pair_cap=pair_cap, approx=approx,
                     )
             else:
                 def call(cand, pair_cap, mp):
                     return kernel(
-                        *args, radius, polygonal=self.polygonal,
+                        *args, r_call, polygonal=self.polygonal,
                         block=self._point_block, cand=cand, max_pairs=mp,
-                        pair_cap=pair_cap,
+                        pair_cap=pair_cap, approx=approx,
                     )
 
             li, ri, dd = self._pruned_block_pairs(call, gb.capacity)
@@ -663,9 +756,10 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         from spatialflink_tpu.operators.base import soa_point_batches
         from spatialflink_tpu.streams.soa import RaggedSoaWindowAssembler
 
+        approx = self.conf.approximate_query
         kernel = jitted(
             point_geometry_join_pruned_kernel,
-            "polygonal", "block", "cand", "max_pairs", "pair_cap",
+            "polygonal", "block", "cand", "max_pairs", "pair_cap", "approx",
         )
         gen_l = soa_point_batches(self.grid, point_chunks, self.conf, dtype)
         asm_r = RaggedSoaWindowAssembler(
@@ -689,19 +783,15 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 edge_valid_flat=wr.edge_valid, dtype=np.float64,
             )
             ho = np.argsort(lcell, kind="stable")  # host locality sort
-            args = (
-                jnp.asarray(np.asarray(lxy)[ho]),
-                jnp.asarray(np.asarray(lvalid)[ho]),
-                self.device_verts(gb.verts, dtype),
-                jnp.asarray(gb.edge_valid),
-                jnp.asarray(gb.valid),
-                jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype)),
+            args, r_call = self._point_side_args(
+                np.asarray(lxy)[ho], np.asarray(lvalid)[ho],
+                np.asarray(lcell)[ho], gb, radius, dtype,
             )
             li, ri, dd = self._pruned_block_pairs(
                 lambda cand, pair_cap, mp: kernel(
-                    *args, radius, polygonal=self.polygonal,
+                    *args, r_call, polygonal=self.polygonal,
                     block=self._point_block, cand=cand, max_pairs=mp,
-                    pair_cap=pair_cap,
+                    pair_cap=pair_cap, approx=approx,
                 ),
                 gb.capacity,
             )
@@ -757,16 +847,33 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             np.int64(1) << 40,
         )
         ho = np.argsort(key, kind="stable")
-        args = (
-            self.device_verts(la.verts[ho], dtype),
-            jnp.asarray(la.edge_valid[ho]),
-            jnp.asarray(la.valid[ho]),
-            jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype)),
-            self.device_verts(ra.verts, dtype),
-            jnp.asarray(ra.edge_valid),
-            jnp.asarray(ra.valid),
-            jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype)),
-        )
+        approx = self.conf.approximate_query
+        if approx:
+            # bbox↔bbox mode reads only the bbox arrays — ship dummy
+            # (N, 2, 2) verts instead of the real boundaries (saves
+            # O(N·V) per window over the tunnel; cand clamp keys on
+            # bbbox).
+            args = (
+                jnp.zeros((la.capacity, 2, 2), np.float32),
+                jnp.zeros((la.capacity, 1), bool),
+                jnp.asarray(la.valid[ho]),
+                jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype)),
+                jnp.zeros((ra.capacity, 2, 2), np.float32),
+                jnp.zeros((ra.capacity, 1), bool),
+                jnp.asarray(ra.valid),
+                jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype)),
+            )
+        else:
+            args = (
+                self.device_verts(la.verts[ho], dtype),
+                jnp.asarray(la.edge_valid[ho]),
+                jnp.asarray(la.valid[ho]),
+                jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype)),
+                self.device_verts(ra.verts, dtype),
+                jnp.asarray(ra.edge_valid),
+                jnp.asarray(ra.valid),
+                jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype)),
+            )
         if mesh is not None:
             from spatialflink_tpu.parallel.sharded import (
                 sharded_geometry_geometry_join_pruned,
@@ -778,7 +885,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                     a_polygonal=self.left_polygonal,
                     b_polygonal=self.right_polygonal,
                     block=self._geom_block, cand=cand, max_pairs=mp,
-                    pair_cap=pair_cap,
+                    pair_cap=pair_cap, approx=approx,
                 )
         else:
             def call(cand, pair_cap, mp):
@@ -787,7 +894,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                     a_polygonal=self.left_polygonal,
                     b_polygonal=self.right_polygonal,
                     block=self._geom_block, cand=cand, max_pairs=mp,
-                    pair_cap=pair_cap,
+                    pair_cap=pair_cap, approx=approx,
                 )
 
         li, ri, dd = self._pruned_block_pairs(call, ra.capacity)
@@ -809,7 +916,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         kernel = jitted(
             geometry_geometry_join_pruned_kernel,
             "a_polygonal", "b_polygonal", "block", "cand", "max_pairs",
-            "pair_cap",
+            "pair_cap", "approx",
         )
         for win in self.windows(merged):
             left_ev = [t.event for t in win.events if t.tag == 0]
@@ -844,7 +951,7 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         kernel = jitted(
             geometry_geometry_join_pruned_kernel,
             "a_polygonal", "b_polygonal", "block", "cand", "max_pairs",
-            "pair_cap",
+            "pair_cap", "approx",
         )
 
         def gen(chunks):
@@ -877,9 +984,12 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
 class PolygonPointJoinQuery(_PointGeometryJoinQuery):
     """join/PolygonPointJoinQuery.java — polygon stream ⋈ point queries;
     run() takes (point_stream, polygon_stream) transposed by the caller in
-    the reference; here the class swaps internally."""
+    the reference; here the class swaps internally. Approximate mode is
+    the bbox distance (getPointPolygonBBoxMinEuclideanDistance ≤ r), NOT
+    emit-all — that semantic belongs to the point-ordinary families."""
 
     polygonal = True
+    approx_emit_all = False
 
     def run(self, ordinary, query_stream, radius, dtype=np.float64,
             mesh=None):

@@ -61,6 +61,34 @@ class _PointStreamKNNQuery(SpatialOperator):
 
     query_kind = "point"
 
+    def _packed_query(self, query_obj):
+        """Query verts/edge mask used for DISTANCE evaluation.
+
+        In approximate mode (QueryConfiguration.approximate_query) a
+        polygon query is replaced by its closed bbox ring: point-in-rect
+        → 0, else min edge distance — exactly the reference's
+        getPointPolygonBBoxMinEuclideanDistance case analysis
+        (knn/PointPolygonKNNQuery.java:132-146, DistanceFunctions.java:
+        150-200), with zero kernel changes. A linestring query is
+        deliberately NOT substituted: the reference's "approximate"
+        branch calls getPointLineStringMinEuclideanDistance — the EXACT
+        point-to-segments distance (DistanceFunctions.java:87-90), so
+        approximate == exact there (quirk preserved; PARITY.md). A point
+        query has no approximate branch in the reference at all
+        (knn/PointPointKNNQuery.java reads but never uses the flag).
+        Cell flags always come from the ORIGINAL geometry — the
+        reference computes neighboring cells identically in both modes.
+        """
+        if self.conf.approximate_query and self.query_kind == "polygon":
+            x0, y0, x1, y1 = query_obj.bbox()
+            ring = np.asarray(
+                [[x0, y0], [x1, y0], [x1, y1], [x0, y1], [x0, y0]],
+                np.float64,
+            )
+            return ring, np.ones(4, bool)
+        verts, ev = pack_query_geometries([query_obj], np.float64)
+        return verts[0], ev[0]
+
     def run(
         self,
         stream: Iterable[Point],
@@ -93,8 +121,8 @@ class _PointStreamKNNQuery(SpatialOperator):
         if self.query_kind == "point":
             q = self.device_q([query_obj.x, query_obj.y], dtype)
         else:
-            verts, ev = pack_query_geometries([query_obj], np.float64)
-            qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
+            verts, ev = self._packed_query(query_obj)
+            qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
 
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
@@ -189,8 +217,8 @@ class _PointStreamKNNQuery(SpatialOperator):
                 cand=4096,
             )
         else:
-            verts, ev = pack_query_geometries([query_obj], np.float64)
-            qv, qe = self.device_q(verts[0], dtype), jnp.asarray(ev[0])
+            verts, ev = self._packed_query(query_obj)
+            qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
             digest_fn = functools.partial(
                 jitted(knn_pane_digest_geometry_compact,
                        "num_segments", "query_polygonal", "cand"),
@@ -556,6 +584,16 @@ class _GeometryStreamKNNQuery(SpatialOperator):
 
     stream_polygonal = True  # Polygon* subclasses; LineString* override
 
+    def _device_query_bbox(self, query_obj, dtype):
+        """Query bbox as a centered device (4,) array for approximate
+        mode — a Point query degenerates to [x, y, x, y], which reduces
+        bbox↔bbox to the reference's point↔bbox case analysis
+        (knn/PolygonPointKNNQuery.java:95)."""
+        from spatialflink_tpu.operators.join_query import _centered_bbox
+
+        bb = np.asarray([query_obj.bbox()], np.float64)
+        return jnp.asarray(_centered_bbox(self.grid, bb, dtype)[0])
+
     def _query_arrays(self, query_obj):
         """(qverts, qev, query_polygonal) — a Point query packs as a
         degenerate one-edge boundary. Shared by run() and run_soa()."""
@@ -582,6 +620,9 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         qverts, qev, query_polygonal = self._query_arrays(query_obj)
         qv = self.device_verts(qverts, dtype)
         qe = jnp.asarray(qev)
+        approx = self.conf.approximate_query
+        if approx:
+            qbb = self._device_query_bbox(query_obj, dtype)
 
         from spatialflink_tpu.models.batch import flag_prefix_planes
 
@@ -589,26 +630,50 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         for win in self.windows(stream):
             batch = self.geometry_batch(win.events, mesh=mesh)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            statics = dict(
-                k=k, num_segments=nseg,
-                obj_polygonal=self.stream_polygonal,
-                query_polygonal=query_polygonal,
-            )
-            kg = window_program(
-                mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
-                topk=True, **statics,
-            )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
-            res = kg(
-                self.device_verts(batch.verts, dtype),
-                jnp.asarray(batch.edge_valid),
-                jnp.asarray(batch.valid),
-                jnp.asarray(oflags),
-                jnp.asarray(batch.oid),
-                qv,
-                qe,
-                radius,
-            )
+            if approx:
+                # Approximate mode: bbox ↔ bbox distance (GeometryBatch
+                # already carries per-object bboxes), same candidate
+                # cells and radius/top-k contract as exact mode.
+                from spatialflink_tpu.operators.join_query import (
+                    _centered_bbox,
+                )
+                from spatialflink_tpu.ops.knn import knn_geometry_bbox_kernel
+
+                ka = window_program(
+                    mesh, knn_geometry_bbox_kernel, (0, 1, 2, 3), 6,
+                    topk=True, k=k, num_segments=nseg,
+                )
+                res = ka(
+                    jnp.asarray(
+                        _centered_bbox(self.grid, batch.bbox, dtype)
+                    ),
+                    jnp.asarray(batch.valid),
+                    jnp.asarray(oflags),
+                    jnp.asarray(batch.oid),
+                    qbb,
+                    radius,
+                )
+            else:
+                statics = dict(
+                    k=k, num_segments=nseg,
+                    obj_polygonal=self.stream_polygonal,
+                    query_polygonal=query_polygonal,
+                )
+                kg = window_program(
+                    mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
+                    topk=True, **statics,
+                )
+                res = kg(
+                    self.device_verts(batch.verts, dtype),
+                    jnp.asarray(batch.edge_valid),
+                    jnp.asarray(batch.valid),
+                    jnp.asarray(oflags),
+                    jnp.asarray(batch.oid),
+                    qv,
+                    qe,
+                    radius,
+                )
             nv = int(res.num_valid)
             neighbors = [
                 (
@@ -644,6 +709,16 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         qverts, qev, query_polygonal = self._query_arrays(query_obj)
         qv = self.device_verts(qverts, dtype)
         qe = jnp.asarray(qev)
+        approx = self.conf.approximate_query
+        if approx:
+            from spatialflink_tpu.operators.join_query import _centered_bbox
+            from spatialflink_tpu.ops.knn import knn_geometry_bbox_kernel
+
+            qbb = self._device_query_bbox(query_obj, dtype)
+            ka = functools.partial(
+                jitted(knn_geometry_bbox_kernel, "k", "num_segments"),
+                k=k, num_segments=num_segments,
+            )
         kg = functools.partial(
             jitted(
                 knn_geometry_query_kernel,
@@ -666,14 +741,25 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 edge_valid_flat=win.edge_valid, dtype=np.float64,
             )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
-            res = kg(
-                self.device_verts(batch.verts, dtype),
-                jnp.asarray(batch.edge_valid),
-                jnp.asarray(batch.valid),
-                jnp.asarray(oflags),
-                jnp.asarray(batch.oid),
-                qv, qe, radius,
-            )
+            if approx:
+                res = ka(
+                    jnp.asarray(
+                        _centered_bbox(self.grid, batch.bbox, dtype)
+                    ),
+                    jnp.asarray(batch.valid),
+                    jnp.asarray(oflags),
+                    jnp.asarray(batch.oid),
+                    qbb, radius,
+                )
+            else:
+                res = kg(
+                    self.device_verts(batch.verts, dtype),
+                    jnp.asarray(batch.edge_valid),
+                    jnp.asarray(batch.valid),
+                    jnp.asarray(oflags),
+                    jnp.asarray(batch.oid),
+                    qv, qe, radius,
+                )
             nv = int(res.num_valid)
             yield (
                 win.start, win.end,

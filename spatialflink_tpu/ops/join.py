@@ -551,6 +551,7 @@ def point_geometry_join_pruned_kernel(
     cand: int,
     max_pairs: int,
     pair_cap: int = 8,
+    approx: bool = False,
 ) -> PrunedJoinPairs:
     """Grid-pruned point ⋈ geometry join, device-extracted.
 
@@ -586,7 +587,8 @@ def point_geometry_join_pruned_kernel(
     # Static clamps: cand cannot exceed the geometry count, pair_cap
     # cannot exceed cand (an item's matches come from its tile's cand
     # list) — unclamped values would crash only on the top_k backends.
-    cand = min(cand, gverts.shape[0])
+    # Clamp keys on gbbox so approximate callers may pass dummy verts.
+    cand = min(cand, gbbox.shape[0])
     pair_cap = min(pair_cap, cand)
     n = pxy.shape[0]
     nb = -(-n // block)
@@ -605,19 +607,37 @@ def point_geometry_join_pruned_kernel(
         bbox, gbbox, gvalid, radius, cand
     )
 
-    cgv = gverts[gids]  # (NB, cand, V, 2)
-    cge = gev[gids]  # (NB, cand, V-1)
+    if approx:
+        # Approximate mode: per-pair distance = point → candidate's
+        # BOUNDING BOX (ops/distances.py:bbox_point_min_distance), the
+        # device form of the reference's approximateQuery branches
+        # (join/PolygonPointJoinQuery.java, getPoint*BBoxMinEuclidean-
+        # Distance). The operator also routes the point-ordinary
+        # "emit all grid candidates" semantics here by passing
+        # CELL-INDEX coordinates + layer-expanded cell boxes with
+        # radius 0 (see join_query._PointGeometryJoinQuery).
+        from spatialflink_tpu.ops.distances import bbox_point_min_distance
 
-    def one_geom(bxy, verts, ev):
-        d = point_polyline_distance(bxy, verts, ev)
-        if polygonal:
-            inside = points_in_polygon(bxy, verts, ev)
-            d = jnp.where(inside, jnp.zeros((), d.dtype), d)
-        return d
+        cgb = gbbox[gids]  # (NB, cand, 4)
+        dmat = bbox_point_min_distance(
+            bx[:, None, :, :], cgb[:, :, None, :]
+        )  # (NB, cand, block)
+    else:
+        cgv = gverts[gids]  # (NB, cand, V, 2)
+        cge = gev[gids]  # (NB, cand, V-1)
 
-    dmat = jax.vmap(
-        lambda bxy, gv, ge: jax.vmap(lambda v, e: one_geom(bxy, v, e))(gv, ge)
-    )(bx, cgv, cge)  # (NB, cand, block)
+        def one_geom(bxy, verts, ev):
+            d = point_polyline_distance(bxy, verts, ev)
+            if polygonal:
+                inside = points_in_polygon(bxy, verts, ev)
+                d = jnp.where(inside, jnp.zeros((), d.dtype), d)
+            return d
+
+        dmat = jax.vmap(
+            lambda bxy, gv, ge: jax.vmap(
+                lambda v, e: one_geom(bxy, v, e)
+            )(gv, ge)
+        )(bx, cgv, cge)  # (NB, cand, block)
 
     mask = (
         (dmat <= radius)
@@ -646,6 +666,7 @@ def geometry_geometry_join_pruned_kernel(
     cand: int,
     max_pairs: int,
     pair_cap: int = 8,
+    approx: bool = False,
 ) -> PrunedJoinPairs:
     """Grid-pruned geometry ⋈ geometry join, device-extracted.
 
@@ -661,7 +682,7 @@ def geometry_geometry_join_pruned_kernel(
     """
     from spatialflink_tpu.ops.range import geometry_pair_distance
 
-    cand = min(cand, bverts.shape[0])  # see point kernel's clamps
+    cand = min(cand, bbbox.shape[0])  # see point kernel's clamps
     pair_cap = min(pair_cap, cand)
     la = averts.shape[0]
     nb = -(-la // block)
@@ -687,25 +708,37 @@ def geometry_geometry_join_pruned_kernel(
         tile_bbox, bbbox, bvalid, radius, cand
     )
 
-    sav = jnp.pad(averts, ((0, pad), (0, 0), (0, 0)))
-    sae = jnp.pad(aev, ((0, pad), (0, 0)))
-    tav = sav.reshape(nb, block, averts.shape[1], 2)
-    tae = sae.reshape(nb, block, aev.shape[1])
-    cbv = bverts[gids]  # (NB, cand, Vb, 2)
-    cbe = bev[gids]
+    if approx:
+        # Approximate mode: per-pair distance = bbox ↔ bbox min distance
+        # (the reference's getBBoxBBoxMinEuclideanDistance branches in
+        # every geometry-geometry join, e.g.
+        # join/LineStringLineStringJoinQuery.java:173-180).
+        from spatialflink_tpu.ops.distances import bbox_bbox_min_distance
 
-    def pair_d(av, ae, bv, be):
-        return geometry_pair_distance(av, ae, bv, be, a_polygonal,
-                                      b_polygonal)
+        cbb = bbbox[gids]  # (NB, cand, 4)
+        dmat = bbox_bbox_min_distance(
+            t_bbox[:, None, :, :], cbb[:, :, None, :]
+        )  # (NB, cand, block)
+    else:
+        sav = jnp.pad(averts, ((0, pad), (0, 0), (0, 0)))
+        sae = jnp.pad(aev, ((0, pad), (0, 0)))
+        tav = sav.reshape(nb, block, averts.shape[1], 2)
+        tae = sae.reshape(nb, block, aev.shape[1])
+        cbv = bverts[gids]  # (NB, cand, Vb, 2)
+        cbe = bev[gids]
 
-    # (NB, cand, block): for each tile, candidate × member distances.
-    dmat = jax.vmap(
-        lambda avs, aes, bvs, bes: jax.vmap(
-            lambda bv, be: jax.vmap(
-                lambda av, ae: pair_d(av, ae, bv, be)
-            )(avs, aes)
-        )(bvs, bes)
-    )(tav, tae, cbv, cbe)
+        def pair_d(av, ae, bv, be):
+            return geometry_pair_distance(av, ae, bv, be, a_polygonal,
+                                          b_polygonal)
+
+        # (NB, cand, block): for each tile, candidate × member distances.
+        dmat = jax.vmap(
+            lambda avs, aes, bvs, bes: jax.vmap(
+                lambda bv, be: jax.vmap(
+                    lambda av, ae: pair_d(av, ae, bv, be)
+                )(avs, aes)
+            )(bvs, bes)
+        )(tav, tae, cbv, cbe)
 
     mask = (
         (dmat <= radius)

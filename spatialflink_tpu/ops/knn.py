@@ -576,3 +576,36 @@ def knn_geometry_query_kernel(
         dist, valid, flags, oid, radius, k, num_segments,
         axis_name=axis_name, index_base=index_base,
     )
+
+
+def knn_geometry_bbox_kernel(
+    obj_bbox: jnp.ndarray,
+    valid: jnp.ndarray,
+    flags: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_bbox: jnp.ndarray,
+    radius,
+    k: int,
+    num_segments: int,
+    axis_name=None,
+    index_base=None,
+) -> KnnResult:
+    """Geometry-stream kNN in APPROXIMATE mode: per-object distance is the
+    min distance between the object's bounding box and the query's
+    (``bbox_bbox_min_distance``) — the reference's approximateQuery
+    branches in every geometry-stream KNN variant
+    (knn/LineStringLineStringKNNQuery.java:95-110 getBBoxBBox...,
+    knn/PolygonPointKNNQuery.java:95 getPointPolygonBBox... — a Point
+    query packs as a degenerate [x, y, x, y] box, which reduces
+    bbox↔bbox to the reference's point↔bbox case analysis exactly).
+
+    ``obj_bbox``: (N, 4) minx,miny,maxx,maxy (GeometryBatch.bbox, centered
+    like the vertex coords); ``query_bbox``: (4,).
+    """
+    from spatialflink_tpu.ops.distances import bbox_bbox_min_distance
+
+    dist = bbox_bbox_min_distance(obj_bbox, query_bbox[None, :])
+    return _topk_from_point_dists(
+        dist, valid, flags, oid, radius, k, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )

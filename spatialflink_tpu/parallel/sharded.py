@@ -497,7 +497,8 @@ def sharded_join(
 
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_pg_join(mesh: Mesh, polygonal: bool, block: int,
-                            cand: int, max_pairs: int, pair_cap: int):
+                            cand: int, max_pairs: int, pair_cap: int,
+                            approx: bool = False):
     from spatialflink_tpu.ops.join import (
         PrunedJoinPairs,
         point_geometry_join_pruned_kernel,
@@ -507,7 +508,7 @@ def _cached_sharded_pg_join(mesh: Mesh, polygonal: bool, block: int,
         res = point_geometry_join_pruned_kernel(
             pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
             polygonal=polygonal, block=block, cand=cand,
-            max_pairs=max_pairs, pair_cap=pair_cap,
+            max_pairs=max_pairs, pair_cap=pair_cap, approx=approx,
         )
         base = jax.lax.axis_index("data") * pxy.shape[0]
         left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
@@ -533,7 +534,7 @@ def sharded_point_geometry_join_pruned(
     mesh: Mesh,
     pxy, pvalid, gverts, gev, gvalid, gbbox, radius,
     polygonal: bool, block: int, cand: int, max_pairs: int,
-    pair_cap: int = 8,
+    pair_cap: int = 8, approx: bool = False,
 ):
     """Multi-chip grid-pruned point ⋈ geometry join: the (host-locality-
     sorted) point side shards over ``data``, the geometry batch
@@ -547,14 +548,14 @@ def sharded_point_geometry_join_pruned(
     counters are psum-replicated. Bit-parity with single-device up to
     pair order (tests/test_join_pruned.py)."""
     return _cached_sharded_pg_join(
-        mesh, polygonal, block, cand, max_pairs, pair_cap
+        mesh, polygonal, block, cand, max_pairs, pair_cap, approx
     )(pxy, pvalid, gverts, gev, gvalid, gbbox, radius)
 
 
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
                             block: int, cand: int, max_pairs: int,
-                            pair_cap: int):
+                            pair_cap: int, approx: bool = False):
     from spatialflink_tpu.ops.join import (
         PrunedJoinPairs,
         geometry_geometry_join_pruned_kernel,
@@ -565,6 +566,7 @@ def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
             averts, aev, avalid, abbox, bverts, bev, bvalid, bbox, radius,
             a_polygonal=a_polygonal, b_polygonal=b_polygonal,
             block=block, cand=cand, max_pairs=max_pairs, pair_cap=pair_cap,
+            approx=approx,
         )
         base = jax.lax.axis_index("data") * averts.shape[0]
         left = jnp.where(res.left_index >= 0, res.left_index + base, -1)
@@ -593,10 +595,12 @@ def sharded_geometry_geometry_join_pruned(
     averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius,
     a_polygonal: bool, b_polygonal: bool,
     block: int, cand: int, max_pairs: int, pair_cap: int = 8,
+    approx: bool = False,
 ):
     """Multi-chip grid-pruned geometry ⋈ geometry join — left side (host-
     locality-sorted) sharded over ``data``, right side replicated; same
     contracts as sharded_point_geometry_join_pruned."""
     return _cached_sharded_gg_join(
-        mesh, a_polygonal, b_polygonal, block, cand, max_pairs, pair_cap
+        mesh, a_polygonal, b_polygonal, block, cand, max_pairs, pair_cap,
+        approx,
     )(averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius)

@@ -1,0 +1,351 @@
+"""Approximate-query mode parity (QueryConfiguration.approximate_query).
+
+The reference honors ``approximateQuery`` in all three operator families:
+
+- Point-ordinary joins emit ALL grid candidates with no distance filter
+  (join/PointPointJoinQuery.java:164-166, PointPolygonJoinQuery.java:131).
+- Geometry-ordinary joins use bbox min-distances instead of exact JTS
+  distances (join/LineStringLineStringJoinQuery.java:173-180,
+  PolygonPointJoinQuery.java getPointPolygonBBoxMinEuclideanDistance).
+- kNN variants swap the ranking distance for the bbox distance
+  (knn/PointPolygonKNNQuery.java:132-146,
+  knn/LineStringLineStringKNNQuery.java:95-110); PointPoint ignores the
+  flag; PointLineString's "approximate" calls the EXACT point-to-segments
+  distance (DistanceFunctions.java:87-90) — quirk preserved.
+
+Each test checks the operator output against an independent numpy oracle
+of the reference semantics.
+"""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import LineString, Point, Polygon
+from spatialflink_tpu.operators import QueryConfiguration, QueryType
+from spatialflink_tpu.operators.join_query import (
+    LineStringLineStringJoinQuery,
+    PointPointJoinQuery,
+    PointPolygonJoinQuery,
+    PolygonPointJoinQuery,
+)
+from spatialflink_tpu.operators.knn_query import (
+    PointLineStringKNNQuery,
+    PointPolygonKNNQuery,
+    PolygonPolygonKNNQuery,
+)
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(77)
+
+
+def _conf(**kw):
+    kw.setdefault("window_size", 30)
+    kw.setdefault("slide_step", 30)
+    return QueryConfiguration(QueryType.WindowBased, approximate_query=True, **kw)
+
+
+def _points(rng, n, t_span=29_000):
+    xy = rng.uniform(0, 10, (n, 2))
+    return [
+        Point(obj_id=f"p{i}", timestamp=int(i * t_span / n),
+              x=float(xy[i, 0]), y=float(xy[i, 1]))
+        for i in range(n)
+    ]
+
+
+def _square(cx, cy, r):
+    return np.array([
+        [cx - r, cy - r], [cx + r, cy - r], [cx + r, cy + r],
+        [cx - r, cy + r], [cx - r, cy - r],
+    ])
+
+
+def _polygons(rng, m, t_span=29_000, size=0.3):
+    out = []
+    for i in range(m):
+        cx, cy = rng.uniform(1.0, 9.0, 2)
+        out.append(Polygon(
+            obj_id=f"g{i}", timestamp=int(i * t_span / m),
+            rings=[_square(float(cx), float(cy), size)],
+        ))
+    return out
+
+
+def _linestrings(rng, m, t_span=29_000, prefix="l"):
+    out = []
+    for i in range(m):
+        x0, y0 = rng.uniform(1.0, 8.5, 2)
+        pts = np.stack([
+            np.linspace(x0, x0 + 0.9, 5),
+            y0 + 0.3 * np.sin(np.linspace(0.0, 3.0, 5)),
+        ], axis=1)
+        out.append(LineString(obj_id=f"{prefix}{i}",
+                              timestamp=int(i * t_span / m), coords=pts))
+    return out
+
+
+def _bbox_point_dist(px, py, bb):
+    dx = max(max(bb[0] - px, 0.0), px - bb[2])
+    dy = max(max(bb[1] - py, 0.0), py - bb[3])
+    return float(np.hypot(dx, dy))
+
+
+def _bbox_bbox_dist(a, b):
+    dx = max(max(b[0] - a[2], 0.0), a[0] - b[2])
+    dy = max(max(b[1] - a[3], 0.0), a[1] - b[3])
+    return float(np.hypot(dx, dy))
+
+
+def _cell_idx(p):
+    xi = int(np.floor((p.x - GRID.min_x) / GRID.cell_length))
+    yi = int(np.floor((p.y - GRID.min_y) / GRID.cell_length))
+    return xi, yi
+
+
+# ---------------------------------------------------------------- joins
+
+
+def test_pointpoint_join_approx_emits_all_grid_candidates(rng):
+    """Approximate PointPoint join = every pair whose cells are within
+    the candidate-layer Chebyshev square, regardless of distance."""
+    radius = 0.7
+    L = GRID.candidate_layers(radius)
+    left = _points(rng, 150)
+    right = [
+        Point(obj_id=f"q{i}", timestamp=p.timestamp, x=p.x, y=p.y)
+        for i, p in enumerate(_points(rng, 60))
+    ]
+    res = list(PointPointJoinQuery(_conf(), GRID).run(
+        iter(left), iter(right), radius))
+    got = {(a.obj_id, b.obj_id) for r in res for a, b, _ in r.pairs}
+
+    expect = set()
+    for a in left:
+        ax, ay = _cell_idx(a)
+        for b in right:
+            bx, by = _cell_idx(b)
+            if max(abs(ax - bx), abs(ay - by)) <= L:
+                expect.add((a.obj_id, b.obj_id))
+    assert got == expect
+    # sanity: approximate must be a strict superset of the exact join
+    exact = {
+        (a.obj_id, b.obj_id)
+        for a in left for b in right
+        if np.hypot(a.x - b.x, a.y - b.y) <= radius
+    }
+    assert exact < got
+
+
+def test_pointpoint_join_naive_approx_all_pairs(rng):
+    """RealTimeNaive approximate = every pair in the window
+    (PointPointJoinQuery.java:216, no grid, no filter)."""
+    conf = QueryConfiguration(
+        QueryType.RealTimeNaive, realtime_batch_ms=30_000,
+        approximate_query=True,
+    )
+    left = _points(rng, 40)
+    right = _points(rng, 15)
+    right = [Point(obj_id=f"q{i}", timestamp=p.timestamp, x=p.x, y=p.y)
+             for i, p in enumerate(right)]
+    res = list(PointPointJoinQuery(conf, GRID).run(
+        iter(left), iter(right), 0.1))
+    got = {(a.obj_id, b.obj_id) for r in res for a, b, _ in r.pairs}
+    assert len(got) == 40 * 15
+
+
+def test_point_polygon_join_approx_emit_all_cells(rng):
+    """Approximate point⋈polygon = point's cell inside the polygon's
+    layer-expanded bbox-cell rectangle (reference replication set)."""
+    radius = 0.6
+    L = GRID.candidate_layers(radius)
+    pts = _points(rng, 200)
+    polys = _polygons(rng, 12)
+    res = list(PointPolygonJoinQuery(_conf(), GRID).run(
+        iter(pts), iter(polys), radius))
+    got = {(a.obj_id, b.obj_id) for r in res for a, b, _ in r.pairs}
+
+    expect = set()
+    for g in polys:
+        x0, y0, x1, y1 = g.bbox()
+        cx0 = np.floor((x0 - GRID.min_x) / GRID.cell_length) - L
+        cy0 = np.floor((y0 - GRID.min_y) / GRID.cell_length) - L
+        cx1 = np.floor((x1 - GRID.min_x) / GRID.cell_length) + L
+        cy1 = np.floor((y1 - GRID.min_y) / GRID.cell_length) + L
+        for p in pts:
+            xi, yi = _cell_idx(p)
+            if cx0 <= xi <= cx1 and cy0 <= yi <= cy1:
+                expect.add((p.obj_id, g.obj_id))
+    assert got == expect
+    exact_pairs = {
+        (a.obj_id, b.obj_id)
+        for r in list(PointPolygonJoinQuery(
+            QueryConfiguration(QueryType.WindowBased, window_size=30,
+                               slide_step=30), GRID,
+        ).run(iter(pts), iter(polys), radius))
+        for a, b, _ in r.pairs
+    }
+    assert exact_pairs <= got
+
+
+def test_polygon_point_join_approx_bbox_distance(rng):
+    """Approximate polygon-ordinary ⋈ point query = point-to-polygon-BBOX
+    min distance ≤ r (NOT emit-all)."""
+    radius = 0.8
+    polys = _polygons(rng, 15)
+    pts = _points(rng, 80)
+    res = list(PolygonPointJoinQuery(_conf(), GRID).run(
+        iter(polys), iter(pts), radius))
+    got = {(a.obj_id, b.obj_id): d for r in res for a, b, d in r.pairs}
+
+    expect = {}
+    for g in polys:
+        bb = g.bbox()
+        for p in pts:
+            d = _bbox_point_dist(p.x, p.y, bb)
+            if d <= radius:
+                expect[(g.obj_id, p.obj_id)] = d
+    assert set(got) == set(expect)
+    for k, d in expect.items():
+        assert got[k] == pytest.approx(d, abs=1e-9)
+
+
+def test_linestring_join_approx_bbox_bbox(rng):
+    """Approximate geometry⋈geometry = bbox↔bbox min distance ≤ r."""
+    radius = 0.5
+    a = _linestrings(rng, 25, prefix="a")
+    b = _linestrings(rng, 18, prefix="b")
+    res = list(LineStringLineStringJoinQuery(_conf(), GRID).run(
+        iter(a), iter(b), radius))
+    got = {(x.obj_id, y.obj_id): d for r in res for x, y, d in r.pairs}
+
+    expect = {}
+    for la in a:
+        for lb in b:
+            d = _bbox_bbox_dist(la.bbox(), lb.bbox())
+            if d <= radius:
+                expect[(la.obj_id, lb.obj_id)] = d
+    assert set(got) == set(expect)
+    for k, d in expect.items():
+        assert got[k] == pytest.approx(d, abs=1e-9)
+
+
+# ---------------------------------------------------------------- kNN
+
+
+def test_knn_point_polygon_approx_bbox_distance(rng):
+    """Approximate PointPolygon kNN ranks by point→query-bbox distance
+    (0 inside the bbox)."""
+    radius, k = 4.0, 5
+    pts = _points(rng, 120)
+    query = Polygon(rings=[np.array(
+        [[4.0, 4.0], [6.0, 4.2], [5.0, 6.5], [4.0, 4.0]])])
+    res = list(PointPolygonKNNQuery(_conf(window_size=30, slide_step=30),
+                                    GRID).run(iter(pts), query, radius, k))
+    first = res[0]
+    bb = query.bbox()
+    win_pts = [p for p in pts if first.start <= p.timestamp < first.end]
+    # oracle: per obj_id min bbox distance, then k smallest within radius
+    best = {}
+    for p in win_pts:
+        d = _bbox_point_dist(p.x, p.y, bb)
+        if d <= radius:
+            best[p.obj_id] = min(best.get(p.obj_id, np.inf), d)
+    expect = sorted(best.items(), key=lambda kv: kv[1])[:k]
+    got = [(oid, d) for oid, d, _ in first.neighbors]
+    assert [o for o, _ in got] == [o for o, _ in expect]
+    for (_, dg), (_, de) in zip(got, expect):
+        assert dg == pytest.approx(de, abs=1e-9)
+
+
+def test_knn_point_linestring_approx_equals_exact(rng):
+    """Reference quirk: PointLineString's approximate branch calls the
+    EXACT point-to-segments distance, so the flag changes nothing."""
+    pts = _points(rng, 100)
+    ls = LineString(coords=np.array([[2.0, 2.0], [5.0, 3.0], [8.0, 2.5]]))
+    kw = dict(window_size=30, slide_step=30)
+    exact = list(PointLineStringKNNQuery(
+        QueryConfiguration(QueryType.WindowBased, **kw), GRID,
+    ).run(iter(pts), ls, 3.0, 4))
+    approx = list(PointLineStringKNNQuery(_conf(**kw), GRID).run(
+        iter(pts), ls, 3.0, 4))
+    assert [
+        [(o, d) for o, d, _ in r.neighbors] for r in exact
+    ] == [
+        [(o, d) for o, d, _ in r.neighbors] for r in approx
+    ]
+
+
+def test_knn_geometry_stream_approx_bbox_bbox(rng):
+    """Approximate geometry-stream kNN ranks by bbox↔bbox distance."""
+    radius, k = 5.0, 4
+    polys = _polygons(rng, 40)
+    query = Polygon(rings=[_square(5.0, 5.0, 0.8)])
+    res = list(PolygonPolygonKNNQuery(_conf(), GRID).run(
+        iter(polys), query, radius, k))
+    first = res[0]
+    qb = query.bbox()
+    wins = [g for g in polys if first.start <= g.timestamp < first.end]
+    best = {}
+    for g in wins:
+        d = _bbox_bbox_dist(g.bbox(), qb)
+        if d <= radius:
+            best[g.obj_id] = min(best.get(g.obj_id, np.inf), d)
+    expect = sorted(best.items(), key=lambda kv: kv[1])[:k]
+    got = [(oid, d) for oid, d, _ in first.neighbors]
+    assert [o for o, _ in got] == [o for o, _ in expect]
+    for (_, dg), (_, de) in zip(got, expect):
+        assert dg == pytest.approx(de, abs=1e-9)
+
+
+def test_pane_knn_polygon_approx_matches_run(rng):
+    """query_panes must honor approximate mode identically to run()."""
+    pts = _points(rng, 150, t_span=25_000)
+    query = Polygon(rings=[np.array(
+        [[3.0, 3.0], [7.0, 3.5], [5.0, 7.0], [3.0, 3.0]])])
+    kw = dict(window_size=10, slide_step=5)
+    op_r = PointPolygonKNNQuery(_conf(**kw), GRID)
+    op_p = PointPolygonKNNQuery(_conf(**kw), GRID)
+    runs = list(op_r.run(iter(pts), query, 4.0, 3))
+    panes = list(op_p.query_panes(iter(pts), query, 4.0, 3))
+    key = lambda rs: [
+        (r.start, r.end, [(o, round(d, 12)) for o, d, _ in r.neighbors])
+        for r in rs
+    ]
+    assert key(runs) == key(panes)
+
+
+def test_knn_soa_geometry_approx_matches_run(rng):
+    """run_soa must honor approximate mode (bbox kernel) identically."""
+    polys = _polygons(rng, 40)
+    query = Polygon(rings=[_square(5.0, 5.0, 0.8)])
+    op = PolygonPolygonKNNQuery(_conf(), GRID)
+    runs = list(op.run(iter(polys), query, 5.0, 4))
+
+    op2 = PolygonPolygonKNNQuery(_conf(), GRID)
+    # one chunk of ragged SoA data; intern ids to match op2's interner
+    oid = op2.interner.intern_many(g.obj_id for g in polys)
+    lengths = np.array([len(g.rings[0]) for g in polys])
+    verts = np.concatenate([g.rings[0] for g in polys], axis=0)
+    chunk = {
+        "ts": np.array([g.timestamp for g in polys], np.int64),
+        "oid": oid,
+        "lengths": lengths,
+        "verts": verts,
+        "edge_valid": np.concatenate(
+            [np.ones(len(g.rings[0]) - 1, bool) for g in polys]),
+    }
+    soa = list(op2.run_soa(iter([chunk]), query, 5.0, 4, num_segments=64))
+    assert len(soa) == len(runs)
+    for r, (start, end, segs, dists, nv) in zip(runs, soa):
+        assert (r.start, r.end) == (start, end)
+        got = [(op2.interner.lookup(int(s)), float(d))
+               for s, d in zip(segs, dists)]
+        expect = [(o, d) for o, d, _ in r.neighbors]
+        assert [o for o, _ in got] == [o for o, _ in expect]
+        for (_, dg), (_, de) in zip(got, expect):
+            assert dg == pytest.approx(de, abs=1e-9)
