@@ -529,14 +529,27 @@ def _aligned_soa_windows(gen_l, gen_r, start_l, start_r):
             wr = next(gen_r, None)
 
 
-def _centered_bbox(grid, bbox: np.ndarray, dtype) -> np.ndarray:
+def _centered_bbox(grid, bbox: np.ndarray, dtype, pad: bool = True) -> np.ndarray:
     """Center a (N, 4) minx,miny,maxx,maxy array the way device
     coordinates are centered (operators/base.py:center_coords) so bbox
-    pruning compares in the same frame as the vertex/point coords."""
+    pruning compares in the same frame as the vertex/point coords.
+
+    With ``pad`` (the pruning call sites), sub-f64 outputs are padded
+    OUTWARD by one ulp per corner: bbox corners round independently of
+    the vertex coords, so a sub-ulp-shrunk expanded box could in
+    principle prune a geometry exactly at the radius boundary that the
+    dense kernel keeps — padding makes bbox rounding strictly
+    over-inclusive (pruning is a superset filter; exactness is decided
+    by the distance kernel). Approximate-mode call sites pass
+    ``pad=False``: there the boxes ARE the distance operands, and
+    inflating them would bias every reported bbox distance low."""
     from spatialflink_tpu.operators.base import center_coords
 
     mins = center_coords(grid, bbox[:, 0:2], dtype)
     maxs = center_coords(grid, bbox[:, 2:4], dtype)
+    if pad and mins.dtype != np.float64:
+        mins = np.nextafter(mins, -np.inf)
+        maxs = np.nextafter(maxs, np.inf)
     return np.concatenate([mins, maxs], axis=1)
 
 
@@ -639,16 +652,19 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         gbbox = np.stack([bx1, by1, bx2, by2], axis=1)
         return pxy, pvalid, gbbox
 
-    def _point_side_args(self, pxy_centered, pvalid, pcell, gb, radius,
-                         dtype):
+    def _point_side_args(self, pxy_fn, pvalid, pcell, gb, radius, dtype):
         """(args, r_call) for the pruned kernel — ONE home for the
         approximate routing, shared by run() and run_soa().
 
-        ``pxy_centered``/``pvalid``/``pcell``: the locality-sorted point
-        side (coords already centered). In both approximate modes the
-        kernel reads only bboxes, so dummy (M, 2, 2) verts/edge masks
-        ship instead of the real boundary arrays (saves O(M·V) per
-        window over the tunnel; the kernel's cand clamp keys on gbbox).
+        ``pxy_fn``: zero-arg callable producing the locality-sorted
+        CENTERED point coords — lazy because the emit-all mode replaces
+        them with cell indices and must not pay the O(N) centering.
+        In both approximate modes the kernel reads only bboxes, so dummy
+        (M, 2, 2) verts/edge masks ship instead of the real boundary
+        arrays (saves O(M·V) per window over the tunnel; the kernel's
+        cand clamp keys on gbbox). Exact mode pads the pruning boxes
+        outward one ulp (sub-f64); approximate-bbox mode does NOT — its
+        boxes are the distance operands.
         """
         approx = self.conf.approximate_query
         if approx:
@@ -673,8 +689,9 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
                 0.0,
             )
         return (
-            (jnp.asarray(pxy_centered), jnp.asarray(pvalid), *geom,
-             jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype))),
+            (jnp.asarray(pxy_fn()), jnp.asarray(pvalid), *geom,
+             jnp.asarray(_centered_bbox(self.grid, gb.bbox, dtype,
+                                        pad=not approx))),
             radius,
         )
 
@@ -711,7 +728,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             # Contiguous sharding of the sorted points preserves locality.
             ho = np.argsort(lb.cell, kind="stable")
             args, r_call = self._point_side_args(
-                center_coords(self.grid, lb.xy[ho], dtype),
+                lambda: center_coords(self.grid, lb.xy[ho], dtype),
                 lb.valid[ho], lb.cell[ho], gb, radius, dtype,
             )
             if mesh is not None:
@@ -784,7 +801,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             )
             ho = np.argsort(lcell, kind="stable")  # host locality sort
             args, r_call = self._point_side_args(
-                np.asarray(lxy)[ho], np.asarray(lvalid)[ho],
+                lambda: np.asarray(lxy)[ho], np.asarray(lvalid)[ho],
                 np.asarray(lcell)[ho], gb, radius, dtype,
             )
             li, ri, dd = self._pruned_block_pairs(
@@ -852,16 +869,18 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             # bbox↔bbox mode reads only the bbox arrays — ship dummy
             # (N, 2, 2) verts instead of the real boundaries (saves
             # O(N·V) per window over the tunnel; cand clamp keys on
-            # bbbox).
+            # bbbox). pad=False: these boxes are the distance operands.
             args = (
                 jnp.zeros((la.capacity, 2, 2), np.float32),
                 jnp.zeros((la.capacity, 1), bool),
                 jnp.asarray(la.valid[ho]),
-                jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype)),
+                jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype,
+                                           pad=False)),
                 jnp.zeros((ra.capacity, 2, 2), np.float32),
                 jnp.zeros((ra.capacity, 1), bool),
                 jnp.asarray(ra.valid),
-                jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype)),
+                jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype,
+                                           pad=False)),
             )
         else:
             args = (

@@ -592,7 +592,8 @@ class _GeometryStreamKNNQuery(SpatialOperator):
         from spatialflink_tpu.operators.join_query import _centered_bbox
 
         bb = np.asarray([query_obj.bbox()], np.float64)
-        return jnp.asarray(_centered_bbox(self.grid, bb, dtype)[0])
+        # pad=False: this box is the distance operand, not a prune box.
+        return jnp.asarray(_centered_bbox(self.grid, bb, dtype, pad=False)[0])
 
     def _query_arrays(self, query_obj):
         """(qverts, qev, query_polygonal) — a Point query packs as a
@@ -646,7 +647,8 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                 )
                 res = ka(
                     jnp.asarray(
-                        _centered_bbox(self.grid, batch.bbox, dtype)
+                        _centered_bbox(self.grid, batch.bbox, dtype,
+                                       pad=False)
                     ),
                     jnp.asarray(batch.valid),
                     jnp.asarray(oflags),
@@ -744,7 +746,8 @@ class _GeometryStreamKNNQuery(SpatialOperator):
             if approx:
                 res = ka(
                     jnp.asarray(
-                        _centered_bbox(self.grid, batch.bbox, dtype)
+                        _centered_bbox(self.grid, batch.bbox, dtype,
+                                       pad=False)
                     ),
                     jnp.asarray(batch.valid),
                     jnp.asarray(oflags),

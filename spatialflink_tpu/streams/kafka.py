@@ -131,19 +131,43 @@ def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
                 )
             ts = mod.EARLIEST if from_earliest else mod.LATEST
             offsets = {p: client.list_offset(topic, p, ts) for p in parts}
+            single = len(parts) == 1
             while True:
                 progressed = False
+                # Merge each fetch round across partitions by message
+                # timestamp: a fixed round-robin yield would interleave
+                # partitions out of event-time order, and the pane paths
+                # (query_panes rejects allowed_lateness) would silently
+                # drop such records as late. Cost: a round's records are
+                # held until every partition's fetch returns (idle
+                # partitions long-poll max_wait_ms) — inherent to
+                # cross-partition ordering, so the single-partition
+                # common case bypasses the buffer entirely. The sort is
+                # stable, so a partition's producer order survives for
+                # equal/monotone timestamps; full ordering guarantees
+                # still need allowed_lateness via run() — same contract
+                # as any multi-partition consumer.
+                round_msgs: list = []
                 for p in parts:
                     msgs, _hw = client.fetch(topic, p, offsets[p])
-                    for off, _ts, _key, value in msgs:
+                    for off, ts_ms, _key, value in msgs:
                         offsets[p] = off + 1
                         progressed = True
                         if value is None:
                             continue
-                        try:
-                            yield parser(value.decode())
-                        except (ValueError, IndexError):
-                            continue
+                        if single:
+                            try:
+                                yield parser(value.decode())
+                            except (ValueError, IndexError):
+                                pass
+                        else:
+                            round_msgs.append((ts_ms, value))
+                round_msgs.sort(key=lambda m: m[0])
+                for _ts, value in round_msgs:
+                    try:
+                        yield parser(value.decode())
+                    except (ValueError, IndexError):
+                        continue
                 if not progressed:
                     # fetch() already long-polled max_wait_ms per partition;
                     # loop again (a live stream source never terminates —
@@ -177,11 +201,33 @@ class KafkaSink:
             self._producer = mod.Producer({"bootstrap.servers": bootstrap_servers})
             self._send = lambda v: self._producer.produce(self.topic, v)
         else:
+            import weakref
+
             self._client = mod.KafkaWireClient(bootstrap_servers)
             self._partition = partition
             self._batch = batch
             self._buf: list = []
             self._send = self._buffer_send
+            # The wire backend has no producer thread: records sit in
+            # _buf until flush()/close(). Guarantee delivery even if the
+            # owner drops the sink without closing (library backends
+            # flush via their own threads) — the finalizer flushes at GC
+            # or interpreter exit. close() detaches it.
+            self._finalizer = weakref.finalize(
+                self, KafkaSink._final_flush,
+                self._client, self.topic, partition, self._buf,
+            )
+
+    @staticmethod
+    def _final_flush(client, topic, partition, buf):
+        # Bound object state only (weakref.finalize contract: no self).
+        try:
+            if buf:
+                client.produce(topic, partition, list(buf))
+                buf.clear()
+            client.close()
+        except Exception:
+            pass  # interpreter teardown: sockets may already be gone
 
     def _buffer_send(self, value: bytes) -> None:
         import time as _time
@@ -198,9 +244,16 @@ class KafkaSink:
             self._producer.flush()
         elif self._buf:
             self._client.produce(self.topic, self._partition, self._buf)
-            self._buf = []
+            self._buf.clear()  # in place: the finalizer holds this list
 
     def close(self):
         self.flush()
         if self._kind == "wire":
+            self._finalizer.detach()
             self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -186,7 +186,17 @@ def decode_message_set(data: bytes) -> List[Tuple[int, int, Optional[bytes],
 
     A fetch response may end with a PARTIAL message (the broker truncates
     at max_bytes) — stop cleanly there. Message format v0 (magic 0, no
-    timestamp → -1) and v1 both decode."""
+    timestamp → -1) and v1 both decode.
+
+    GZIP-compressed sets (attributes codec 1) decode transparently: the
+    wrapper's value is itself a message set, recursively decoded. With
+    magic v1 wrappers the inner offsets are RELATIVE (KIP-31: wrapper
+    offset = absolute offset of the LAST inner message) and a
+    LogAppendTime wrapper (attr bit 0x08) overrides every inner
+    timestamp — both per the Kafka message-format spec. Snappy/LZ4
+    message sets still raise (those codecs need external libraries;
+    the reference gets them via the Flink Kafka connector's client,
+    pom.xml:81)."""
     out = []
     r = Reader(data)
     while r.remaining() >= 12:
@@ -201,16 +211,28 @@ def decode_message_set(data: bytes) -> List[Tuple[int, int, Optional[bytes],
             raise ValueError(f"Kafka message CRC mismatch at offset {offset}")
         magic = msg.int8()
         attrs = msg.int8()
-        if attrs & 0x07:
-            raise NotImplementedError(
-                "compressed Kafka message sets are not supported by the "
-                "built-in client (produce uncompressed, or install "
-                "kafka-python)"
-            )
+        codec = attrs & 0x07
         ts = msg.int64() if magic >= 1 else -1
         key = msg.bytes_()
         value = msg.bytes_()
-        out.append((offset, ts, key, value))
+        if codec == 0:
+            out.append((offset, ts, key, value))
+            continue
+        if codec != 1 or value is None:
+            name = {2: "snappy", 3: "lz4", 4: "zstd"}.get(codec, str(codec))
+            raise NotImplementedError(
+                f"{name}-compressed Kafka message sets are not supported "
+                "by the built-in client (gzip decodes natively; for other "
+                "codecs produce uncompressed or install kafka-python)"
+            )
+        # wbits=47: auto-detect gzip or zlib framing.
+        inner = decode_message_set(zlib.decompress(value, 47))
+        if magic >= 1 and inner:
+            base = offset - inner[-1][0]
+            inner = [(base + o, t, k, v) for o, t, k, v in inner]
+        if magic >= 1 and (attrs & 0x08) and ts >= 0:
+            inner = [(o, ts, k, v) for o, _, k, v in inner]
+        out.extend(inner)
     return out
 
 
@@ -240,7 +262,14 @@ class KafkaWireClient:
                  timeout_s: float = 15.0):
         self.bootstrap: List[Tuple[str, int]] = []
         for hp in bootstrap_servers.split(","):
-            host, _, port = hp.strip().rpartition(":")
+            hp = hp.strip()
+            if hp.startswith("["):  # bracketed IPv6: [::1]:9092 or [::1]
+                host, _, rest = hp[1:].partition("]")
+                port = rest.lstrip(":") or "9092"
+            elif ":" in hp:
+                host, _, port = hp.rpartition(":")
+            else:  # bare hostname → Kafka's default port
+                host, port = hp, "9092"
             self.bootstrap.append((host or "localhost", int(port)))
         self.client_id = client_id
         self.timeout_s = timeout_s

@@ -349,3 +349,76 @@ def test_knn_soa_geometry_approx_matches_run(rng):
         assert [o for o, _ in got] == [o for o, _ in expect]
         for (_, dg), (_, de) in zip(got, expect):
             assert dg == pytest.approx(de, abs=1e-9)
+
+
+# ------------------------------------------------------- 8-device mesh
+
+
+@pytest.fixture
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs.reshape(8), ("data",))
+
+
+def _pair_key(results):
+    return sorted(
+        (r.start, a.obj_id, b.obj_id, round(float(d), 9))
+        for r in results for a, b, d in r.pairs
+    )
+
+
+def test_point_polygon_join_approx_mesh_matches_single(rng, mesh):
+    """CLAUDE.md sharding invariant: the emit-all approx path must be
+    bit-identical on the 8-device mesh (cell-space coords shard over
+    data like any point side)."""
+    pts = _points(rng, 160)
+    polys = _polygons(rng, 10)
+
+    def run(m):
+        return list(PointPolygonJoinQuery(_conf(), GRID, mesh=m).run(
+            iter(list(pts)), iter(list(polys)), 0.6))
+
+    assert _pair_key(run(None)) == _pair_key(run(mesh))
+    assert _pair_key(run(mesh))  # non-empty
+
+
+def test_linestring_join_approx_mesh_matches_single(rng, mesh):
+    a = _linestrings(rng, 24, prefix="a")
+    b = _linestrings(rng, 16, prefix="b")
+
+    def run(m):
+        return list(LineStringLineStringJoinQuery(_conf(), GRID, mesh=m).run(
+            iter(list(a)), iter(list(b)), 0.5))
+
+    assert _pair_key(run(None)) == _pair_key(run(mesh))
+
+
+def test_knn_geometry_approx_mesh_matches_single(rng, mesh):
+    polys = _polygons(rng, 40)
+    query = Polygon(rings=[_square(5.0, 5.0, 0.8)])
+
+    def run(m):
+        return list(PolygonPolygonKNNQuery(_conf(), GRID, mesh=m).run(
+            iter(list(polys)), query, 5.0, 4))
+
+    key = lambda rs: [
+        (r.start, r.end, [(o, round(float(d), 12)) for o, d, _ in r.neighbors])
+        for r in rs
+    ]
+    assert key(run(None)) == key(run(mesh))
+    assert any(r.neighbors for r in run(mesh))
+
+
+def test_pointpoint_join_approx_mesh_matches_single(rng, mesh):
+    pts = _points(rng, 120)
+    qpts = [Point(obj_id=f"q{i}", timestamp=p.timestamp, x=p.x, y=p.y)
+            for i, p in enumerate(_points(rng, 40))]
+
+    def run(m):
+        return list(PointPointJoinQuery(_conf(), GRID, mesh=m).run(
+            iter(list(pts)), iter(list(qpts)), 0.5))
+
+    assert _pair_key(run(None)) == _pair_key(run(mesh))

@@ -114,6 +114,70 @@ def test_message_set_partial_trailing_message():
     assert len(out) == 1 and out[0][3] == b"full"
 
 
+def _gzip_wrapper(inner: bytes, wrapper_offset: int, wrapper_ts: int,
+                  attrs: int = 0x01, magic: int = 1) -> bytes:
+    """Broker-style gzip wrapper message around an inner message set."""
+    import gzip as _gzip
+
+    comp = _gzip.compress(inner)
+    if magic >= 1:
+        body = struct.pack(">bbq", magic, attrs, wrapper_ts)
+    else:
+        body = struct.pack(">bb", magic, attrs)
+    body += kw.enc_bytes(None) + kw.enc_bytes(comp)
+    msg = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
+    return struct.pack(">qi", wrapper_offset, len(msg)) + msg
+
+
+def test_bootstrap_parsing_portless_and_ipv6():
+    c = kw.KafkaWireClient("localhost")
+    assert c.bootstrap == [("localhost", 9092)]
+    c = kw.KafkaWireClient("[::1]:9093, broker:1234, [fe80::2]")
+    assert c.bootstrap == [("::1", 9093), ("broker", 1234),
+                           ("fe80::2", 9092)]
+
+
+def test_gzip_message_set_decodes_with_relative_offsets():
+    """KIP-31 v1 wrappers: inner offsets are relative; wrapper offset is
+    the absolute offset of the LAST inner message."""
+    inner = kw.encode_message_set(
+        [(b"a", None, 10), (b"b", b"k", 20), (b"c", None, 30)]
+    )  # inner offsets 0,1,2
+    wire = _gzip_wrapper(inner, wrapper_offset=41, wrapper_ts=99)
+    out = kw.decode_message_set(wire)
+    assert [(o, t, k, v) for o, t, k, v in out] == [
+        (39, 10, None, b"a"), (40, 20, b"k", b"b"), (41, 30, None, b"c"),
+    ]
+
+
+def test_gzip_log_append_time_overrides_inner_timestamps():
+    inner = kw.encode_message_set([(b"a", None, 10), (b"b", None, 20)])
+    wire = _gzip_wrapper(inner, wrapper_offset=7, wrapper_ts=555,
+                         attrs=0x01 | 0x08)
+    out = kw.decode_message_set(wire)
+    assert [(o, t) for o, t, _, _ in out] == [(6, 555), (7, 555)]
+
+
+def test_gzip_magic0_wrapper_keeps_absolute_offsets():
+    # magic-0 inner messages with absolute offsets, magic-0 wrapper.
+    msgs = []
+    for off, val in [(3, b"x"), (4, b"y")]:
+        body = struct.pack(">bb", 0, 0) + kw.enc_bytes(None) + kw.enc_bytes(val)
+        m = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
+        msgs.append(struct.pack(">qi", off, len(m)) + m)
+    wire = _gzip_wrapper(b"".join(msgs), wrapper_offset=4, wrapper_ts=0,
+                         magic=0)
+    out = kw.decode_message_set(wire)
+    assert [(o, v) for o, _, _, v in out] == [(3, b"x"), (4, b"y")]
+
+
+def test_snappy_message_set_still_rejected():
+    inner = kw.encode_message_set([(b"a", None, 1)])
+    wire = _gzip_wrapper(inner, wrapper_offset=0, wrapper_ts=0, attrs=0x02)
+    with pytest.raises(NotImplementedError, match="snappy"):
+        kw.decode_message_set(wire)
+
+
 def test_message_set_magic0_decodes():
     body = struct.pack(">bb", 0, 0) + kw.enc_bytes(None) + kw.enc_bytes(b"v0")
     msg = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
