@@ -84,29 +84,84 @@ def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
     return step
 
 
+_ERROR_RECORD = {
+    "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+    "value": 0,
+    "unit": "points/s",
+    "vs_baseline": 0,
+}
+
+
+def _supervise() -> None:
+    """Retry-with-backoff around the real benchmark: a down tunnel hangs
+    device init in an unkillable C call, so each dial attempt is a FRESH
+    subprocess (in-process retry cannot recover a hung init). 3 attempts
+    with 30 s / 60 s backoff — a transient outage no longer zeroes a
+    round's record (round-3 lesson: BENCH_r03 was a watchdog error
+    record from a single 600 s dial). Only the final outcome's JSON line
+    is relayed; the driver still sees exactly one line."""
+    import os
+    import subprocess
+    import time
+
+    last_out, last_rc = "", 3
+    for attempt in range(3):
+        if attempt:
+            time.sleep(30 * 2 ** (attempt - 1))  # 30 s, then 60 s
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "SFT_BENCH_CHILD": "1"},
+                capture_output=True, text=True, timeout=3000,
+            )
+            last_out, last_rc = p.stdout, p.returncode
+            sys.stderr.write(p.stderr[-4000:])
+        except subprocess.TimeoutExpired as e:
+            last_out = (e.stdout or b"").decode(errors="replace") if isinstance(
+                e.stdout, bytes) else (e.stdout or "")
+            last_rc = 3
+            continue
+        if p.returncode == 0:
+            sys.stdout.write(p.stdout)
+            return
+    lines = [ln for ln in last_out.strip().splitlines()
+             if ln.startswith("{")]
+    if lines:
+        print(lines[-1])
+    else:
+        print(json.dumps({
+            **_ERROR_RECORD,
+            "error": f"bench child failed rc={last_rc} after 3 attempts",
+        }))
+    sys.exit(3)
+
+
 def main() -> None:
     import os as _os
     import threading
 
+    if not _os.environ.get("SFT_BENCH_CHILD"):
+        _supervise()
+        return
+
     # Device-init watchdog: the tunnel's site hook dials the device while
     # jax initializes; a down tunnel hangs that C call forever (observed
     # outage 2026-07-30). Emit an honest one-line record and exit instead
-    # of hanging the driver — a hung benchmark records nothing.
+    # of hanging the driver — the supervisor above retries the dial in a
+    # fresh process with backoff.
     _init_ok = threading.Event()
 
     def _watchdog():
-        # 600 s is ~20× a cold plugin start — far past any healthy init,
-        # even on a congested tunnel (first compiles happen later and
-        # are not under this timer).
-        if not _init_ok.wait(600):
+        # 180 s is ~6× a cold plugin start — past any healthy init (first
+        # compiles happen later and are not under this timer); short
+        # enough that the supervisor's 3 dials fit where one 600 s dial
+        # sat before.
+        if not _init_ok.wait(180):
             if _init_ok.is_set():  # lost the race at the boundary
                 return
             print(json.dumps({
-                "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
-                "value": 0,
-                "unit": "points/s",
-                "vs_baseline": 0,
-                "error": "device tunnel unreachable (init hang > 600 s)",
+                **_ERROR_RECORD,
+                "error": "device tunnel unreachable (init hang > 180 s)",
             }))
             sys.stdout.flush()
             _os._exit(3)

@@ -80,6 +80,128 @@ def traj_stats_sorted_fused(
     )
 
 
+class TrajPaneStats(NamedTuple):
+    """Device pane-sliding tStats output: (num_oids, n_starts) matrices,
+    oid-major (the segment-sum layout); the host wrapper transposes and
+    applies the alive-window filter. ``temporal``/``count`` are int32 —
+    exact on every backend (per-oid ms totals are bounded by the stream
+    span, which the wrapper checks fits int32)."""
+
+    spatial: jnp.ndarray  # (K, n_starts)
+    temporal: jnp.ndarray  # (K, n_starts) int32 ms
+    count: jnp.ndarray  # (K, n_starts) int32
+
+
+def traj_stats_pane_kernel(
+    ts_rel: jnp.ndarray,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_oids: int,
+    slide_ms: int,
+    ppw: int,
+    n_panes: int,
+) -> TrajPaneStats:
+    """Pane-decomposed sliding tStats ON DEVICE — the TPU form of
+    streams/panes.py:traj_stats_sliding (itself the vectorized analog of
+    the reference's per-record accumulator walk, TStatsQuery.java:44-145).
+
+    Inputs are pre-sorted by (oid, ts) with padding at the end
+    (valid=False). ``ts_rel`` is int32 REBASED time: the host wrapper
+    subtracts ``p_lo·slide_ms`` so epoch-ms values survive the int32
+    world of a non-x64 device (raw epoch ms ~1.7e12 would silently wrap;
+    pane arithmetic is shift-invariant, so rebasing changes nothing).
+    ``n_panes`` is a static bucket.
+
+    Everything is expressed as SORTED segment sums + cumulative sums —
+    no data-dependent scatters: the (oid, ts) sort makes every flat
+    ``oid·n_panes + pane`` id non-decreasing, which XLA lowers to an
+    efficient sorted-segment reduction instead of a serialized scatter.
+    Window sums are cumsum differences gathered at STATIC row offsets,
+    and the start-boundary corrections (a consecutive-point segment must
+    not count for windows that begin after its earlier point) are two
+    more sorted segment sums into a difference array + one cumsum —
+    the interval-subtract of the host path, TPU-shaped. Temporal sums
+    stay integer end to end (int32-exact; floats would round above
+    2^24 on f32 devices).
+    """
+    k = num_oids
+    n_starts = n_panes + ppw - 1
+    nseg_flat = k * n_panes
+    ts_rel = ts_rel.astype(jnp.int32)
+    pane = jnp.clip(ts_rel // slide_ms, 0, n_panes - 1)
+    sentinel = jnp.int32(nseg_flat)
+    ids_pt = jnp.where(
+        valid, oid.astype(jnp.int32) * n_panes + pane, sentinel
+    )
+
+    cnt = jax.ops.segment_sum(
+        valid.astype(jnp.int32), ids_pt, num_segments=nseg_flat + 1,
+        indices_are_sorted=True,
+    )[:nseg_flat].reshape(k, n_panes)
+
+    same = (oid[1:] == oid[:-1]) & valid[1:] & valid[:-1]
+    dx = x[1:] - x[:-1]
+    dy = y[1:] - y[:-1]
+    f_dtype = x.dtype
+    seg_d = jnp.where(same, jnp.sqrt(dx * dx + dy * dy),
+                      jnp.zeros((), f_dtype))
+    seg_dt = jnp.where(same, ts_rel[1:] - ts_rel[:-1], jnp.int32(0))
+    ids_seg = ids_pt[1:]  # always the later point's id — stays sorted;
+    # non-segments contribute zeros (cheaper than breaking sortedness
+    # with a sentinel mid-stream).
+    pane_d = jax.ops.segment_sum(
+        seg_d, ids_seg, num_segments=nseg_flat + 1, indices_are_sorted=True,
+    )[:nseg_flat].reshape(k, n_panes)
+    pane_dt = jax.ops.segment_sum(
+        seg_dt, ids_seg, num_segments=nseg_flat + 1, indices_are_sorted=True,
+    )[:nseg_flat].reshape(k, n_panes)
+
+    # Rolling window sums: one cumsum + static-offset row gathers.
+    row = jnp.arange(n_starts, dtype=jnp.int32) - (ppw - 1)
+    row_hi = jnp.clip(row + ppw, 0, n_panes)
+    row_lo = jnp.clip(row, 0, n_panes)
+
+    def rolling(a):
+        c = jnp.concatenate(
+            [jnp.zeros((k, 1), a.dtype), jnp.cumsum(a, axis=1)], axis=1
+        )
+        return c[:, row_hi] - c[:, row_lo]
+
+    w_d = rolling(pane_d)
+    w_dt = rolling(pane_dt)
+    w_cnt = rolling(cnt)
+
+    # Start-boundary corrections. t_prev_eff keeps ids monotone across
+    # trajectory boundaries (those lanes carry zero data anyway).
+    t_prev_eff = jnp.where(same, ts_rel[:-1], ts_rel[1:])
+    seg_pane = ts_rel[1:] // slide_ms  # rebased pane of the later point
+    first_b = jnp.maximum(t_prev_eff // slide_ms + 1,
+                          seg_pane - ppw + 1)
+    base = -(ppw - 1)  # rebased window-start pane of start-index 0
+    si0 = jnp.clip(first_b - base, 0, n_starts)
+    si1 = jnp.clip(seg_pane - base + 1, 0, n_starts)
+    has = same & (si0 < si1) & valid[1:]
+    d_corr = jnp.where(has, seg_d, jnp.zeros((), f_dtype))
+    t_corr = jnp.where(has, seg_dt, jnp.int32(0))
+    stride = n_starts + 1
+    oid_b = oid[1:].astype(jnp.int32) * stride
+    ids0 = jnp.where(valid[1:], oid_b + si0, jnp.int32(k * stride))
+    ids1 = jnp.where(valid[1:], oid_b + si1, jnp.int32(k * stride))
+
+    def interval(vals, ids):
+        return jax.ops.segment_sum(
+            vals, ids, num_segments=k * stride + 1, indices_are_sorted=True,
+        )[:k * stride].reshape(k, stride)
+
+    diff_d = interval(d_corr, ids0) - interval(d_corr, ids1)
+    diff_t = interval(t_corr, ids0) - interval(t_corr, ids1)
+    w_d = w_d - jnp.cumsum(diff_d, axis=1)[:, :n_starts]
+    w_dt = w_dt - jnp.cumsum(diff_t, axis=1)[:, :n_starts]
+    return TrajPaneStats(w_d, w_dt, w_cnt)
+
+
 class TrajPairs(NamedTuple):
     """Deduped trajectory-pair join output (device-compacted).
 

@@ -178,6 +178,90 @@ class TrajPaneWindows:
         return self.starts + self._size_ms
 
 
+def _device_backend_preferred() -> bool:
+    """True when the default JAX backend is an accelerator — there the
+    pane engine runs as one jitted program (ops/trajectory.py:
+    traj_stats_pane_kernel); on CPU the native C++ single-pass engine
+    wins (same gate shape as ops/join.pallas_join_supported)."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _traj_stats_sliding_device(ts, xy, oid, num_oids, size_ms, slide_ms):
+    """Device pane engine wrapper: host (oid, ts) sort + pad, ONE jitted
+    dispatch, host alive-filter. Bit-parity with the numpy path in f64
+    (tests); f32 on non-x64 devices (segment sums associate in the same
+    pane order, spatial tolerance ~1e-6 relative)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.trajectory import traj_stats_pane_kernel
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    ppw = size_ms // slide_ms
+    ts = np.asarray(ts, np.int64)
+    oid = np.asarray(oid, np.int64)
+    xy = np.asarray(xy, np.float64)
+    ts_sorted = len(ts) <= 1 or bool(np.all(ts[1:] >= ts[:-1]))
+    if ts_sorted:
+        order = np.argsort(oid, kind="stable")
+    else:
+        order = np.lexsort((ts, oid))
+    t, o, p = ts[order], oid[order], xy[order]
+
+    pane = np.floor_divide(t, slide_ms)
+    p_lo = int(pane.min())
+    n_panes = next_bucket(int(pane.max()) - p_lo + 1, minimum=8)
+    # Rebase time HOST-side so epoch-ms values survive the int32 world
+    # of a non-x64 device (raw ~1.7e12 ms would silently wrap; pane
+    # arithmetic is shift-invariant). int32 covers ~24 days of stream
+    # span — fail loudly beyond, don't wrap.
+    t_rel = t - p_lo * slide_ms
+    if len(t_rel) and int(t_rel.max()) >= np.iinfo(np.int32).max - slide_ms:
+        raise ValueError(
+            "stream span exceeds the device pane engine's int32 ms range "
+            "(~24 days); use backend='native' or chunk the stream"
+        )
+    n = len(t)
+    nb = next_bucket(n, minimum=8)
+    pad = nb - n
+    f_dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    tp = np.concatenate([t_rel, np.full(pad, t_rel[-1], np.int64)]
+                        ).astype(np.int32)
+    op_ = np.concatenate([o, np.full(pad, num_oids - 1, np.int64)]
+                         ).astype(np.int32)
+    xp = np.concatenate([p[:, 0], np.zeros(pad)]).astype(f_dtype)
+    yp = np.concatenate([p[:, 1], np.zeros(pad)]).astype(f_dtype)
+    vp = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+
+    kernel = jitted(
+        traj_stats_pane_kernel, "num_oids", "slide_ms", "ppw", "n_panes",
+    )
+    res = kernel(
+        jnp.asarray(tp), jnp.asarray(xp), jnp.asarray(yp),
+        jnp.asarray(op_), jnp.asarray(vp),
+        num_oids=num_oids, slide_ms=slide_ms, ppw=ppw, n_panes=n_panes,
+    )
+    w_d = np.asarray(res.spatial).T
+    w_dt = np.asarray(res.temporal).T.astype(np.int64)  # int32-exact sums
+    w_cnt = np.asarray(res.count).T
+    n_starts = n_panes + ppw - 1
+    alive = w_cnt.sum(axis=1) > 0
+    starts = ((np.arange(n_starts) + p_lo - (ppw - 1)) * slide_ms)[alive]
+    return TrajPaneWindows(
+        starts=starts.astype(np.int64),
+        spatial=w_d[alive],
+        temporal=w_dt[alive],
+        count=w_cnt[alive].astype(np.int64),
+        _size_ms=size_ms,
+    )
+
+
 def traj_stats_sliding(
     ts: np.ndarray,
     xy: np.ndarray,
@@ -185,6 +269,7 @@ def traj_stats_sliding(
     num_oids: int,
     size_ms: int,
     slide_ms: int,
+    backend: str = "auto",
 ) -> TrajPaneWindows:
     """Pane-decomposed sliding trajectory statistics — tStats through
     extreme-overlap windows (e.g. the reference's 10s/10ms configs) in
@@ -199,6 +284,12 @@ def traj_stats_sliding(
     from exactly the windows whose start boundary it crosses.
 
     Exactly equals TStatsQuery.run's per-window recompute (parity test).
+
+    ``backend``: "auto" picks the DEVICE pane engine when the default
+    JAX backend is a TPU (one jitted sorted-segment-sum program,
+    ops/trajectory.py:traj_stats_pane_kernel) and the native C++ engine
+    on CPU hosts; "device" / "native" / "numpy" force a path (the
+    parity-oracle contract: all three agree bit-identically in f64).
     """
     if size_ms % slide_ms != 0:
         raise ValueError("size must be a multiple of slide for pane slicing")
@@ -213,6 +304,14 @@ def traj_stats_sliding(
             empty.astype(np.int64), _size_ms=size_ms,
         )
 
+    if backend not in ("auto", "device", "native", "numpy"):
+        raise ValueError(f"unknown traj_stats backend {backend!r}")
+    if backend == "device" or (backend == "auto" and
+                               _device_backend_preferred()):
+        return _traj_stats_sliding_device(
+            ts, xy, oid, num_oids, size_ms, slide_ms
+        )
+
     ts_sorted = len(ts) <= 1 or bool(np.all(ts[1:] >= ts[:-1]))
 
     # Native single-pass engine (native/sfnative.cpp:sf_traj_stats):
@@ -222,9 +321,15 @@ def traj_stats_sliding(
     try:
         from spatialflink_tpu import native as _native
 
-        native_ok = _native.available()
+        native_ok = _native.available() and backend != "numpy"
     except Exception:  # pragma: no cover - import/build failure
         native_ok = False
+    if backend == "native" and not native_ok:
+        raise RuntimeError(
+            "backend='native' was forced but the native library is "
+            "unavailable (build native/ with make) — refusing to "
+            "silently measure the numpy path instead"
+        )
     if native_ok:
         if ts_sorted:
             ts_s, xy_s, oid_s = ts, xy, oid

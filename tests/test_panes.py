@@ -91,3 +91,100 @@ def test_q2_batch_throughput(rng):
     # Streaming mode would touch 1000 windows per event; the pane engine
     # must sustain well beyond the 20k EPS reference target.
     assert eps > 100_000, f"pane engine too slow: {eps:.0f} EPS"
+
+
+def test_traj_stats_device_matches_numpy(rng):
+    """The device pane engine (ops/trajectory.py:traj_stats_pane_kernel)
+    must reproduce the numpy oracle: exact ints, 1e-12 floats — sorted
+    and shuffled inputs, including the start-boundary corrections."""
+    from spatialflink_tpu.streams import panes
+
+    n = 30_000
+    ts = np.sort(rng.integers(0, 9_000, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    oid = rng.integers(0, 65, n).astype(np.int64)
+
+    for shuffle in (False, True):
+        if shuffle:
+            perm = rng.permutation(n)
+            t_in, xy_in, o_in = ts[perm], xy[perm], oid[perm]
+        else:
+            t_in, xy_in, o_in = ts, xy, oid
+        dev = panes.traj_stats_sliding(
+            t_in, xy_in, o_in, 128, 3_000, 10, backend="device")
+        ref = panes.traj_stats_sliding(
+            t_in, xy_in, o_in, 128, 3_000, 10, backend="numpy")
+        assert np.array_equal(dev.starts, ref.starts)
+        assert np.array_equal(dev.count, ref.count)
+        assert np.array_equal(dev.temporal, ref.temporal)
+        # segment_sum associates float adds in a different order than
+        # bincount: 1e-12 RELATIVE parity (sums here are O(1e3)).
+        assert np.allclose(dev.spatial, ref.spatial, rtol=1e-12, atol=5e-12)
+
+
+def test_traj_stats_device_single_window_and_empty(rng):
+    from spatialflink_tpu.streams import panes
+
+    dev = panes.traj_stats_sliding(
+        np.asarray([100, 200, 300], np.int64),
+        np.asarray([[0.0, 0.0], [3.0, 4.0], [3.0, 8.0]]),
+        np.asarray([2, 2, 2], np.int64), 8, 1_000, 1_000,
+        backend="device",
+    )
+    ref = panes.traj_stats_sliding(
+        np.asarray([100, 200, 300], np.int64),
+        np.asarray([[0.0, 0.0], [3.0, 4.0], [3.0, 8.0]]),
+        np.asarray([2, 2, 2], np.int64), 8, 1_000, 1_000,
+        backend="numpy",
+    )
+    assert np.array_equal(dev.starts, ref.starts)
+    assert np.array_equal(dev.count, ref.count)
+    assert np.allclose(dev.spatial, ref.spatial)
+    # tumbling single window: trajectory 2 walked 5 + 4 units
+    w = list(ref.starts).index(0)
+    assert dev.spatial[w, 2] == 9.0
+
+
+def test_traj_stats_device_epoch_ms_timestamps(rng):
+    """Epoch-ms timestamps (~1.75e12, the real-stream case) must survive
+    the device path's int32 rebasing — raw casts would silently wrap."""
+    from spatialflink_tpu.streams import panes
+
+    base = 1_753_900_000_000  # ~2025 epoch ms
+    n = 5_000
+    ts = base + np.sort(rng.integers(0, 6_000, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    oid = rng.integers(0, 32, n).astype(np.int64)
+    dev = panes.traj_stats_sliding(ts, xy, oid, 64, 3_000, 100,
+                                   backend="device")
+    ref = panes.traj_stats_sliding(ts, xy, oid, 64, 3_000, 100,
+                                   backend="numpy")
+    assert np.array_equal(dev.starts, ref.starts)
+    assert np.array_equal(dev.count, ref.count)
+    assert np.array_equal(dev.temporal, ref.temporal)
+    assert np.allclose(dev.spatial, ref.spatial, rtol=1e-12, atol=5e-12)
+
+
+def test_traj_stats_device_rejects_int32_overflow_span(rng):
+    from spatialflink_tpu.streams import panes
+
+    ts = np.asarray([0, np.iinfo(np.int32).max + 10_000], np.int64)
+    with pytest.raises(ValueError, match="int32 ms range"):
+        panes.traj_stats_sliding(
+            ts, np.zeros((2, 2)), np.zeros(2, np.int64), 8, 1_000, 1_000,
+            backend="device",
+        )
+
+
+def test_traj_stats_native_forced_raises_when_unavailable(rng):
+    import unittest.mock as mock
+
+    import spatialflink_tpu.native as native
+    from spatialflink_tpu.streams import panes
+
+    with mock.patch.object(native, "available", lambda: False):
+        with pytest.raises(RuntimeError, match="native"):
+            panes.traj_stats_sliding(
+                np.asarray([0, 10], np.int64), np.zeros((2, 2)),
+                np.zeros(2, np.int64), 8, 1_000, 1_000, backend="native",
+            )
