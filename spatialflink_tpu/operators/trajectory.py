@@ -473,6 +473,206 @@ class TJoinQuery(SpatialOperator):
             )
 
 
+    def run_soa_panes(
+        self,
+        left_chunks,
+        right_chunks,
+        radius: float,
+        num_segments: int,
+        cap_w: int = 64,
+        pair_sel: int = 16,
+        dtype=np.float64,
+    ):
+        """Extreme-overlap sliding tJoin via the device pane-carry engine
+        (ops/tjoin_panes.py): window state lives ON DEVICE in ring-buffer
+        bucket planes, each slide does O(new-pane) join work, and the
+        whole bounded stream runs as ONE ``lax.scan`` dispatch — the
+        10s/10ms configs (ppw = 1000) stop paying the ppw× full-window
+        recompute of ``run_soa``. Yields the same per-window tuples
+        (start, end, left_oids, right_oids, min_dists, count, overflow)
+        with identical pair sets/min dists (parity test) — pairs ordered
+        by flat pair key rather than dedup compaction order.
+
+        Bounded streams only (the retry contract re-scans with doubled
+        ``cap_w``/``pair_sel`` on overflow). In-order events; windows
+        fire when they contain ≥1 event on either side (the assembler
+        contract). Digest memory = ppw·num_segments²·4 bytes — sized
+        for the domain's dozens-to-hundreds of vehicles; a guard raises
+        past ~2 GB rather than OOMing the device.
+        """
+        from spatialflink_tpu.operators.base import check_oid_range, jitted
+        from spatialflink_tpu.ops.tjoin_panes import (
+            tjoin_pane_init,
+            tjoin_pane_scan,
+        )
+        from spatialflink_tpu.utils.padding import next_bucket as _nb
+
+        conf = self.conf
+        size, slide = conf.window_size_ms, conf.slide_step_ms
+        if size % slide != 0:
+            raise ValueError("run_soa_panes requires size % slide == 0")
+        if conf.allowed_lateness_ms > 0:
+            raise ValueError(
+                "run_soa_panes does not support allowed_lateness; use "
+                "run_soa()"
+            )
+        ppw = size // slide
+        g = self.grid
+        import jax as _jax
+
+        # Honor the requested dtype with the usual effective-f64 rule
+        # (operators/base.py:center_coords): an f64 request without x64
+        # lands as f32 on device, so prep in f32 from the start.
+        f_dtype = np.dtype(dtype)
+        if f_dtype == np.float64 and not _jax.config.jax_enable_x64:
+            f_dtype = np.dtype(np.float32)
+        budget = ppw * num_segments * num_segments * 4
+        if budget > 2 << 30:
+            raise ValueError(
+                f"pane digest memory ppw·K² = {budget / 1e9:.1f} GB "
+                "exceeds the 2 GB guard; reduce num_segments or overlap"
+            )
+
+        def collect(chunks):
+            ts = []
+            xs = []
+            ys = []
+            oids = []
+            for ch in chunks:
+                ts.append(np.asarray(ch["ts"], np.int64))
+                xs.append(np.asarray(ch["x"], np.float64))
+                ys.append(np.asarray(ch["y"], np.float64))
+                oids.append(np.asarray(ch["oid"], np.int32))
+            if not ts:
+                z = np.zeros(0)
+                return z.astype(np.int64), z, z, z.astype(np.int32)
+            return (np.concatenate(ts), np.concatenate(xs),
+                    np.concatenate(ys), np.concatenate(oids))
+
+        lt, lx, ly, lo = collect(left_chunks)
+        rt, rx, ry, ro = collect(right_chunks)
+        check_oid_range(lo, num_segments)
+        check_oid_range(ro, num_segments)
+        if len(lt) == 0 and len(rt) == 0:
+            return
+        all_t = np.concatenate([lt, rt])
+        p_first = int(all_t.min() // slide)
+        p_last = int(all_t.max() // slide)
+        # Trailing empty panes flush the windows that still contain the
+        # last events (the assembler's end-of-stream flush).
+        n_slides = (p_last - p_first + 1) + (ppw - 1)
+
+        def pane_fields(t_arr, x_arr, y_arr, o_arr):
+            """Per-pane padded (S, PC) field arrays + per-pane counts."""
+            pane = (t_arr // slide - p_first).astype(np.int64)
+            order = np.argsort(pane, kind="stable")
+            pane_s = pane[order]
+            counts = np.bincount(pane_s, minlength=n_slides).astype(np.int64)
+            pc = int(_nb(max(int(counts.max()) if len(counts) else 1, 1),
+                         minimum=8))
+            S = n_slides
+            fx = np.zeros((S, pc), f_dtype)
+            fy = np.zeros((S, pc), f_dtype)
+            fo = np.zeros((S, pc), np.int32)
+            fv = np.zeros((S, pc), bool)
+            fxi = np.zeros((S, pc), np.int32)
+            fyi = np.zeros((S, pc), np.int32)
+            fcell = np.zeros((S, pc), np.int32)
+            frank = np.zeros((S, pc), np.int32)
+            starts = np.concatenate([[0], np.cumsum(counts)])
+            lane = np.arange(len(t_arr)) - starts[pane_s]
+            from spatialflink_tpu.operators.base import center_coords
+
+            xy = np.stack([x_arr, y_arr], axis=1)
+            cxy = center_coords(g, xy, f_dtype)
+            xi = np.floor((x_arr - g.min_x) / g.cell_length).astype(np.int64)
+            yi = np.floor((y_arr - g.min_y) / g.cell_length).astype(np.int64)
+            ing = (xi >= 0) & (xi < g.n) & (yi >= 0) & (yi < g.n)
+            cell = np.where(ing, xi * g.n + yi, 0).astype(np.int32)
+            fx[pane_s, lane] = cxy[order, 0]
+            fy[pane_s, lane] = cxy[order, 1]
+            fo[pane_s, lane] = o_arr[order]
+            fv[pane_s, lane] = ing[order]
+            fxi[pane_s, lane] = xi[order].astype(np.int32)
+            fyi[pane_s, lane] = yi[order].astype(np.int32)
+            fcell[pane_s, lane] = cell[order]
+            # within-(pane, cell) slot rank — distinct ring slots for a
+            # pane's same-cell points (vectorized: sort by (pane, cell)).
+            key_order = np.lexsort((cell[order], pane_s))
+            ps2, c2 = pane_s[key_order], cell[order][key_order]
+            newrun = np.ones(len(ps2), bool)
+            if len(ps2) > 1:
+                newrun[1:] = (ps2[1:] != ps2[:-1]) | (c2[1:] != c2[:-1])
+            run_id = np.cumsum(newrun) - 1
+            pos = np.arange(len(ps2))
+            run_start = pos[newrun][run_id]
+            rank2 = pos - run_start
+            rank = np.empty(len(ps2), np.int64)
+            rank[key_order] = rank2
+            frank[pane_s, lane] = rank.astype(np.int32)
+            return (fx, fy, fxi, fyi, fcell, frank, fo, fv), counts
+
+        lfields, lcounts = pane_fields(lt, lx, ly, lo)
+        rfields, rcounts = pane_fields(rt, rx, ry, ro)
+        layers = g.candidate_layers(radius)
+        scan = jitted(
+            tjoin_pane_scan,
+            "grid_n", "cap_w", "layers", "ppw", "num_ids", "pair_sel",
+        )
+        while True:
+            carry = tjoin_pane_init(
+                g.num_cells, cap_w, ppw, num_segments,
+                jnp.dtype(f_dtype),
+            )
+            # Pane indices are REBASED to 0 (the panes.py int32 lesson:
+            # absolute epoch-ms pane indices ~1.7e11 overflow int32);
+            # the kernel's ring/alive logic is shift-invariant and the
+            # host maps slide s back to absolute time below.
+            ts_dev = jnp.asarray(np.arange(n_slides, dtype=np.int32))
+            final, wmins = scan(
+                carry, ts_dev,
+                tuple(jnp.asarray(a) for a in lfields),
+                tuple(jnp.asarray(a) for a in rfields),
+                radius,
+                grid_n=g.n, cap_w=cap_w, layers=layers, ppw=ppw,
+                num_ids=num_segments, pair_sel=pair_sel,
+            )
+            cap_over = int(final.cap_overflow)
+            sel_over = int(final.sel_overflow)
+            if cap_over == 0 and sel_over == 0:
+                break
+            # Bounded-stream retry: grow whichever budget overflowed and
+            # re-scan (same idiom as the pruned joins' _pruned_block_pairs).
+            if cap_over:
+                cap_w *= 2
+            if sel_over:
+                pair_sel *= 2
+
+        wmins = np.asarray(wmins)  # (S, K²)
+        # Rolling per-side window event counts decide which windows fire.
+        def rolling_counts(c):
+            cc = np.concatenate([[0], np.cumsum(c)])
+            lo_i = np.maximum(np.arange(n_slides) - ppw + 1, 0)
+            return cc[np.arange(n_slides) + 1] - cc[lo_i]
+
+        lwin = rolling_counts(lcounts)
+        rwin = rolling_counts(rcounts)
+        for s in range(n_slides):
+            if lwin[s] == 0 and rwin[s] == 0:
+                continue
+            t_pane = p_first + s
+            start = (t_pane - ppw + 1) * slide
+            row = wmins[s]
+            hit = np.nonzero(np.isfinite(row))[0]
+            yield (
+                start, start + size,
+                (hit // num_segments).astype(np.int32),
+                (hit % num_segments).astype(np.int32),
+                row[hit].astype(np.float64),
+                int(len(hit)), 0,
+            )
+
+
 class PointPointTJoinQuery(TJoinQuery):
     """tJoin/PointPointTJoinQuery.java."""
 

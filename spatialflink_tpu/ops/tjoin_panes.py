@@ -1,0 +1,273 @@
+"""Pane-carry tJoin — the extreme-overlap sliding trajectory join.
+
+The reference's windowBased tJoin re-walks the whole window per fire
+(tJoin/PointPointTJoinQuery.java:183+); at the domain's extreme-overlap
+configs (10 s windows sliding every 10 ms — Q2_BrakeMonitor's window
+style, ppw = 1000) that is a 1000× redundant recompute per slide, and so
+is this repo's ``run_soa`` (one full-window join per fire). This module
+keeps the WINDOW STATE ON DEVICE and does only O(new-pane) join work per
+slide:
+
+- **Ring-buffer bucket planes** per stream side: (cells · capW) slots of
+  x/y/oid/pane-tag with a per-cell write cursor. Inserting a pane is a
+  small scatter; expiry is LAZY — probes mask slots whose pane tag left
+  the window, and a slot is reused (cursor ring) long after it expired.
+- **Min-pane-indexed pair digests**: ``D[m % ppw, lid·K + rid]`` = min
+  point-pair distance among pairs whose EARLIER point sits in pane
+  ``m``. A point pair (i ≤ j) is alive for window [s, s+ppw) iff i ≥ s,
+  and every contribution discovered so far has j ≤ current pane — so at
+  emission time ``min over m ∈ [s, t]`` of D is exactly the window's
+  per-trajectory-pair min distance (the tStats min-pane argument,
+  applied to a bilinear join).
+- Per slide: probe the new LEFT pane against the RIGHT window planes,
+  insert the left pane, probe the new RIGHT pane against the LEFT
+  planes (now containing pane t — covers new×new exactly once), insert
+  the right pane, then reduce the digest ring for the window ending at
+  pane t. All of it is one ``lax.scan`` step — one dispatch per BATCH
+  of slides, not per slide (the tunnel-dispatch lesson, CLAUDE.md).
+
+Exactness contract (same family as the other join kernels): results
+equal ``run_soa`` iff ``cap_overflow == 0`` (a live window slot was
+never overwritten — grow ``capW``) and ``sel_overflow == 0`` (no probe
+point matched more than ``pair_sel`` window points — grow
+``pair_sel``). Digest memory is ``ppw · K² · 4`` bytes (K = interned
+trajectory ids per side): extreme overlap trades memory for the 1000×
+work cut, sized for the domain's dozens-to-hundreds of vehicles.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.select import first_k_onehot, onehot_select_preferred
+
+
+class TJoinPaneCarry(NamedTuple):
+    lwx: jnp.ndarray  # (cells*capW,) left window planes
+    lwy: jnp.ndarray
+    lwoid: jnp.ndarray  # int32
+    lwtag: jnp.ndarray  # int32 pane index, very negative = empty
+    lwcur: jnp.ndarray  # (cells,) int32 ring cursor
+    rwx: jnp.ndarray
+    rwy: jnp.ndarray
+    rwoid: jnp.ndarray
+    rwtag: jnp.ndarray
+    rwcur: jnp.ndarray
+    digests: jnp.ndarray  # (ppw, K*K) min-pane-indexed pair min dists
+    cap_overflow: jnp.ndarray  # () int32
+    sel_overflow: jnp.ndarray  # () int32
+
+
+def tjoin_pane_init(
+    num_cells: int, cap_w: int, ppw: int, num_ids: int, dtype,
+) -> TJoinPaneCarry:
+    """Fresh carry. ``num_ids`` = interned trajectory-id bucket (shared
+    by both sides); digest row m holds pairs whose earlier pane is m."""
+    slots = num_cells * cap_w
+    empty_tag = jnp.int32(-(1 << 30))
+    plane_f = jnp.zeros((slots,), dtype)
+    plane_i = jnp.zeros((slots,), jnp.int32)
+    tags = jnp.full((slots,), empty_tag, jnp.int32)
+    cur = jnp.zeros((num_cells,), jnp.int32)
+    inf = jnp.asarray(jnp.inf, dtype)
+    return TJoinPaneCarry(
+        plane_f, plane_f, plane_i, tags, cur,
+        plane_f, plane_f, plane_i, tags, cur,
+        jnp.full((ppw, num_ids * num_ids), inf, dtype),
+        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+    )
+
+
+def _probe(wx, wy, woid, wtag, t, px, py, pxi, pyi, poid, pvalid, radius,
+           swap_pair, grid_n: int, cap_w: int, layers: int, ppw: int,
+           num_ids: int, pair_sel: int):
+    """New-pane points × window planes → (digest flat idx, dist,
+    sel_overflow). Row gathers only (span² cell rows per point, never
+    element gathers); per-point first-``pair_sel`` match selection is
+    backend-gated (one-hot on TPU, top_k on CPU — ops/select.py)."""
+    span = 2 * layers + 1
+    offs = jnp.arange(-layers, layers + 1, dtype=jnp.int32)
+    nx = pxi[:, None, None] + offs[None, :, None]  # (PC, span, 1)
+    ny = pyi[:, None, None] + offs[None, None, :]  # (PC, 1, span)
+    in_grid = (
+        (nx >= 0) & (nx < grid_n) & (ny >= 0) & (ny < grid_n)
+    ).reshape(-1, span * span)
+    rows = jnp.clip(nx * grid_n + ny, 0, grid_n * grid_n - 1).reshape(
+        -1, span * span
+    )  # (PC, span²)
+
+    w2 = lambda a: a.reshape(grid_n * grid_n, cap_w)
+    gx = w2(wx)[rows]  # (PC, span², capW) — row gathers
+    gy = w2(wy)[rows]
+    goid = w2(woid)[rows]
+    gtag = w2(wtag)[rows]
+
+    d = jnp.sqrt(
+        (gx - px[:, None, None]) ** 2 + (gy - py[:, None, None]) ** 2
+    )
+    alive = (gtag > t - ppw) & (gtag <= t)
+    mask = (
+        pvalid[:, None, None] & in_grid[:, :, None] & alive & (d <= radius)
+    ).reshape(len(px), -1)  # (PC, C)
+    dflat = d.reshape(len(px), -1)
+    oflat = goid.reshape(len(px), -1)
+    tflat = gtag.reshape(len(px), -1)
+
+    if onehot_select_preferred():
+        hit, count, sel_over = first_k_onehot(mask, pair_sel)
+        # one-hot sums select exactly one lane — bit-exact values.
+        sd = jnp.sum(jnp.where(hit, dflat[:, :, None], 0), axis=1)
+        so = jnp.sum(hit * oflat[:, :, None], axis=1)
+        st = jnp.sum(hit * tflat[:, :, None], axis=1)
+    else:
+        count = jnp.sum(mask.astype(jnp.int32), axis=1)
+        sel_over = jnp.sum(jnp.maximum(count - pair_sel, 0))
+        _v, ci = jax.lax.top_k(mask.astype(jnp.int8), pair_sel)
+        sd = jnp.take_along_axis(dflat, ci, axis=1)
+        so = jnp.take_along_axis(oflat, ci, axis=1)
+        st = jnp.take_along_axis(tflat, ci, axis=1)
+    svalid = (
+        jnp.arange(pair_sel, dtype=jnp.int32)[None, :]
+        < jnp.minimum(count, pair_sel)[:, None]
+    )
+
+    # Digest key: earlier pane = window slot's tag (window panes ≤ t).
+    ring = jnp.where(st >= 0, st % ppw, (st % ppw + ppw) % ppw)
+    a = poid[:, None]
+    b = so
+    lid = jnp.where(swap_pair, b, a)
+    rid = jnp.where(swap_pair, a, b)
+    flat = ring * (num_ids * num_ids) + lid * num_ids + rid
+    sentinel = ppw * num_ids * num_ids  # drop lane
+    flat = jnp.where(svalid, flat, sentinel)
+    return flat.reshape(-1), sd.reshape(-1), sel_over
+
+
+def _insert(wx, wy, woid, wtag, wcur, t, px, py, pcell, prank, poid, pvalid,
+            cap_w: int, ppw: int):
+    """Scatter one pane into a side's ring planes; returns the updated
+    planes + the count of LIVE slots overwritten (exactness counter)."""
+    cur = wcur[pcell]  # (PC,) row gather of the cursor
+    slot = (cur + prank) % cap_w
+    fi = jnp.where(pvalid, pcell * cap_w + slot, wx.shape[0])
+    # Two loss modes feed the exactness counter: overwriting a slot whose
+    # point is still inside the window, AND a single pane putting more
+    # than cap_w points in one cell (ranks wrap modulo cap_w and collide
+    # within this very scatter — invisible to the old-tag check).
+    overwritten = (
+        jnp.sum(jnp.where(
+            pvalid & (wtag[jnp.clip(fi, 0, wx.shape[0] - 1)] > t - ppw),
+            1, 0,
+        ))
+        + jnp.sum(jnp.where(pvalid & (prank >= cap_w), 1, 0))
+    ).astype(jnp.int32)
+    wx = wx.at[fi].set(px, mode="drop")
+    wy = wy.at[fi].set(py, mode="drop")
+    woid = woid.at[fi].set(poid, mode="drop")
+    wtag = wtag.at[fi].set(t, mode="drop")
+    wcur = wcur.at[jnp.where(pvalid, pcell, wcur.shape[0])].add(
+        1, mode="drop"
+    )
+    return wx, wy, woid, wtag, wcur, overwritten
+
+
+def tjoin_pane_step(
+    carry: TJoinPaneCarry,
+    xs,
+    radius,
+    grid_n: int,
+    cap_w: int,
+    layers: int,
+    ppw: int,
+    num_ids: int,
+    pair_sel: int,
+):
+    """One slide: probe/insert both sides, emit the window digest.
+
+    ``xs`` = (t, left pane, right pane) where each pane is
+    (x, y, xi, yi, cell, rank, oid, valid) fixed-capacity arrays.
+    Returns (carry', per-pair window min dists (K²,)). Designed as a
+    ``lax.scan`` body so a whole batch of slides is ONE dispatch.
+    """
+    t, lp, rp = xs
+    P = num_ids * num_ids
+    inf = jnp.asarray(jnp.inf, carry.digests.dtype)
+    # Ring slot t%ppw held pane t-ppw — reset before this pane's writes.
+    D = jax.lax.dynamic_update_index_in_dim(
+        carry.digests, jnp.full((P,), inf, carry.digests.dtype),
+        t % ppw, axis=0,
+    )
+
+    # Direction A: new LEFT pane × RIGHT window (panes < t).
+    fa, da, sa = _probe(
+        carry.rwx, carry.rwy, carry.rwoid, carry.rwtag, t,
+        lp[0], lp[1], lp[2], lp[3], lp[6], lp[7], radius,
+        swap_pair=jnp.asarray(False),
+        grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
+        num_ids=num_ids, pair_sel=pair_sel,
+    )
+    Df = D.reshape(-1)
+    Df = Df.at[fa].min(da, mode="drop")
+
+    lwx, lwy, lwoid, lwtag, lwcur, ov_l = _insert(
+        carry.lwx, carry.lwy, carry.lwoid, carry.lwtag, carry.lwcur, t,
+        lp[0], lp[1], lp[4], lp[5], lp[6], lp[7], cap_w=cap_w, ppw=ppw,
+    )
+
+    # Direction B: new RIGHT pane × LEFT window (panes ≤ t — includes the
+    # pane just inserted, so new×new pairs are counted exactly once).
+    fb, db, sb = _probe(
+        lwx, lwy, lwoid, lwtag, t,
+        rp[0], rp[1], rp[2], rp[3], rp[6], rp[7], radius,
+        swap_pair=jnp.asarray(True),
+        grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
+        num_ids=num_ids, pair_sel=pair_sel,
+    )
+    Df = Df.at[fb].min(db, mode="drop")
+    D = Df.reshape(ppw, P)
+
+    rwx, rwy, rwoid, rwtag, rwcur, ov_r = _insert(
+        carry.rwx, carry.rwy, carry.rwoid, carry.rwtag, carry.rwcur, t,
+        rp[0], rp[1], rp[4], rp[5], rp[6], rp[7], cap_w=cap_w, ppw=ppw,
+    )
+
+    new_carry = TJoinPaneCarry(
+        lwx, lwy, lwoid, lwtag, lwcur,
+        rwx, rwy, rwoid, rwtag, rwcur,
+        D,
+        (carry.cap_overflow + ov_l + ov_r).astype(jnp.int32),
+        (carry.sel_overflow + sa + sb).astype(jnp.int32),
+    )
+    # Window ending at pane t: min over every live earlier-pane digest.
+    wmin = jnp.min(D, axis=0)
+    return new_carry, wmin
+
+
+def tjoin_pane_scan(
+    carry: TJoinPaneCarry,
+    ts, lps, rps,
+    radius,
+    grid_n: int,
+    cap_w: int,
+    layers: int,
+    ppw: int,
+    num_ids: int,
+    pair_sel: int,
+):
+    """Scan ``tjoin_pane_step`` over a batch of slides in ONE program.
+
+    ``ts``: (S,) pane indices; ``lps``/``rps``: per-field (S, PC) arrays
+    (x, y, xi, yi, cell, rank, oid, valid). Returns (carry',
+    (S, K²) per-window pair min dists).
+    """
+
+    def body(c, x):
+        return tjoin_pane_step(
+            c, x, radius, grid_n=grid_n, cap_w=cap_w, layers=layers,
+            ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+        )
+
+    return jax.lax.scan(body, carry, (ts, lps, rps))

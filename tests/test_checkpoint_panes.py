@@ -94,6 +94,7 @@ def test_knn_pane_carry_resume_digests_survive(rng, tmp_path):
     assert state["assembler"]["buffers"]  # open windows buffered
 
 
+@pytest.mark.slow
 def test_join_pane_carry_kill_and_resume(rng, tmp_path):
     left = _pts(rng, 500, prefix="a")
     right = _pts(np.random.default_rng(9), 400, prefix="b", n_obj=16)

@@ -153,6 +153,7 @@ def test_socket_source_loopback():
     assert [(p.obj_id, p.x) for p in pts] == [("a", 1.0), ("b", 3.0)]
 
 
+@pytest.mark.slow
 def test_streaming_job_remaining_options(tmp_path):
     """CLI options 2 (realtime range), 5 (join), 7 (tAggregate),
     8 (multi-query kNN)."""
@@ -196,6 +197,7 @@ window:
         assert out.read_text().strip(), f"option {opt} produced no output"
 
 
+@pytest.mark.slow
 def test_streaming_job_incremental_flag_matches_full(tmp_path):
     """query.incremental: true routes options 1/3/5 through the carry
     paths; CLI output must equal the full-recompute run line for line

@@ -22,6 +22,7 @@ def test_entry_compiles_and_runs():
     assert (np.diff(d) >= 0).all()  # ascending
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__
 

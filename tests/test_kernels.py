@@ -356,6 +356,7 @@ def test_bucketed_join_overflow_and_truncation(rng):
     assert int(res2.count) > 50
 
 
+@pytest.mark.slow
 def test_join_out_of_grid_points_never_match(rng):
     """Reference semantics: points outside the grid bbox carry keys no
     neighbor set contains, so they never join — in every join variant."""

@@ -71,6 +71,7 @@ def test_point_parser_schema_positions():
     assert p.object_name(int(out["oid"][0])) == ref.obj_id
 
 
+@pytest.mark.slow
 def test_native_parser_speed():
     lines = make_lines(200_000)
     data = "\n".join(lines).encode()
@@ -196,6 +197,7 @@ def test_wkt_parser_feeds_geometry_soa_pipeline(rng):
 
 
 @needs_native
+@pytest.mark.slow
 def test_wkt_parser_throughput():
     """The native WKT parser must beat the 20k EPS reference target by a
     wide margin (it replaces per-line Python WKT parsing)."""
@@ -268,6 +270,7 @@ def test_wkt_holes_through_geometry_soa_pipeline(rng):
     assert obj_res[0] == [("donut", 1.0)]
 
 
+@pytest.mark.slow
 def test_traj_stats_native_bit_identical_to_numpy(rng):
     """sf_traj_stats must reproduce the numpy pane path BIT-FOR-BIT
     (same float association order), sorted and unsorted inputs, including

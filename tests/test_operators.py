@@ -561,6 +561,7 @@ def test_point_polygon_range_pruned_path_matches_dense(rng):
     assert got == dense_sorted
 
 
+@pytest.mark.slow
 def test_pane_join_matches_windowed(rng):
     """query_panes (pane-block carry) must produce the same pair MULTISET
     per sliding window as run() full recomputation (order may differ:

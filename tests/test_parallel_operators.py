@@ -89,6 +89,7 @@ def test_knn_operator_mesh_bit_matches_single(rng, mesh):
     assert all(len(w[2]) == 50 for w in single)
 
 
+@pytest.mark.slow
 def test_join_operator_mesh_matches_single(rng, mesh):
     # Finer grid so neither side exceeds the per-cell cap (overflow 0 →
     # both the compact single-device path and the dense sharded path are
@@ -278,6 +279,7 @@ def test_taggregate_operator_mesh_matches_single(rng, mesh):
     assert run(None) == run(mesh)
 
 
+@pytest.mark.slow
 def test_tjoin_operator_mesh_matches_single(rng, mesh):
     from spatialflink_tpu.operators import TJoinQuery
 

@@ -85,6 +85,7 @@ def test_tknn_soa_matches_object_path(rng):
             assert gd == pytest.approx(wd, rel=1e-9)
 
 
+@pytest.mark.slow
 def test_join_soa_matches_object_path(rng):
     lts, lxs, lys, loids = _stream(rng, 2000)
     rng2 = np.random.default_rng(9)
@@ -164,6 +165,7 @@ def test_tjoin_device_dedup_matches_bruteforce(rng):
     assert any(res.pairs for res in results)
 
 
+@pytest.mark.slow
 def test_tjoin_run_soa_matches_object_path(rng):
     """run_soa's raw (left_oid, right_oid, min_dist) arrays == the object
     path's dedup'd pair set per window, through sliding windows — the

@@ -1,0 +1,179 @@
+"""Pane-carry tJoin (ops/tjoin_panes.py + TJoinQuery.run_soa_panes):
+pair-set and min-distance parity with the full-window run_soa path,
+including an extreme-overlap (ppw=100) config and the overflow retry."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.operators import QueryConfiguration, QueryType
+from spatialflink_tpu.operators.trajectory import TJoinQuery
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _chunks(rng, n, t_span, n_obj, seed_shift=0.0):
+    ts = np.sort(rng.integers(0, t_span, n)).astype(np.int64)
+    return [{
+        "ts": ts,
+        "x": rng.uniform(2 + seed_shift, 8 + seed_shift, n),
+        "y": rng.uniform(2, 8, n),
+        "oid": rng.integers(0, n_obj, n).astype(np.int32),
+    }]
+
+
+def _runsoa_key(results):
+    out = {}
+    for start, end, lo, ro, dd, count, over in results:
+        assert over == 0
+        out[start] = sorted(
+            (int(a), int(b), round(float(d), 9))
+            for a, b, d in zip(lo, ro, dd)
+        )
+    return out
+
+
+def _parity(rng, conf, radius, n=1500, n_obj=24, t_span=4_000):
+    left = _chunks(rng, n, t_span, n_obj)
+    right = _chunks(rng, n, t_span, n_obj, seed_shift=0.3)
+    op1 = TJoinQuery(conf, GRID)
+    soa = _runsoa_key(op1.run_soa(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        radius, num_segments=n_obj,
+    ))
+    op2 = TJoinQuery(conf, GRID)
+    panes = _runsoa_key(op2.run_soa_panes(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        radius, num_segments=n_obj,
+    ))
+    assert soa, "no windows fired"
+    hits = 0
+    for start, pairs in soa.items():
+        assert start in panes, f"pane engine missed window {start}"
+        assert panes[start] == pairs, f"window {start} diverges"
+        hits += len(pairs)
+    assert hits > 0, "degenerate test: no pairs matched anywhere"
+
+
+@pytest.mark.slow
+def test_tjoin_panes_matches_run_soa_sliding(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.1)
+    _parity(rng, conf, radius=0.4)
+
+
+@pytest.mark.slow
+def test_tjoin_panes_matches_run_soa_extreme_overlap(rng):
+    """ppw=100 — the 10s/10ms window shape at test scale."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.01)
+    _parity(rng, conf, radius=0.3, n=800, n_obj=16, t_span=2_500)
+
+
+@pytest.mark.slow
+def test_tjoin_panes_retry_on_tiny_budgets(rng):
+    """Deliberately tiny cap_w/pair_sel must converge via the doubling
+    retry to the same exact result."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.25)
+    n, n_obj = 600, 8
+    left = _chunks(rng, n, 3_000, n_obj)
+    right = _chunks(rng, n, 3_000, n_obj, seed_shift=0.2)
+    ref = _runsoa_key(TJoinQuery(conf, GRID).run_soa(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj,
+    ))
+    got = _runsoa_key(TJoinQuery(conf, GRID).run_soa_panes(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj, cap_w=2, pair_sel=1,
+    ))
+    for start, pairs in ref.items():
+        assert got[start] == pairs
+
+
+def test_tjoin_panes_one_sided_windows_fire_empty(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.5)
+    left = _chunks(rng, 100, 1_000, 8)
+    right = [{
+        "ts": np.asarray([5_000, 5_100], np.int64),  # far later
+        "x": np.asarray([5.0, 5.1]),
+        "y": np.asarray([5.0, 5.1]),
+        "oid": np.asarray([0, 1], np.int32),
+    }]
+    res = list(TJoinQuery(conf, GRID).run_soa_panes(
+        iter(left), iter(right), 0.5, num_segments=8,
+    ))
+    starts = [r[0] for r in res]
+    # early (left-only) and late (right-only) windows both fire, empty
+    assert any(s < 2_000 for s in starts)
+    assert any(s >= 4_000 for s in starts)
+    assert all(r[5] == 0 for r in res if r[0] < 2_000 or r[0] >= 4_000)
+    assert all(r[6] == 0 for r in res)
+
+
+def test_tjoin_panes_digest_memory_guard():
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
+                              slide_step=0.01)
+    with pytest.raises(ValueError, match="digest memory"):
+        list(TJoinQuery(conf, GRID).run_soa_panes(
+            iter([]), iter([{
+                "ts": np.asarray([0], np.int64), "x": np.asarray([1.0]),
+                "y": np.asarray([1.0]), "oid": np.asarray([0], np.int32),
+            }]), 0.5, num_segments=2048,
+        ))
+
+
+def test_tjoin_panes_epoch_ms_timestamps(rng):
+    """Epoch-ms streams must survive the int32 pane rebasing (absolute
+    pane indices ~1.7e11 would overflow int32)."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.25)
+    base = 1_753_900_000_000
+    n, n_obj = 400, 8
+    left = _chunks(rng, n, 2_000, n_obj)
+    right = _chunks(rng, n, 2_000, n_obj, seed_shift=0.2)
+    for side in (left, right):
+        side[0]["ts"] = side[0]["ts"] + base
+    ref = _runsoa_key(TJoinQuery(conf, GRID).run_soa(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj,
+    ))
+    got = _runsoa_key(TJoinQuery(conf, GRID).run_soa_panes(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj,
+    ))
+    assert ref
+    for start, pairs in ref.items():
+        assert got[start] == pairs
+
+
+def test_tjoin_panes_single_pane_cell_flood_retries(rng):
+    """More same-cell points in ONE pane than cap_w must trip the
+    overflow counter (rank wraparound would silently drop points) and
+    converge via the retry."""
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=1)
+    n, n_obj = 120, 8  # all in one cell, one pane
+    left = [{
+        "ts": np.zeros(n, np.int64) + 100,
+        "x": rng.uniform(5.0, 5.4, n),
+        "y": rng.uniform(5.0, 5.4, n),
+        "oid": rng.integers(0, n_obj, n).astype(np.int32),
+    }]
+    right = [dict(left[0], x=rng.uniform(5.0, 5.4, n))]
+    ref = _runsoa_key(TJoinQuery(conf, GRID, cap=256).run_soa(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj,
+    ))
+    got = _runsoa_key(TJoinQuery(conf, GRID).run_soa_panes(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        0.5, num_segments=n_obj, cap_w=16,
+    ))
+    for start, pairs in ref.items():
+        assert got[start] == pairs
