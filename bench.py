@@ -57,24 +57,40 @@ def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
     (bench_suite.bench_headline_knn_1m): one slide of packed wire records
     + the carried digest → (new digest, window KnnResult).
 
-    ``wire_s``: (slide, 3) uint16 — x_q, y_q, oid (int16 bits). Returns a
-    raw fn for jax.jit / lax.scan embedding.
+    ``wire_s``: (3, slide) uint16 PLANE-MAJOR rows — x_q, y_q, oid (int16
+    bits). Returns a raw fn for jax.jit / lax.scan embedding.
     """
     from spatialflink_tpu.ops.knn import (
+        _digest_from_point_dists_compact,
         knn_merge_digest_list,
-        knn_pane_digest_compact,
     )
 
     bases = np.asarray([0, slide], np.int32)
 
+    sx = np.float32(wf.scale[0])
+    sy = np.float32(wf.scale[1])
+    ox = np.float32(wf.origin[0])
+    oy = np.float32(wf.origin[1])
+
     def step(seg_prev, rep_prev, wire_s, query_xy):
-        xyq = wire_s[:, :2]
-        oid = wire_s[:, 2].astype(jnp.int32)  # oids < 32768: bit-exact
-        xy = wf.dequantize(xyq)
-        valid = jnp.ones((wire_s.shape[0],), bool)
-        d = knn_pane_digest_compact(
-            xy, valid, None, None, oid, query_xy, np.float32(radius),
-            jnp.int32(0), num_segments=nseg, cand=cand,
+        # PLANE-MAJOR wire: (3, slide) u16 rows — a (slide, 2) coordinate
+        # tensor tiles onto 2 of the 128 TPU lanes (the (N,2) layout
+        # lever, BASELINE.md); contiguous (slide,) planes keep the
+        # dequant + distance fully lane-parallel. Same f32 ops in the
+        # same order as dequantize()+point_point_distance; inside one
+        # jit XLA may FMA-fuse differently than the eager digest path
+        # (≤1 ulp on distances) — the CPU baseline runs THIS program,
+        # so the comparison stays exact.
+        xq = wire_s[0].astype(jnp.float32)
+        yq = wire_s[1].astype(jnp.float32)
+        oid = wire_s[2].astype(jnp.int32)  # oids < 32768: bit-exact
+        dx = (xq * sx + ox) - query_xy[0]
+        dy = (yq * sy + oy) - query_xy[1]
+        dist = jnp.sqrt(dx * dx + dy * dy)
+        valid = jnp.ones((wire_s.shape[1],), bool)
+        d = _digest_from_point_dists_compact(
+            dist, valid, None, oid, np.float32(radius), nseg,
+            index_base=jnp.int32(0), cand=cand,
         )
         res = knn_merge_digest_list(
             (seg_prev, d.seg_min), (rep_prev, d.rep), bases, k=k
@@ -197,6 +213,13 @@ def main() -> None:
 
     step = build_headline_step(jnp, wf)
     jstep = jax.jit(step)
+    # Throughput loops donate the carried digest buffers: without
+    # donation every dispatch materializes fresh (nseg,) seg/rep outputs
+    # and the runtime schedules carry copies (~230 ms per 100 steps in
+    # the round-3 profiler trace, BASELINE.md). Donated inputs are dead
+    # after the call, so resets re-copy seg0/rep0 device-side.
+    jstep_d = jax.jit(step, donate_argnums=(0, 1))
+    jcopy = jax.jit(lambda a: a.copy())
     q_d = jax.device_put(jnp.asarray(q), dev)
     big = np.float32(np.finfo(np.float32).max)
     empty_seg = jax.device_put(
@@ -207,7 +230,10 @@ def main() -> None:
     )
 
     def slide_wire(i):
-        return jax.device_put(wire[i * SLIDE:(i + 1) * SLIDE], dev)
+        # plane-major (3, SLIDE) — see build_headline_step's layout note
+        return jax.device_put(
+            np.ascontiguousarray(wire[i * SLIDE:(i + 1) * SLIDE].T), dev
+        )
 
     # Warm-up (compile) + slide-0 digest (its ingest precedes window 0).
     seg0, rep0, warm = jstep(empty_seg, empty_rep, slide_wire(0), q_d)
@@ -237,15 +263,16 @@ def main() -> None:
     def timed_run():
         # Re-seed from slide 0's digest outside the timed region:
         # carrying the previous run's final slide into window 0 would
-        # merge non-adjacent panes.
-        sp, rp = seg0, rep0
+        # merge non-adjacent panes. Copies, not aliases — jstep_d
+        # donates its carry inputs.
+        sp, rp = jcopy(seg0), jcopy(rep0)
         fired = []
         t0 = time.perf_counter()
         staged = [slide_wire(1), slide_wire(2)]
         for w in range(N_WINDOWS):
             if w + 3 <= N_WINDOWS:
                 staged.append(slide_wire(w + 3))
-            sp, rp, res = jstep(sp, rp, staged.pop(0), q_d)
+            sp, rp, res = jstep_d(sp, rp, staged.pop(0), q_d)
             fired.append(res.num_valid)
         results = [int(v) for v in jax.device_get(fired)]
         return time.perf_counter() - t0, results
@@ -278,7 +305,9 @@ def main() -> None:
     # end is the only sync. This is the silicon number comparable to the
     # measured XLA:CPU in-RAM baseline.
     wire_all = jax.device_put(
-        wire[SLIDE:].reshape(N_WINDOWS, SLIDE, 3), dev
+        np.ascontiguousarray(
+            wire[SLIDE:].reshape(N_WINDOWS, SLIDE, 3).transpose(0, 2, 1)
+        ), dev,
     )
 
     def resident_pass(seg_prev, rep_prev, wire_r):
@@ -288,21 +317,21 @@ def main() -> None:
         carry, outs = jax.lax.scan(body, (seg_prev, rep_prev), wire_r)
         return carry[0], carry[1], outs
 
-    jresident = jax.jit(resident_pass)
+    jresident = jax.jit(resident_pass, donate_argnums=(0, 1))
 
     # Compile + force staging, then calibrate the pass count so a timed
     # run spans ~2 s (amortizes the final fetch's tunnel round trip).
-    s, r, outs = jresident(seg0, rep0, wire_all)
+    s, r, outs = jresident(jcopy(seg0), jcopy(rep0), wire_all)
     jax.device_get(outs[-1])
     t0 = time.perf_counter()
-    s, r, outs = jresident(seg0, rep0, wire_all)
+    s, r, outs = jresident(jcopy(seg0), jcopy(rep0), wire_all)
     fetched = jax.device_get(outs)
     t_pass = time.perf_counter() - t0
     resident_results = [int(v) for v in fetched[-1]]
     passes = int(np.clip(np.ceil(2.0 / max(t_pass, 1e-4)), 2, 64))
 
     def resident_run():
-        sp, rp = seg0, rep0
+        sp, rp = jcopy(seg0), jcopy(rep0)
         handles = []
         t0 = time.perf_counter()
         for _ in range(passes):

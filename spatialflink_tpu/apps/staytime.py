@@ -95,6 +95,77 @@ def cell_stay_time(
         yield (win.start, win.end, per_cell)
 
 
+def cell_stay_time_soa(
+    chunks,
+    window_s: int,
+    slide_s: int,
+    grid: UniformGrid,
+    allowed_lateness_s: int = 0,
+    oid_allow: Optional[np.ndarray] = None,
+) -> Iterator[Tuple[int, int, np.ndarray, np.ndarray]]:
+    """SoA/device fast path for ``cell_stay_time``: point chunks
+    {"ts","x","y","oid"} (dense int32 oids) → per window
+    (start, end, cell_ids, dwell_ms) raw arrays via ONE segment-sum
+    kernel per window (ops/trajectory.py:stay_time_cells_kernel) — the
+    per-trajectory Python walk of the object path collapses into a
+    device reduction (apps/StayTime.java:216-396). ``cell_ids`` may
+    include ``grid.num_cells`` (the object path's "out" bucket);
+    ``oid_allow``: optional bool mask over dense oids (the trajIdSet
+    filter) — filtered points are COMPACTED out before pairing, exactly
+    like the object path's pre-filter (masking alone would break
+    consecutive pairs differently). Parity test: tests/test_apps.py."""
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.trajectory import stay_time_cells_kernel
+    from spatialflink_tpu.streams.soa import SoaWindowAssembler
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    kernel = jitted(stay_time_cells_kernel, "num_cells")
+    asm = SoaWindowAssembler(
+        window_s * 1000, slide_s * 1000,
+        ooo_ms=allowed_lateness_s * 1000,
+    )
+    for win in asm.stream(chunks):
+        ts = np.asarray(win.arrays["ts"], np.int64)[:win.count]
+        oid = np.asarray(win.arrays["oid"], np.int64)[:win.count]
+        xy = np.stack(
+            [np.asarray(win.arrays["x"], np.float64)[:win.count],
+             np.asarray(win.arrays["y"], np.float64)[:win.count]],
+            axis=1,
+        )
+        if oid_allow is not None:
+            keep = oid_allow[oid]
+            ts, oid, xy = ts[keep], oid[keep], xy[keep]
+        if len(ts) == 0:
+            # Object-path parity: a window with no surviving events is
+            # SUPPRESSED (cell_stay_time's `if not evs: continue`), while
+            # one with events but no pairs fires empty.
+            continue
+        if len(ts) < 2:
+            yield (win.start, win.end, np.empty(0, np.int32),
+                   np.empty(0, np.int64))
+            continue
+        order = np.lexsort((ts, oid))
+        cells = grid.assign_cells_np(xy[order])
+        nb = next_bucket(len(ts), minimum=8)
+        pad = nb - len(ts)
+        t_rel = ts[order] - int(ts.min())  # int32-safe on non-x64 devices
+        tp = np.concatenate([t_rel, np.zeros(pad, np.int64)]).astype(np.int32)
+        op_ = np.concatenate(
+            [oid[order], np.full(pad, -1, np.int64)]).astype(np.int32)
+        cp = np.concatenate(
+            [cells, np.full(pad, grid.num_cells, np.int64)]).astype(np.int32)
+        vp = np.concatenate([np.ones(len(ts), bool), np.zeros(pad, bool)])
+        dwell, cnt = kernel(
+            jnp.asarray(tp), jnp.asarray(cp), jnp.asarray(op_),
+            jnp.asarray(vp), num_cells=grid.num_cells,
+        )
+        dwell = np.asarray(dwell).astype(np.int64)
+        hit = np.nonzero(np.asarray(cnt))[0].astype(np.int32)
+        yield (win.start, win.end, hit, dwell[hit])
+
+
 def cell_sensor_range_intersection(
     polygons: Iterable[Polygon],
     traj_ids: Set[str],

@@ -561,6 +561,16 @@ class TJoinQuery(SpatialOperator):
         # Trailing empty panes flush the windows that still contain the
         # last events (the assembler's end-of-stream flush).
         n_slides = (p_last - p_first + 1) + (ppw - 1)
+        # The scan stacks an (n_slides, K²) wmins output on device —
+        # it scales with the stream's TIME SPAN, not ppw; guard it like
+        # the digest (raise, don't OOM). Long streams: call in chunks.
+        out_bytes = n_slides * num_segments * num_segments * 4
+        if out_bytes > 2 << 30:
+            raise ValueError(
+                f"pane scan output n_slides·K² = {out_bytes / 1e9:.1f} GB "
+                f"exceeds the 2 GB guard ({n_slides} slides); feed the "
+                "stream in shorter bounded chunks or reduce num_segments"
+            )
 
         def pane_fields(t_arr, x_arr, y_arr, o_arr):
             """Per-pane padded (S, PC) field arrays + per-pane counts."""
@@ -596,20 +606,11 @@ class TJoinQuery(SpatialOperator):
             fxi[pane_s, lane] = xi[order].astype(np.int32)
             fyi[pane_s, lane] = yi[order].astype(np.int32)
             fcell[pane_s, lane] = cell[order]
-            # within-(pane, cell) slot rank — distinct ring slots for a
-            # pane's same-cell points (vectorized: sort by (pane, cell)).
-            key_order = np.lexsort((cell[order], pane_s))
-            ps2, c2 = pane_s[key_order], cell[order][key_order]
-            newrun = np.ones(len(ps2), bool)
-            if len(ps2) > 1:
-                newrun[1:] = (ps2[1:] != ps2[:-1]) | (c2[1:] != c2[:-1])
-            run_id = np.cumsum(newrun) - 1
-            pos = np.arange(len(ps2))
-            run_start = pos[newrun][run_id]
-            rank2 = pos - run_start
-            rank = np.empty(len(ps2), np.int64)
-            rank[key_order] = rank2
-            frank[pane_s, lane] = rank.astype(np.int32)
+            from spatialflink_tpu.ops.tjoin_panes import pane_cell_ranks
+
+            frank[pane_s, lane] = pane_cell_ranks(
+                pane_s, cell[order]
+            ).astype(np.int32)
             return (fx, fy, fxi, fyi, fcell, frank, fo, fv), counts
 
         lfields, lcounts = pane_fields(lt, lx, ly, lo)

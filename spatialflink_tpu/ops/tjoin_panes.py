@@ -42,7 +42,28 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spatialflink_tpu.ops.select import first_k_onehot, onehot_select_preferred
+
+
+def pane_cell_ranks(pane: "np.ndarray", cell: "np.ndarray") -> "np.ndarray":
+    """Within-(pane, cell) slot ranks, vectorized — the host half of
+    ``_insert``'s ring-slot contract (a pane's same-cell points need
+    distinct slots). ONE home, shared by the operator wrapper and the
+    benchmark staging (drift here would silently change collision
+    behavior between the product path and the measured path)."""
+    n = len(pane)
+    order = np.lexsort((cell, pane))
+    ps, cs = pane[order], cell[order]
+    newrun = np.ones(n, bool)
+    if n > 1:
+        newrun[1:] = (ps[1:] != ps[:-1]) | (cs[1:] != cs[:-1])
+    run_id = np.cumsum(newrun) - 1
+    pos = np.arange(n)
+    rank = np.empty(n, np.int64)
+    rank[order] = pos - pos[newrun][run_id]
+    return rank
 
 
 class TJoinPaneCarry(NamedTuple):

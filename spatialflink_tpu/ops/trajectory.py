@@ -202,6 +202,37 @@ def traj_stats_pane_kernel(
     return TrajPaneStats(w_d, w_dt, w_cnt)
 
 
+def stay_time_cells_kernel(
+    ts: jnp.ndarray,
+    cell: jnp.ndarray,
+    oid: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_cells: int,
+) -> jnp.ndarray:
+    """Per-cell dwell time for one window: consecutive same-trajectory
+    time gaps attributed to the EARLIER point's grid cell, summed per
+    cell — the device form of the StayTime app's per-trajectory walk
+    (apps/StayTime.java:216-396 CellStayTimeWinFunction + :433-447
+    aggregate). Inputs pre-sorted by (oid, ts), padding at the end;
+    out-of-grid points carry ``cell == num_cells`` and land in the last
+    ("out") bucket. Returns ((num_cells + 1,) int32 ms sums,
+    (num_cells + 1,) int32 pair counts)."""
+    same = (oid[1:] == oid[:-1]) & valid[1:] & valid[:-1]
+    gaps = jnp.where(same, (ts[1:] - ts[:-1]).astype(jnp.int32),
+                     jnp.int32(0))
+    key = jnp.where(same & valid[:-1], cell[:-1].astype(jnp.int32),
+                    jnp.int32(num_cells + 1))
+    dwell = jax.ops.segment_sum(
+        gaps, key, num_segments=num_cells + 2
+    )[:num_cells + 1]
+    # Pair counts distinguish "cell with only zero-length gaps" (the
+    # object path still emits the key, value 0) from "no pairs".
+    count = jax.ops.segment_sum(
+        same.astype(jnp.int32), key, num_segments=num_cells + 2
+    )[:num_cells + 1]
+    return dwell, count
+
+
 class TrajPairs(NamedTuple):
     """Deduped trajectory-pair join output (device-compacted).
 

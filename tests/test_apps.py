@@ -118,3 +118,86 @@ def test_sensor_intersection_thin_strip_crossing():
     # The strip crosses cells (x, 4) for all x; cell (5,4) has no strip
     # vertex inside it and its corners are outside the thin band.
     assert cells.get(GRID.cell_name(5 * 10 + 4)) == 1
+
+
+def test_cell_stay_time_soa_matches_object_path():
+    """Device SoA dwell (stay_time_cells_kernel) must equal the object
+    path per (window, cell), including zero-gap keys, out-of-grid "out"
+    buckets, and the trajId filter semantics."""
+    from spatialflink_tpu.apps.staytime import cell_stay_time_soa
+
+    rng = np.random.default_rng(21)
+    n, n_obj = 4_000, 12
+    ts = np.sort(rng.integers(0, 40_000, n)).astype(np.int64)
+    # include some out-of-grid points and some equal timestamps
+    x = rng.uniform(-0.5, 10.5, n)
+    y = rng.uniform(-0.5, 10.5, n)
+    oid = rng.integers(0, n_obj, n)
+    ts[100] = ts[101]  # a zero gap somewhere
+    names = [f"obj{i}" for i in range(n_obj)]
+    pts = [
+        Point(obj_id=names[oid[i]], timestamp=int(ts[i]),
+              x=float(x[i]), y=float(y[i]))
+        for i in range(n)
+    ]
+    obj = {
+        (s_, e): cells
+        for s_, e, cells in cell_stay_time(iter(pts), set(), 0, 10, 5, GRID)
+    }
+    chunks = [{"ts": ts, "x": x, "y": y, "oid": oid.astype(np.int32)}]
+    soa = {}
+    for s_, e, cid, dwell in cell_stay_time_soa(iter(chunks), 10, 5, GRID):
+        soa[(s_, e)] = {
+            (GRID.cell_name(int(c)) if c < GRID.num_cells else "out"):
+                float(d)
+            for c, d in zip(cid, dwell)
+        }
+    assert obj, "object path fired no windows"
+    for span, cells in obj.items():
+        assert span in soa, f"SoA missed window {span}"
+        assert soa[span] == cells, f"window {span} diverges"
+
+
+def test_cell_stay_time_soa_traj_filter():
+    from spatialflink_tpu.apps.staytime import cell_stay_time_soa
+
+    # two objects alternating in one cell; filtering one must RE-PAIR
+    # the other's consecutive points (compaction, not masking)
+    pts = []
+    ts = [0, 1000, 2000, 3000, 4000, 5000]
+    for i, t in enumerate(ts):
+        pts.append(Point(obj_id="keep" if i % 2 == 0 else "drop",
+                         timestamp=t, x=1.5, y=1.5))
+    obj = list(cell_stay_time(iter(pts), {"keep"}, 0, 10, 10, GRID))
+    chunks = [{
+        "ts": np.asarray(ts, np.int64),
+        "x": np.full(6, 1.5), "y": np.full(6, 1.5),
+        "oid": np.asarray([0, 1, 0, 1, 0, 1], np.int32),
+    }]
+    allow = np.asarray([True, False])
+    soa = list(cell_stay_time_soa(iter(chunks), 10, 10, GRID,
+                                  oid_allow=allow))
+    name = GRID.cell_name(GRID.flat_cell(1.5, 1.5))
+    assert obj[0][2] == {name: 4000.0}  # keep: 0->2000->4000
+    (s_, e, cid, dwell) = soa[0]
+    assert [int(c) for c in cid] == [GRID.flat_cell(1.5, 1.5)]
+    assert float(dwell[0]) == 4000.0
+
+
+def test_cell_stay_time_soa_suppresses_fully_filtered_windows():
+    from spatialflink_tpu.apps.staytime import cell_stay_time_soa
+
+    # a window whose only events are filtered out must NOT fire (the
+    # object path continues); one kept event fires empty.
+    chunks = [{
+        "ts": np.asarray([100, 200, 10_100], np.int64),
+        "x": np.asarray([1.5, 1.6, 1.5]),
+        "y": np.asarray([1.5, 1.6, 1.5]),
+        "oid": np.asarray([1, 1, 0], np.int32),
+    }]
+    allow = np.asarray([True, False])
+    out = list(cell_stay_time_soa(iter(chunks), 10, 10, GRID,
+                                  oid_allow=allow))
+    # window [0,10s): only filtered oid=1 events -> suppressed;
+    # window [10s,20s): one kept oid=0 event -> fires empty
+    assert [(s_, e, len(c)) for s_, e, c, _ in out] == [(10_000, 20_000, 0)]
