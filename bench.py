@@ -52,15 +52,23 @@ BASELINE_EPS = 20_000.0
 
 
 def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
-                        radius=RADIUS, cand=CAND):
+                        radius=RADIUS, cand=CAND, pallas=False):
     """The headline program, shared verbatim with the CPU-baseline run
     (bench_suite.bench_headline_knn_1m): one slide of packed wire records
     + the carried digest → (new digest, window KnnResult).
 
     ``wire_s``: (3, slide) uint16 PLANE-MAJOR rows — x_q, y_q, oid (int16
     bits). Returns a raw fn for jax.jit / lax.scan embedding.
+
+    ``pallas=True`` (TPU): the digest's candidate selection runs as the
+    fused Pallas extraction pass (ops/pallas_digest.py — one streaming
+    sweep, cost ∝ matches) with an IN-PROGRAM ``lax.cond`` fallback to
+    the full XLA scatter digest whenever the hit count exceeds the
+    candidate budget — the step is exact either way. main() self-checks
+    one slide against the XLA step before trusting the lowering.
     """
     from spatialflink_tpu.ops.knn import (
+        _digest_from_point_dists,
         _digest_from_point_dists_compact,
         knn_merge_digest_list,
     )
@@ -71,6 +79,53 @@ def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
     sy = np.float32(wf.scale[1])
     ox = np.float32(wf.origin[0])
     oy = np.float32(wf.origin[1])
+
+    if pallas:
+        from spatialflink_tpu.ops.pallas_digest import (
+            PALLAS_DIGEST_MAX_CAND,
+            digest_from_candidates,
+            wire_candidates_pallas,
+        )
+
+        import jax as _jax
+
+        def pallas_step(seg_prev, rep_prev, wire_s, query_xy):
+            consts = jnp.stack([
+                jnp.float32(radius),
+                jnp.float32(sx), jnp.float32(ox), query_xy[0],
+                jnp.float32(sy), jnp.float32(oy), query_xy[1],
+                jnp.float32(0.0),
+            ]).reshape(1, 8)
+            cd, co, cidx, cnt = wire_candidates_pallas(
+                wire_s[0].astype(jnp.int32), wire_s[1].astype(jnp.int32),
+                wire_s[2].astype(jnp.int32), consts,
+            )
+
+            def from_candidates(_):
+                return digest_from_candidates(cd, co, cidx, nseg)
+
+            def full_xla(_):
+                xq = wire_s[0].astype(jnp.float32)
+                yq = wire_s[1].astype(jnp.float32)
+                dxf = (xq * sx + ox) - query_xy[0]
+                dyf = (yq * sy + oy) - query_xy[1]
+                dist = jnp.sqrt(dxf * dxf + dyf * dyf)
+                return _digest_from_point_dists(
+                    dist, jnp.ones((wire_s.shape[1],), bool), None,
+                    wire_s[2].astype(jnp.int32), np.float32(radius), nseg,
+                    index_base=jnp.int32(0),
+                )
+
+            d = _jax.lax.cond(
+                cnt <= PALLAS_DIGEST_MAX_CAND, from_candidates, full_xla,
+                None,
+            )
+            res = knn_merge_digest_list(
+                (seg_prev, d.seg_min), (rep_prev, d.rep), bases, k=k
+            )
+            return d.seg_min, d.rep, res
+
+        return pallas_step
 
     def step(seg_prev, rep_prev, wire_s, query_xy):
         # PLANE-MAJOR wire: (3, slide) u16 rows — a (slide, 2) coordinate
@@ -240,12 +295,46 @@ def main() -> None:
     jax.device_get(warm.num_valid)  # true sync (block_until_ready is a
     # no-op on the axon tunnel)
 
+    import contextlib
+    import os as _os
+
+    # Fused Pallas digest selection (TPU only): self-check one slide
+    # against the XLA step — the in-radius SET must match exactly,
+    # distances within 1 ulp (Mosaic vs XLA FMA freedom) — then the
+    # throughput loops run the fused step (exactness is in-program via
+    # its lax.cond fallback). Any failure → stay on the XLA step.
+    step_kind = "xla"
+    if dev.platform in ("tpu", "axon") and not _os.environ.get(
+            "SFT_NO_PALLAS_DIGEST"):
+        try:
+            pstep = build_headline_step(jnp, wf, pallas=True)
+            jp = jax.jit(pstep)
+            s_p, r_p, res_p = jp(empty_seg, empty_rep, slide_wire(0), q_d)
+            sa, sb = jax.device_get((s_p, seg0))
+            ra, rb = jax.device_get((r_p, rep0))
+            live_a, live_b = sa != big, sb != big
+            ok = bool(np.array_equal(live_a, live_b))
+            if ok and live_a.any():
+                ulp = np.spacing(np.maximum(np.abs(sa), np.abs(sb)))
+                ok = bool(
+                    np.all(np.abs(sa[live_a] - sb[live_a])
+                           <= ulp[live_a])
+                )
+                exact = live_a & (sa == sb)
+                ok = ok and bool(np.array_equal(ra[exact], rb[exact]))
+            if ok:
+                step = pstep
+                jstep = jp
+                jstep_d = jax.jit(pstep, donate_argnums=(0, 1))
+                seg0, rep0 = s_p, r_p  # slide-0 digest from the same step
+                step_kind = "pallas"
+        except Exception as e:  # pragma: no cover - lowering failure
+            sys.stderr.write(f"pallas digest disabled: {e!r}\n")
+
     # Kernel-level tracing hook (the SURVEY §5 "jax.profiler traces"
     # analog of the reference's Flink metric operators): set
     # SFT_PROFILE_DIR=<dir> to capture an XLA/runtime trace of the
     # measured loop (view with tensorboard or xprof).
-    import contextlib
-    import os as _os
 
     profile_dir = _os.environ.get("SFT_PROFILE_DIR")
     trace_ctx = (
@@ -370,6 +459,7 @@ def main() -> None:
         "windows": N_WINDOWS,
         "k": K,
         "wire_bytes_per_point": wf.bytes_per_point,
+        "digest_step": step_kind,
         "device_resident_points_per_sec": round(resident_pps, 1),
         "device_resident_passes": passes,
         "device_resident_vs_baseline": round(resident_pps / BASELINE_EPS, 2),
