@@ -171,10 +171,55 @@ def test_gzip_magic0_wrapper_keeps_absolute_offsets():
     assert [(o, v) for o, _, _, v in out] == [(3, b"x"), (4, b"y")]
 
 
-def test_snappy_message_set_still_rejected():
+def test_snappy_decompress_literals_roundtrip():
+    import os
+    payload = os.urandom(200_000)  # spans multiple 64k literal chunks
+    assert kw.snappy_decompress(kw.snappy_compress_literal(payload)) == payload
+    assert kw.snappy_decompress(kw.snappy_compress_literal(b"")) == b""
+
+
+def test_snappy_decompress_copies_and_xerial():
+    # hand-crafted raw stream: literal "abcd" + copy1(off=4, len=4)
+    # + copy2(off=2, len=3 overlapping)
+    raw = bytes([
+        11,            # varint uncompressed length = 11
+        (4 - 1) << 2,  # literal, len 4
+    ]) + b"abcd" + bytes([
+        ((4 - 4) & 7) << 2 | ((4 >> 8) << 5) | 1, 4 & 0xFF,  # copy1 off=4 len=4
+        (3 - 1) << 2 | 2, 2, 0,  # copy2 off=2 len=3 (overlapping: "cdc")
+    ])
+    assert kw.snappy_decompress(raw) == b"abcdabcdcdc"
+    # xerial framing: magic + version ints + one length-prefixed block
+    framed = (b"\x82SNAPPY\x00" + struct.pack(">ii", 1, 1)
+              + struct.pack(">i", len(raw)) + raw)
+    assert kw.snappy_decompress(framed) == b"abcdabcdcdc"
+
+
+def test_snappy_message_set_decodes():
+    inner = kw.encode_message_set([(b"a", None, 10), (b"b", b"k", 20)])
+    comp = kw.snappy_compress_literal(inner)
+    body = struct.pack(">bbq", 1, 0x02, 99) + kw.enc_bytes(None) + kw.enc_bytes(comp)
+    msg = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
+    wire = struct.pack(">qi", 8, len(msg)) + msg
+    out = kw.decode_message_set(wire)
+    assert [(o, t, k, v) for o, t, k, v in out] == [
+        (7, 10, None, b"a"), (8, 20, b"k", b"b"),
+    ]
+
+
+def test_lz4_message_set_still_rejected():
+    inner = kw.encode_message_set([(b"a", None, 1)])
+    wire = _gzip_wrapper(inner, wrapper_offset=0, wrapper_ts=0, attrs=0x03)
+    with pytest.raises(NotImplementedError, match="lz4"):
+        kw.decode_message_set(wire)
+
+
+def test_snappy_garbage_raises_value_error():
+    # attrs=0x02 but the payload is GZIP bytes — the snappy decoder must
+    # fail loudly, not return garbage
     inner = kw.encode_message_set([(b"a", None, 1)])
     wire = _gzip_wrapper(inner, wrapper_offset=0, wrapper_ts=0, attrs=0x02)
-    with pytest.raises(NotImplementedError, match="snappy"):
+    with pytest.raises((ValueError, IndexError)):
         kw.decode_message_set(wire)
 
 
