@@ -163,22 +163,87 @@ _ERROR_RECORD = {
 }
 
 
+def _last_good_path():
+    import os
+
+    return os.environ.get(
+        "SFT_BENCH_LAST_GOOD",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_LAST_GOOD.json"),
+    )
+
+
+def _record_last_good(record: dict) -> None:
+    """Persist the newest successful capture (value > 0) so a later
+    outage degrades the bench record to "stale" instead of zero. Stored
+    alongside the record: capture wall-clock (UTC ISO) and the git SHA
+    the capture ran against."""
+    import datetime
+    import os
+    import subprocess
+
+    if not record.get("value"):
+        return
+    sha = None
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    try:
+        with open(_last_good_path(), "w") as f:
+            json.dump({
+                "record": record,
+                "captured_at": datetime.datetime.now(
+                    datetime.timezone.utc
+                ).isoformat(timespec="seconds"),
+                "git_sha": sha,
+            }, f, indent=1)
+            f.write("\n")
+    except OSError as e:  # pragma: no cover - fs trouble is non-fatal
+        sys.stderr.write(f"last-good store not written: {e}\n")
+
+
+def _load_last_good():
+    try:
+        with open(_last_good_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def _supervise() -> None:
     """Retry-with-backoff around the real benchmark: a down tunnel hangs
     device init in an unkillable C call, so each dial attempt is a FRESH
-    subprocess (in-process retry cannot recover a hung init). 3 attempts
-    with 30 s / 60 s backoff — a transient outage no longer zeroes a
-    round's record (round-3 lesson: BENCH_r03 was a watchdog error
-    record from a single 600 s dial). Only the final outcome's JSON line
-    is relayed; the driver still sees exactly one line."""
+    subprocess (in-process retry cannot recover a hung init). The driver
+    run is the round's ONE shot at an on-chip record, so the dials
+    spread over a long wall-clock window (default backoffs 30/60/120/
+    300/600 s → 6 dials across ~20-40 min; the round-3/4 outages lasted
+    hours, but a within-the-hour blip no longer zeroes the round).
+    Override with SFT_BENCH_BACKOFFS="s1,s2,..." (tests use "0").
+
+    Outcomes, always exactly ONE stdout JSON line:
+    - success → the child's record relayed verbatim; also persisted to
+      BENCH_LAST_GOOD.json (value, device, UTC timestamp, git SHA).
+    - final failure → an honest error record (``value`` 0, never a
+      stale number) carrying ``last_good`` metadata from the newest
+      persisted capture, clearly labeled ``stale: true``."""
     import os
     import subprocess
     import time
 
+    backoffs = [
+        float(s) for s in os.environ.get(
+            "SFT_BENCH_BACKOFFS", "30,60,120,300,600"
+        ).split(",") if s.strip()
+    ]
     last_out, last_rc = "", 3
-    for attempt in range(3):
+    for attempt in range(len(backoffs) + 1):
         if attempt:
-            time.sleep(30 * 2 ** (attempt - 1))  # 30 s, then 60 s
+            time.sleep(backoffs[attempt - 1])
         try:
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
@@ -194,16 +259,38 @@ def _supervise() -> None:
             continue
         if p.returncode == 0:
             sys.stdout.write(p.stdout)
+            lines = [ln for ln in p.stdout.strip().splitlines()
+                     if ln.startswith("{")]
+            if lines:
+                try:
+                    _record_last_good(json.loads(lines[-1]))
+                except ValueError:
+                    pass
             return
     lines = [ln for ln in last_out.strip().splitlines()
              if ln.startswith("{")]
     if lines:
-        print(lines[-1])
+        record = json.loads(lines[-1])
     else:
-        print(json.dumps({
+        record = {
             **_ERROR_RECORD,
-            "error": f"bench child failed rc={last_rc} after 3 attempts",
-        }))
+            "error": f"bench child failed rc={last_rc} "
+                     f"after {len(backoffs) + 1} attempts",
+        }
+    good = _load_last_good()
+    if good and good.get("record", {}).get("value"):
+        record["last_good"] = {
+            "stale": True,
+            "value": good["record"]["value"],
+            "unit": good["record"].get("unit"),
+            "vs_baseline": good["record"].get("vs_baseline"),
+            "device": good["record"].get("device"),
+            "device_resident_points_per_sec": good["record"].get(
+                "device_resident_points_per_sec"),
+            "captured_at": good.get("captured_at"),
+            "git_sha": good.get("git_sha"),
+        }
+    print(json.dumps(record))
     sys.exit(3)
 
 
@@ -213,6 +300,22 @@ def main() -> None:
 
     if not _os.environ.get("SFT_BENCH_CHILD"):
         _supervise()
+        return
+
+    if _os.environ.get("SFT_BENCH_FORCE_FAIL"):
+        # Simulated-outage hook for the JSON-contract test: behave
+        # exactly like the init-watchdog firing, without dialing the
+        # device (a real down tunnel hangs for 180 s per dial).
+        print(json.dumps({
+            **_ERROR_RECORD,
+            "error": "device tunnel unreachable (simulated outage)",
+        }))
+        sys.exit(3)
+    fake = _os.environ.get("SFT_BENCH_FAKE_RECORD")
+    if fake:
+        # Simulated-success hook (contract test): the supervisor must
+        # relay this verbatim AND persist it to the last-good store.
+        print(fake)
         return
 
     # Device-init watchdog: the tunnel's site hook dials the device while

@@ -1,0 +1,149 @@
+"""bench.py JSON-contract tests under a simulated device outage.
+
+The driver runs ``python bench.py`` once per round and records the single
+stdout JSON line. These tests pin the contract without a device:
+
+- an outage (child exits 3 on every dial) yields rc=3, ``value`` 0 (never
+  a stale number), an ``error``, and ``last_good`` metadata from the
+  newest persisted capture, labeled ``stale: true``;
+- a successful dial is relayed verbatim and persisted to the last-good
+  store (value, device, UTC timestamp, git SHA).
+
+The hooks (``SFT_BENCH_FORCE_FAIL`` / ``SFT_BENCH_FAKE_RECORD``) short-
+circuit the child before it imports jax, so these run in milliseconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+
+def _run(tmp_path, extra_env, last_good=None):
+    lg = tmp_path / "last_good.json"
+    if last_good is not None:
+        lg.write_text(json.dumps(last_good))
+    env = {
+        **os.environ,
+        "SFT_BENCH_BACKOFFS": "0",
+        "SFT_BENCH_LAST_GOOD": str(lg),
+        **extra_env,
+    }
+    env.pop("SFT_BENCH_CHILD", None)
+    p = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln]
+    return p, lines, lg
+
+
+FIXTURE_GOOD = {
+    "record": {
+        "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+        "value": 3282867.3,
+        "unit": "points/s",
+        "vs_baseline": 164.14,
+        "device": "TPU v5 lite0",
+        "device_resident_points_per_sec": 4.9e8,
+    },
+    "captured_at": "2026-07-30T14:06:27+00:00",
+    "git_sha": "70bd1ee5267c960c84ea5137456de82d29049f0b",
+}
+
+
+class TestOutageRecord:
+    def test_outage_with_last_good(self, tmp_path):
+        p, lines, _ = _run(
+            tmp_path, {"SFT_BENCH_FORCE_FAIL": "1"}, last_good=FIXTURE_GOOD
+        )
+        assert p.returncode == 3
+        assert len(lines) == 1, f"driver contract: ONE line, got {lines}"
+        rec = json.loads(lines[0])
+        # Never report a stale number in `value`.
+        assert rec["value"] == 0
+        assert rec["vs_baseline"] == 0
+        assert "unreachable" in rec["error"]
+        lg = rec["last_good"]
+        assert lg["stale"] is True
+        assert lg["value"] == 3282867.3
+        assert lg["device"] == "TPU v5 lite0"
+        assert lg["device_resident_points_per_sec"] == 4.9e8
+        assert lg["captured_at"].startswith("2026-07-30T")
+        assert len(lg["git_sha"]) == 40
+
+    def test_outage_without_last_good(self, tmp_path):
+        p, lines, _ = _run(tmp_path, {"SFT_BENCH_FORCE_FAIL": "1"})
+        assert p.returncode == 3
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        assert "last_good" not in rec
+
+    def test_corrupt_last_good_is_ignored(self, tmp_path):
+        (tmp_path / "last_good.json").write_text("{not json")
+        p, lines, _ = _run(tmp_path, {"SFT_BENCH_FORCE_FAIL": "1"})
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        assert "last_good" not in rec
+
+
+class TestSuccessRecord:
+    def test_success_relayed_and_persisted(self, tmp_path):
+        good = {
+            "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+            "value": 123456.7,
+            "unit": "points/s",
+            "vs_baseline": 6.17,
+            "device": "TPU v5 lite0",
+        }
+        p, lines, lg_path = _run(
+            tmp_path, {"SFT_BENCH_FAKE_RECORD": json.dumps(good)}
+        )
+        assert p.returncode == 0
+        assert len(lines) == 1
+        assert json.loads(lines[0]) == good
+        stored = json.loads(lg_path.read_text())
+        assert stored["record"] == good
+        # ISO-8601 UTC timestamp + the capture's git SHA.
+        assert "T" in stored["captured_at"]
+        assert stored["captured_at"].endswith("+00:00")
+        assert len(stored["git_sha"]) == 40
+
+    def test_zero_value_record_not_persisted(self, tmp_path):
+        zero = {**bench._ERROR_RECORD}
+        p, lines, lg_path = _run(
+            tmp_path, {"SFT_BENCH_FAKE_RECORD": json.dumps(zero)}
+        )
+        assert p.returncode == 0
+        assert not lg_path.exists()
+
+
+class TestLastGoodStore:
+    def test_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SFT_BENCH_LAST_GOOD", str(tmp_path / "lg.json")
+        )
+        bench._record_last_good({"value": 42.0, "unit": "points/s"})
+        got = bench._load_last_good()
+        assert got["record"]["value"] == 42.0
+        assert len(got["git_sha"]) == 40
+
+    def test_missing_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SFT_BENCH_LAST_GOOD", str(tmp_path / "absent.json")
+        )
+        assert bench._load_last_good() is None
+
+    def test_committed_seed_is_valid(self):
+        """The repo ships a seed store from the r02 chip capture."""
+        with open(os.path.join(REPO, "BENCH_LAST_GOOD.json")) as f:
+            seed = json.load(f)
+        assert seed["record"]["value"] > 1e6
+        assert seed["record"]["device"] == "TPU v5 lite0"
+        assert len(seed["git_sha"]) == 40
