@@ -54,99 +54,37 @@ BASELINE_EPS = 20_000.0
 def build_headline_step(jnp, wf, slide=SLIDE, k=K, nseg=NUM_SEGMENTS,
                         radius=RADIUS, cand=CAND, pallas=False):
     """The headline program, shared verbatim with the CPU-baseline run
-    (bench_suite.bench_headline_knn_1m): one slide of packed wire records
-    + the carried digest → (new digest, window KnnResult).
+    (bench_suite.bench_headline_knn_1m) AND the shipped operator path
+    (operators/knn_query.py:run_wire_panes): one slide of packed wire
+    records + the carried digest → (new digest, window KnnResult).
+
+    The wire→digest step itself lives in ops/wire_knn.py — ONE program
+    for operator, bench, and suite (VERDICT r4 weak #3: the measured
+    and shipped programs had diverged). This wrapper adds only the
+    2-pane window merge and bakes the statics.
 
     ``wire_s``: (3, slide) uint16 PLANE-MAJOR rows — x_q, y_q, oid (int16
     bits). Returns a raw fn for jax.jit / lax.scan embedding.
 
-    ``pallas=True`` (TPU): the digest's candidate selection runs as the
-    fused Pallas extraction pass (ops/pallas_digest.py — one streaming
-    sweep, cost ∝ matches) with an IN-PROGRAM ``lax.cond`` fallback to
-    the full XLA scatter digest whenever the hit count exceeds the
-    candidate budget — the step is exact either way. main() self-checks
-    one slide against the XLA step before trusting the lowering.
+    ``pallas=True`` (TPU): the fused Pallas extraction with the
+    IN-PROGRAM ``lax.cond`` overflow fallback — exact either way;
+    main() self-checks one slide against the XLA step before trusting
+    the lowering (ops/wire_knn.py:digests_agree).
     """
-    from spatialflink_tpu.ops.knn import (
-        _digest_from_point_dists,
-        _digest_from_point_dists_compact,
-        knn_merge_digest_list,
-    )
+    from spatialflink_tpu.ops.knn import knn_merge_digest_list
+    from spatialflink_tpu.ops.wire_knn import make_wire_digest_step
 
     bases = np.asarray([0, slide], np.int32)
-
-    sx = np.float32(wf.scale[0])
-    sy = np.float32(wf.scale[1])
-    ox = np.float32(wf.origin[0])
-    oy = np.float32(wf.origin[1])
-
-    if pallas:
-        from spatialflink_tpu.ops.pallas_digest import (
-            PALLAS_DIGEST_MAX_CAND,
-            digest_from_candidates,
-            wire_candidates_pallas,
-        )
-
-        import jax as _jax
-
-        def pallas_step(seg_prev, rep_prev, wire_s, query_xy):
-            consts = jnp.stack([
-                jnp.float32(radius),
-                jnp.float32(sx), jnp.float32(ox), query_xy[0],
-                jnp.float32(sy), jnp.float32(oy), query_xy[1],
-                jnp.float32(0.0),
-            ]).reshape(1, 8)
-            cd, co, cidx, cnt = wire_candidates_pallas(
-                wire_s[0].astype(jnp.int32), wire_s[1].astype(jnp.int32),
-                wire_s[2].astype(jnp.int32), consts,
-            )
-
-            def from_candidates(_):
-                return digest_from_candidates(cd, co, cidx, nseg)
-
-            def full_xla(_):
-                xq = wire_s[0].astype(jnp.float32)
-                yq = wire_s[1].astype(jnp.float32)
-                dxf = (xq * sx + ox) - query_xy[0]
-                dyf = (yq * sy + oy) - query_xy[1]
-                dist = jnp.sqrt(dxf * dxf + dyf * dyf)
-                return _digest_from_point_dists(
-                    dist, jnp.ones((wire_s.shape[1],), bool), None,
-                    wire_s[2].astype(jnp.int32), np.float32(radius), nseg,
-                    index_base=jnp.int32(0),
-                )
-
-            d = _jax.lax.cond(
-                cnt <= PALLAS_DIGEST_MAX_CAND, from_candidates, full_xla,
-                None,
-            )
-            res = knn_merge_digest_list(
-                (seg_prev, d.seg_min), (rep_prev, d.rep), bases, k=k
-            )
-            return d.seg_min, d.rep, res
-
-        return pallas_step
+    scale = jnp.asarray(np.asarray(wf.scale, np.float32))
+    origin = jnp.asarray(np.asarray(wf.origin, np.float32))
+    r32 = np.float32(radius)
+    digest = make_wire_digest_step(
+        num_segments=nseg, cand=cand,
+        strategy="pallas" if pallas else "xla",
+    )
 
     def step(seg_prev, rep_prev, wire_s, query_xy):
-        # PLANE-MAJOR wire: (3, slide) u16 rows — a (slide, 2) coordinate
-        # tensor tiles onto 2 of the 128 TPU lanes (the (N,2) layout
-        # lever, BASELINE.md); contiguous (slide,) planes keep the
-        # dequant + distance fully lane-parallel. Same f32 ops in the
-        # same order as dequantize()+point_point_distance; inside one
-        # jit XLA may FMA-fuse differently than the eager digest path
-        # (≤1 ulp on distances) — the CPU baseline runs THIS program,
-        # so the comparison stays exact.
-        xq = wire_s[0].astype(jnp.float32)
-        yq = wire_s[1].astype(jnp.float32)
-        oid = wire_s[2].astype(jnp.int32)  # oids < 32768: bit-exact
-        dx = (xq * sx + ox) - query_xy[0]
-        dy = (yq * sy + oy) - query_xy[1]
-        dist = jnp.sqrt(dx * dx + dy * dy)
-        valid = jnp.ones((wire_s.shape[1],), bool)
-        d = _digest_from_point_dists_compact(
-            dist, valid, None, oid, np.float32(radius), nseg,
-            index_base=jnp.int32(0), cand=cand,
-        )
+        d = digest(wire_s, wire_s.shape[1], query_xy, scale, origin, r32)
         res = knn_merge_digest_list(
             (seg_prev, d.seg_min), (rep_prev, d.rep), bases, k=k
         )
@@ -410,22 +348,12 @@ def main() -> None:
     if dev.platform in ("tpu", "axon") and not _os.environ.get(
             "SFT_NO_PALLAS_DIGEST"):
         try:
+            from spatialflink_tpu.ops.wire_knn import digests_agree
+
             pstep = build_headline_step(jnp, wf, pallas=True)
             jp = jax.jit(pstep)
             s_p, r_p, res_p = jp(empty_seg, empty_rep, slide_wire(0), q_d)
-            sa, sb = jax.device_get((s_p, seg0))
-            ra, rb = jax.device_get((r_p, rep0))
-            live_a, live_b = sa != big, sb != big
-            ok = bool(np.array_equal(live_a, live_b))
-            if ok and live_a.any():
-                ulp = np.spacing(np.maximum(np.abs(sa), np.abs(sb)))
-                ok = bool(
-                    np.all(np.abs(sa[live_a] - sb[live_a])
-                           <= ulp[live_a])
-                )
-                exact = live_a & (sa == sb)
-                ok = ok and bool(np.array_equal(ra[exact], rb[exact]))
-            if ok:
+            if digests_agree(s_p, r_p, seg0, rep0):
                 step = pstep
                 jstep = jp
                 jstep_d = jax.jit(pstep, donate_argnums=(0, 1))

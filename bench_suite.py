@@ -215,21 +215,20 @@ def bench_range_window(jax, jnp, grid, quick):
 def bench_knn_k(jax, jnp, grid, k, quick):
     """Config 2: continuous kNN, k ∈ {10, 50, 500}, 5s/1s sliding windows.
 
-    Measures the pane-digest-carry sliding path in its TPU-first form —
-    the operator's query_panes/run_soa_panes program (ops/knn.py:
-    knn_pane_digest_compact + knn_merge_digests): each 1s pane (200k
-    points at the 200k EPS event rate) is digested ONCE via top-k
-    compaction, each window fire min-merges the 5 live digests and
-    top-ks. Ingest is streamed in the 6 B/pt packed wire format
-    (streams/wire.py): every point crosses host→device exactly once,
-    double-buffered so the next pane's transfer overlaps this window's
-    compute — the same dispatch model as bench.py's headline loop.
-    Rate = distinct ingested points / wall time, median of REPS runs.
+    Measures the shipped operator program — run_wire_panes
+    (operators/knn_query.py), whose wire→digest step is the ONE shared
+    implementation in ops/wire_knn.py (also bench.py's headline): each
+    1s pane (200k points at the 200k EPS event rate) of 6 B/pt
+    plane-major wire records is digested ONCE (top-k compaction on XLA;
+    the fused Pallas extraction on TPU after a first-pane self-check —
+    ``digest_step`` records which won), each window fire min-merges the
+    5 live digests and top-ks. Every point crosses host→device exactly
+    once, double-buffered so the next pane's transfer overlaps this
+    window's compute. Rate = distinct ingested points / wall time,
+    median of REPS runs.
     """
-    from spatialflink_tpu.ops.knn import (
-        knn_merge_digest_list,
-        knn_pane_digest_compact,
-    )
+    from spatialflink_tpu.ops.knn import knn_merge_digest_list
+    from spatialflink_tpu.ops.wire_knn import select_wire_digest_step
     from spatialflink_tpu.streams.wire import WireFormat
 
     ppw = 5
@@ -245,22 +244,29 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     )
     dev = jax.devices()[0]
     q = jax.device_put(jnp.asarray(np.array([116.40, 40.19], np.float32)), dev)
+    scale = jax.device_put(jnp.asarray(np.asarray(wf.scale, np.float32)), dev)
+    origin = jax.device_put(
+        jnp.asarray(np.asarray(wf.origin, np.float32)), dev
+    )
+    r32 = np.float32(0.05)
+
+    def pane_arrays(i):
+        # plane-major (3, pane_pts) — the run_wire_panes/headline layout
+        return jax.device_put(np.ascontiguousarray(
+            wire[i * pane_pts:(i + 1) * pane_pts].T
+        ), dev)
+
+    digest_kind, digest = select_wire_digest_step(
+        pane_arrays(0), pane_pts, q, scale, origin, r32,
+        num_segments=nseg, cand=8_192,
+    )
 
     def pane_step(wire_p, query_xy):
-        xy_p = wf.dequantize(wire_p[:, :2])
-        valid = jnp.ones((wire_p.shape[0],), bool)
-        return knn_pane_digest_compact(
-            xy_p, valid, None, None, wire_p[:, 2].astype(jnp.int32),
-            query_xy, np.float32(0.05), jnp.int32(0), num_segments=nseg,
-            cand=8_192,
-        )
+        return digest(wire_p, wire_p.shape[1], query_xy, scale, origin, r32)
 
     jpane = jax.jit(pane_step)
     jmerge = jax.jit(knn_merge_digest_list, static_argnames="k")
     no_bases = np.zeros(ppw, np.int32)  # rep indices unread by this bench
-
-    def pane_arrays(i):
-        return jax.device_put(wire[i * pane_pts:(i + 1) * pane_pts], dev)
 
     # Warm-up: compile both programs. NB: on the axon tunnel,
     # block_until_ready returns without waiting — a real device→host fetch
@@ -298,11 +304,11 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     # Silicon column: panes 1.. staged in HBM, digest ring carried as a
     # ppw-tuple through one scan (every step fires a window merge).
     xs = jax.device_put(
-        jnp.asarray(
+        jnp.asarray(np.ascontiguousarray(
             wire[pane_pts:pane_pts * n_panes].reshape(
                 n_panes - 1, pane_pts, 3
-            )
-        ), dev,
+            ).transpose(0, 2, 1)
+        )), dev,
     )
     carry0 = ((d0.seg_min,) * ppw, (d0.rep,) * ppw)
 
@@ -320,7 +326,8 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     assert int(np.min(last)) > 0, "resident kNN produced empty windows"
     return _result(f"continuous_knn_k{k}_5s_sliding",
                    pane_pts * (n_panes - 1), dt,
-                   {"num_valid_last": int(out[-1].num_valid)},
+                   {"num_valid_last": int(out[-1].num_valid),
+                    "digest_step": digest_kind},
                    spread=(t_min, t_max), resident=(pps_r, r_min, r_max))
 
 

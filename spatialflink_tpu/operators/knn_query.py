@@ -559,6 +559,135 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             )
 
 
+    def run_wire_panes(
+        self,
+        slides,
+        query_point: Point,
+        radius: float,
+        k: int,
+        num_segments: int,
+        wire_format,
+        start_ms: int = 0,
+        strategy: str = "auto",
+        cand: int = 8192,
+        interpret: bool = False,
+        flush_at_end: bool = True,
+    ):
+        """Wire-plane pane-carry kNN — the HEADLINE program as a shipped
+        operator path (ops/wire_knn.py; bench.py and bench_suite's kNN
+        configs run this same step, so the measured program is the
+        shipped one).
+
+        ``slides``: iterable of (3, n_i) uint16 PLANE-MAJOR pane arrays
+        in the 6 B/pt wire format (streams/wire.py) — rows x_q, y_q,
+        interned-int16-oid bits — one array per ``slide_step`` pane, in
+        event-time order (the kafka wire client and the native CSV
+        parser both produce these planes). Pane i covers
+        [start_ms + i·slide, start_ms + (i+1)·slide); every window
+        OVERLAPPING a received pane fires — including the leading
+        partial windows (negative-offset starts, matching
+        run_soa_panes's earliest_window_of semantics) and, with
+        ``flush_at_end``, the trailing partials — yielding ``run_soa``'s
+        (start, end, oids, dists, num_valid) contract. Variable pane
+        sizes share one compiled step via bucket padding + an
+        ``n_valid`` mask (padding can never match — parity-tested).
+
+        ``strategy``: 'auto' adopts the fused Pallas extraction on TPU
+        only after a first-pane self-check against the XLA step (set
+        equality + ≤1 ulp — bench.py's contract; overflow beyond the
+        candidate budget falls back IN-PROGRAM, so results are exact
+        either way); 'xla'/'pallas' force. The chosen kind is recorded
+        on ``self.last_wire_digest_kind``.
+        """
+        from spatialflink_tpu.operators.query_config import QueryType
+        from spatialflink_tpu.ops.knn import knn_merge_digest_list
+        from spatialflink_tpu.ops.wire_knn import select_wire_digest_step
+
+        conf = self.conf
+        if conf.query_type == QueryType.CountBased:
+            raise ValueError(
+                "run_wire_panes requires time-based sliding windows"
+            )
+        size, slide_ms = conf.window_size_ms, conf.slide_step_ms
+        if conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive):
+            size = slide_ms = conf.realtime_batch_ms
+        if size % slide_ms != 0:
+            raise ValueError("run_wire_panes requires size % slide == 0")
+        ppw = size // slide_ms
+
+        q = jnp.asarray(
+            np.asarray([query_point.x, query_point.y], np.float32)
+        )
+        scale = jnp.asarray(wire_format.scale)
+        origin = jnp.asarray(wire_format.origin)
+        r32 = jnp.asarray(radius, jnp.float32)
+        merge = jitted(knn_merge_digest_list, "k")
+        no_bases = np.zeros(ppw, np.int32)  # indices unused by this yield
+        jstep = None
+        digests: list = []
+        empty = None  # lazy: absent-pane digest (leading/trailing partials)
+        self.last_wire_digest_kind = None
+
+        def fire(pane_i):
+            res = merge(
+                tuple(s for s, _ in digests),
+                tuple(r for _, r in digests), no_bases, k=k,
+            )
+            nv = int(res.num_valid)
+            w_start = start_ms + (pane_i - ppw + 1) * slide_ms
+            return (
+                w_start, w_start + size,
+                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
+            )
+
+        i = -1
+        for i, wire_p in enumerate(slides):
+            wire_p = np.asarray(wire_p)
+            if (wire_p.ndim != 2 or wire_p.shape[0] != 3
+                    or wire_p.dtype != np.uint16):
+                raise ValueError(
+                    "run_wire_panes expects (3, n) uint16 plane-major "
+                    f"panes, got {wire_p.dtype} {wire_p.shape}"
+                )
+            n = wire_p.shape[1]
+            check_oid_range(wire_p[2].view(np.int16), num_segments)
+            nb = next_bucket(max(n, 1), minimum=128)
+            if nb != n:
+                wire_p = np.concatenate(
+                    [wire_p, np.zeros((3, nb - n), np.uint16)], axis=1
+                )
+            wire_d = jnp.asarray(wire_p)
+            if jstep is None:
+                kind, step = select_wire_digest_step(
+                    wire_d, jnp.int32(n), q, scale, origin, r32,
+                    num_segments=num_segments, cand=cand,
+                    interpret=interpret, strategy=strategy,
+                )
+                self.last_wire_digest_kind = kind
+                jstep = jax.jit(step)
+                # Seed the ring with ppw-1 empty digests so the LEADING
+                # partial windows fire (run_soa_panes parity: its
+                # assembler starts at earliest_window_of the first
+                # event, streams/soa.py).
+                empty = (
+                    jnp.full((num_segments,), np.float32(
+                        np.finfo(np.float32).max), jnp.float32),
+                    jnp.full((num_segments,), np.iinfo(np.int32).max,
+                             jnp.int32),
+                )
+                digests.extend([empty] * (ppw - 1))
+            d = jstep(wire_d, jnp.int32(n), q, scale, origin, r32)
+            digests.append((d.seg_min, d.rep))
+            del digests[:-ppw]
+            yield fire(i)
+        if flush_at_end and i >= 0:
+            # Trailing partial windows: panes shift out, empties in.
+            for j in range(1, ppw):
+                digests.append(empty)
+                del digests[:-ppw]
+                yield fire(i + j)
+
+
 class PointPolygonKNNQuery(_PointStreamKNNQuery):
     """knn/PointPolygonKNNQuery.java:67-88 (incl. runLatency variants —
     latency accounting lives in the metrics layer here)."""

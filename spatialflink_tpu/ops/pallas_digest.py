@@ -46,7 +46,7 @@ def pallas_digest_supported() -> bool:
 
 
 def _extract_kernel(
-    consts_ref,  # (1, 8) f32: radius, sx, ox, qx, sy, oy, qy, pad
+    consts_ref,  # (1, 8) f32: radius, sx, ox, qx, sy, oy, qy, n_valid
     xq_ref, yq_ref, oid_ref,  # (1, BLK) i32 rows
     outd_ref, outoid_ref, outidx_ref, cnt_ref,
     sm, accd, acco, acci,
@@ -80,7 +80,14 @@ def _extract_kernel(
     # d² <= r² test would classify radius-boundary points differently
     # within f32 rounding and break the set-parity self-check.
     dist = jnp.sqrt(dx * dx + dy * dy).reshape(1, blk)
-    mask = dist <= radius
+    # consts slot 7 carries the logical point count (f32, exact for
+    # counts < 2^24 — far above any pane size): positions >= n_valid are
+    # bucket padding from a variable-size pane and can never match.
+    # The wrapper always writes this slot.
+    n_valid = consts_ref[0, 7]
+    gidx = (jnp.float32(i * blk)
+            + jax.lax.broadcasted_iota(jnp.float32, (1, blk), 1))
+    mask = (dist <= radius) & (gidx < n_valid)
     nhit = jnp.sum(mask.astype(jnp.int32))
 
     @pl.when(nhit > 0)
@@ -148,17 +155,24 @@ def wire_candidates_pallas(
     blk: int = 2048,
     max_cand: int = PALLAS_DIGEST_MAX_CAND,
     interpret: bool = False,
+    n_valid=None,
 ):
     """Wire planes → compacted in-radius (dist, oid, index) + count.
 
     ``xq``/``yq``/``oid``: (N,) int32 (u16 wire values widened by XLA —
     Mosaic-friendly); ``consts``: (1, 8) f32 [radius, sx, ox, qx, sy,
-    oy, qy, 0]. N is padded to a ``blk`` multiple internally (padding
-    lanes sit at an astronomical distance). ``count`` > ``max_cand`` ⇒
-    truncated (caller falls back); indices are original positions, -1
-    padding.
+    oy, qy, n_valid]. N is padded to a ``blk`` multiple internally
+    (padding lanes sit at an astronomical distance). ``n_valid`` (traced
+    scalar, default N) marks the logical point count when the caller
+    bucket-padded a variable-size pane — positions past it never match;
+    slot 7 of ``consts`` is overwritten with it either way. ``count`` >
+    ``max_cand`` ⇒ truncated (caller falls back); indices are original
+    positions, -1 padding.
     """
     n = xq.shape[0]
+    if n_valid is None:
+        n_valid = n
+    consts = consts.at[0, 7].set(jnp.asarray(n_valid, jnp.float32))
     pad = (-n) % blk
     if pad:
         # Padding lanes carry a coordinate far outside any grid extent
@@ -243,12 +257,14 @@ def wire_digest_pallas(
     num_segments: int,
     max_cand: int = PALLAS_DIGEST_MAX_CAND,
     interpret: bool = False,
+    n_valid=None,
 ):
     """(3, N) u16 wire planes → KnnPaneDigest via the fused extraction.
 
     Returns (digest, count): exact iff ``count <= max_cand`` — the
-    caller owns the fallback (bench.py self-checks and falls back to
-    the XLA step wholesale)."""
+    caller owns the fallback (ops/wire_knn.py wraps this with the
+    in-program lax.cond fallback; bench.py additionally self-checks one
+    slide and falls back to the XLA step wholesale)."""
     consts = jnp.asarray(
         [[radius, scale[0], origin[0], query_xy[0],
           scale[1], origin[1], query_xy[1], 0.0]], jnp.float32,
@@ -256,6 +272,6 @@ def wire_digest_pallas(
     d, o, idx, cnt = wire_candidates_pallas(
         wire_s[0].astype(jnp.int32), wire_s[1].astype(jnp.int32),
         wire_s[2].astype(jnp.int32), consts,
-        max_cand=max_cand, interpret=interpret,
+        max_cand=max_cand, interpret=interpret, n_valid=n_valid,
     )
     return digest_from_candidates(d, o, idx, num_segments), cnt
