@@ -23,7 +23,10 @@ Snapshottable components:
     mid-window with identical output (tests/test_checkpoint_panes.py —
     pass ``flush_at_end=False`` so a killed source doesn't flush open
     windows);
-  - Interner: the objID vocabulary (so dense ids stay stable on resume).
+  - Interner: the objID vocabulary (so dense ids stay stable on resume);
+  - WireKafkaSource: per-partition consumed offsets (kafka_source_state)
+    — Flink's checkpointed Kafka-consumer role, so kill-and-resume
+    covers INGEST as well as operator state.
 """
 
 from __future__ import annotations
@@ -205,6 +208,29 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
                 in state["join_pane_carry"]["blocks"].items()
             },
         }
+
+
+def kafka_source_state(src) -> Dict[str, Any]:
+    """Snapshot a streams/kafka.py:WireKafkaSource — the checkpointed
+    consumer-offsets role of Flink's Kafka consumer
+    (StreamingJob.java:255). Pass the saved mapping back as
+    ``WireKafkaSource(start_offsets=...)`` on resume; combined with the
+    operator/assembler state above, kill-and-resume replays the topic
+    with no gap and no duplicate."""
+    return {
+        "topic": src.topic,
+        "offsets": {int(p): int(o) for p, o in src.offsets.items()},
+    }
+
+
+def restore_kafka_source_offsets(state: Dict[str, Any],
+                                 topic: str) -> Dict[int, int]:
+    """Validate + extract ``start_offsets`` for a resumed source."""
+    if state["topic"] != topic:
+        raise ValueError(
+            f"checkpoint is for topic {state['topic']!r}, not {topic!r}"
+        )
+    return dict(state["offsets"])
 
 
 def save_checkpoint(path: str, **components) -> None:

@@ -15,8 +15,12 @@ Backends, in order of preference:
    a 4.0 broker surfaces a clear UNSUPPORTED_VERSION KafkaError).
    Always available, so ``kafka_available()`` is unconditionally True;
    partition assignment is explicit (all partitions of the topic,
-   round-robin) rather than group-coordinated — the reference likewise
-   relies on Flink's own partition assignment, not group rebalancing.
+   timestamp-merged per fetch round) rather than group-coordinated — the
+   reference likewise relies on Flink's own partition assignment, not
+   group rebalancing. Offsets follow Flink's CHECKPOINTED-consumer model
+   (StreamingJob.java:255): ``WireKafkaSource`` exposes per-partition
+   positions that snapshot/restore through checkpoint.py, so a killed
+   ingest resumes gap-free and dup-free.
 """
 
 from __future__ import annotations
@@ -112,69 +116,138 @@ def _kafka_iter(kind, mod, topic, bootstrap_servers, parser, group_id,
         finally:
             consumer.close()
     else:  # built-in wire client
+        src = WireKafkaSource(topic, bootstrap_servers, parser,
+                              group_id=group_id, from_earliest=from_earliest)
+        try:
+            yield from src
+        finally:
+            src.close()
+
+
+class WireKafkaSource:
+    """Resumable built-in consumer: the FlinkKafkaConsumer's
+    checkpointed-offsets role (StreamingJob.java:255 — Flink snapshots
+    the consumer's partition offsets with every checkpoint so a restart
+    replays from exactly where it left off).
+
+    ``offsets`` (partition → NEXT offset to fetch) advances per record
+    AS IT IS YIELDED — every record below ``offsets[p]`` has been handed
+    to the pipeline, everything at/after it has not. Snapshotting
+    ``offsets`` together with the downstream operator state
+    (checkpoint.py:kafka_source_state) therefore gives gap-free,
+    dup-free kill-and-resume: restore the operator, pass the snapshot
+    back as ``start_offsets``, and the stream continues mid-window
+    (tests/test_kafka_wire.py::test_kill_and_resume_replays_no_gap_no_dup).
+
+    Cross-partition timestamp ordering: within a fetch round, records
+    from all partitions yield in event-time order (stable sort; the
+    single-partition common case bypasses the buffer). Mid-round offset
+    consistency assumes within-partition timestamps are monotone — the
+    same in-order assumption the pane paths already make. Unparseable
+    records and null tombstones advance their offset (they were
+    consumed) without yielding.
+    """
+
+    def __init__(self, topic: str, bootstrap_servers: str,
+                 parser: Callable[[str], T], group_id: str = "spatialflink-tpu",
+                 from_earliest: bool = True,
+                 start_offsets: Optional[dict] = None):
+        from spatialflink_tpu.streams import kafka_wire
+
+        self._mod = kafka_wire
+        self.topic = topic
+        self._parser = parser
+        self._from_earliest = from_earliest
+        self._offsets: dict = dict(start_offsets or {})
+        self._client = kafka_wire.KafkaWireClient(
+            bootstrap_servers, client_id=group_id
+        )
+
+    @property
+    def offsets(self) -> dict:
+        """Per-partition next-fetch offsets (snapshot-safe copy)."""
+        return dict(self._offsets)
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self) -> Iterator[T]:
         import time as _time
 
-        client = mod.KafkaWireClient(bootstrap_servers, client_id=group_id)
-        try:
-            # A broker auto-creating the topic answers the first metadata
-            # request with UNKNOWN_TOPIC_OR_PARTITION / LEADER_NOT_AVAILABLE
-            # (dropped by metadata()); retry like the library consumers do.
-            parts: list = []
-            for attempt in range(25):
-                parts = client.metadata([topic]).get(topic, [])
-                if parts:
-                    break
-                _time.sleep(0.2)
-            if not parts:
-                raise RuntimeError(
-                    f"topic {topic!r} has no partitions (does it exist?)"
-                )
-            ts = mod.EARLIEST if from_earliest else mod.LATEST
-            offsets = {p: client.list_offset(topic, p, ts) for p in parts}
-            single = len(parts) == 1
-            while True:
-                progressed = False
-                # Merge each fetch round across partitions by message
-                # timestamp: a fixed round-robin yield would interleave
-                # partitions out of event-time order, and the pane paths
-                # (query_panes rejects allowed_lateness) would silently
-                # drop such records as late. Cost: a round's records are
-                # held until every partition's fetch returns (idle
-                # partitions long-poll max_wait_ms) — inherent to
-                # cross-partition ordering, so the single-partition
-                # common case bypasses the buffer entirely. The sort is
-                # stable, so a partition's producer order survives for
-                # equal/monotone timestamps; full ordering guarantees
-                # still need allowed_lateness via run() — same contract
-                # as any multi-partition consumer.
-                round_msgs: list = []
-                for p in parts:
-                    msgs, _hw = client.fetch(topic, p, offsets[p])
-                    for off, ts_ms, _key, value in msgs:
+        client, topic, mod = self._client, self.topic, self._mod
+        # A broker auto-creating the topic answers the first metadata
+        # request with UNKNOWN_TOPIC_OR_PARTITION / LEADER_NOT_AVAILABLE
+        # (dropped by metadata()); retry like the library consumers do.
+        parts: list = []
+        for _attempt in range(25):
+            parts = client.metadata([topic]).get(topic, [])
+            if parts:
+                break
+            _time.sleep(0.2)
+        if not parts:
+            raise RuntimeError(
+                f"topic {topic!r} has no partitions (does it exist?)"
+            )
+        ts = mod.EARLIEST if self._from_earliest else mod.LATEST
+        for p in parts:
+            # Restored partitions keep their checkpointed position;
+            # partitions unseen at snapshot time start per from_earliest.
+            if p not in self._offsets:
+                self._offsets[p] = client.list_offset(topic, p, ts)
+        single = len(parts) == 1
+        offsets = self._offsets  # mutated in place: `offsets` stays live
+        while True:
+            progressed = False
+            # Merge each fetch round across partitions by message
+            # timestamp: a fixed round-robin yield would interleave
+            # partitions out of event-time order, and the pane paths
+            # (query_panes rejects allowed_lateness) would silently
+            # drop such records as late. Cost: a round's records are
+            # held until every partition's fetch returns (idle
+            # partitions long-poll max_wait_ms) — inherent to
+            # cross-partition ordering. The sort key is timestamp ONLY
+            # and the sort is stable, so a partition's producer order
+            # survives for equal/monotone timestamps.
+            round_msgs: list = []
+            for p in parts:
+                msgs, _hw = client.fetch(topic, p, offsets[p])
+                for off, ts_ms, _key, value in msgs:
+                    progressed = True
+                    if single:
                         offsets[p] = off + 1
-                        progressed = True
                         if value is None:
                             continue
-                        if single:
-                            try:
-                                yield parser(value.decode())
-                            except (ValueError, IndexError):
-                                pass
-                        else:
-                            round_msgs.append((ts_ms, value))
-                round_msgs.sort(key=lambda m: m[0])
-                for _ts, value in round_msgs:
-                    try:
-                        yield parser(value.decode())
-                    except (ValueError, IndexError):
-                        continue
-                if not progressed:
-                    # fetch() already long-polled max_wait_ms per partition;
-                    # loop again (a live stream source never terminates —
-                    # same contract as the library-backed branches).
+                        try:
+                            rec = self._parser(value.decode())
+                        except (ValueError, IndexError):
+                            continue
+                        yield rec
+                    else:
+                        round_msgs.append((ts_ms, p, off, value))
+            round_msgs.sort(key=lambda m: m[0])
+            for _ts, p, off, value in round_msgs:
+                # Offset advances as the record is HANDED OVER — a
+                # checkpoint between yields never loses or repeats a
+                # round's records (see class docstring).
+                offsets[p] = off + 1
+                if value is None:
                     continue
-        finally:
-            client.close()
+                try:
+                    rec = self._parser(value.decode())
+                except (ValueError, IndexError):
+                    continue
+                yield rec
+            if not progressed:
+                # fetch() already long-polled max_wait_ms per partition;
+                # loop again (a live stream source never terminates —
+                # same contract as the library-backed branches).
+                continue
 
 
 class KafkaSink:

@@ -159,6 +159,249 @@ def snappy_compress_literal(data: bytes) -> bytes:
     return bytes(out)
 
 
+def _xxh32(data: bytes, seed: int = 0) -> int:
+    """Pure-python xxHash32 — the checksum LZ4 frames carry (header HC,
+    optional block and content checksums). Reference: the xxHash spec's
+    32-bit algorithm; vectors pinned in tests/test_kafka_wire.py."""
+    P1, P2, P3, P4, P5 = (
+        2654435761, 2246822519, 3266489917, 668265263, 374761393,
+    )
+    mask = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & mask
+
+    n = len(data)
+    pos = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & mask
+        v2 = (seed + P2) & mask
+        v3 = seed & mask
+        v4 = (seed - P1) & mask
+        while pos + 16 <= n:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[pos + 4 * i:pos + 4 * i + 4],
+                                      "little")
+                v = (v + lane * P2) & mask
+                v = (rotl(v, 13) * P1) & mask
+                if i == 0:
+                    v1 = v
+                elif i == 1:
+                    v2 = v
+                elif i == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            pos += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & mask
+    else:
+        h = (seed + P5) & mask
+    h = (h + n) & mask
+    while pos + 4 <= n:
+        h = (h + int.from_bytes(data[pos:pos + 4], "little") * P3) & mask
+        h = (rotl(h, 17) * P4) & mask
+        pos += 4
+    while pos < n:
+        h = (h + data[pos] * P5) & mask
+        h = (rotl(h, 11) * P1) & mask
+        pos += 1
+    h ^= h >> 15
+    h = (h * P2) & mask
+    h ^= h >> 13
+    h = (h * P3) & mask
+    h ^= h >> 16
+    return h
+
+
+def lz4_block_decompress(data: bytes, out: bytearray) -> None:
+    """LZ4 *block* format decode, appending into ``out`` in place.
+
+    Sequences of [token | literal-length ext | literals | 2-byte LE
+    match offset | match-length ext]; the final sequence carries
+    literals only. Appending into the caller's rolling buffer lets
+    block-DEPENDENT frames (Kafka's legacy Java producer default)
+    reference matches across block boundaries."""
+    n = len(data)
+    pos = 0
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if pos >= n:
+                    raise ValueError("corrupt lz4 block: truncated literal "
+                                     "length")
+                b = data[pos]
+                pos += 1
+                lit += b
+                if b != 255:
+                    break
+        if pos + lit > n:
+            raise ValueError("corrupt lz4 block: literals past end")
+        out += data[pos:pos + lit]
+        pos += lit
+        if pos >= n:
+            break  # final sequence: literals only
+        if pos + 2 > n:
+            raise ValueError("corrupt lz4 block: truncated match offset")
+        off = int.from_bytes(data[pos:pos + 2], "little")
+        pos += 2
+        if off == 0 or off > len(out):
+            raise ValueError(f"corrupt lz4 block: bad match offset {off}")
+        mlen = token & 0x0F
+        if mlen == 15:
+            while True:
+                if pos >= n:
+                    raise ValueError("corrupt lz4 block: truncated match "
+                                     "length")
+                b = data[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        start = len(out) - off
+        if off >= mlen:
+            out += out[start:start + mlen]
+        else:  # overlapping copy: byte-at-a-time semantics
+            for i in range(mlen):
+                out.append(out[start + i])
+
+
+def lz4_decompress(data: bytes) -> bytes:
+    """Pure-python LZ4 *frame* decode — what Kafka codec 3 carries.
+
+    Verifies the frame magic, version, block checksums and content
+    checksum (xxHash32) when present. The header checksum accepts BOTH
+    the spec value (over the descriptor) and the legacy Kafka value
+    (over magic+descriptor): pre-KIP-57 Java producers wrote the broken
+    form with message format v0/v1 — exactly the message versions this
+    client speaks — and brokers accept both. Loud ValueError on
+    anything corrupt."""
+    if len(data) < 7:
+        raise ValueError("corrupt lz4 frame: too short")
+    if data[:4] != b"\x04\x22\x4d\x18":
+        raise ValueError("corrupt lz4 frame: bad magic "
+                         f"{data[:4].hex()}")
+    pos = 4
+    flg = data[pos]
+    bd = data[pos + 1]
+    if (flg >> 6) != 0b01:
+        raise ValueError(f"corrupt lz4 frame: unsupported version {flg >> 6}")
+    if flg & 0x02:
+        raise ValueError("corrupt lz4 frame: FLG reserved bit set")
+    # BD: bits 6-4 carry the block-max-size code (4-7); the rest reserved.
+    if bd & 0x8F or not 4 <= (bd >> 4) & 0x7 <= 7:
+        raise ValueError(f"corrupt lz4 frame: bad BD byte {bd:#04x}")
+    has_b_checksum = bool(flg & 0x10)
+    has_c_size = bool(flg & 0x08)
+    has_c_checksum = bool(flg & 0x04)
+    has_dict = bool(flg & 0x01)
+    desc_start = pos
+    pos += 2
+    content_size = None
+    if has_c_size:
+        content_size = int.from_bytes(data[pos:pos + 8], "little")
+        pos += 8
+    if has_dict:
+        pos += 4
+    hc = data[pos]
+    spec_hc = (_xxh32(data[desc_start:pos]) >> 8) & 0xFF
+    legacy_hc = (_xxh32(data[:pos]) >> 8) & 0xFF  # pre-KIP-57 Kafka
+    if hc not in (spec_hc, legacy_hc):
+        raise ValueError(
+            f"corrupt lz4 frame: header checksum {hc:#04x} matches "
+            f"neither spec ({spec_hc:#04x}) nor legacy-Kafka "
+            f"({legacy_hc:#04x})"
+        )
+    pos += 1
+    out = bytearray()
+    while True:
+        if pos + 4 > len(data):
+            raise ValueError("corrupt lz4 frame: missing EndMark")
+        bsize = int.from_bytes(data[pos:pos + 4], "little")
+        pos += 4
+        if bsize == 0:  # EndMark
+            break
+        uncompressed = bool(bsize & 0x80000000)
+        bsize &= 0x7FFFFFFF
+        if pos + bsize > len(data):
+            raise ValueError("corrupt lz4 frame: block past end")
+        block = data[pos:pos + bsize]
+        pos += bsize
+        if has_b_checksum:
+            want = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+            got = _xxh32(block)
+            if got != want:
+                raise ValueError(
+                    f"corrupt lz4 frame: block checksum {got:#010x} != "
+                    f"{want:#010x}"
+                )
+        if uncompressed:
+            out += block
+        else:
+            lz4_block_decompress(block, out)
+    if has_c_checksum:
+        want = int.from_bytes(data[pos:pos + 4], "little")
+        got = _xxh32(bytes(out))
+        if got != want:
+            raise ValueError(
+                f"corrupt lz4 frame: content checksum {got:#010x} != "
+                f"{want:#010x}"
+            )
+    if content_size is not None and len(out) != content_size:
+        raise ValueError(
+            f"corrupt lz4 frame: got {len(out)} bytes, header says "
+            f"{content_size}"
+        )
+    return bytes(out)
+
+
+def lz4_compress_literal(data: bytes, legacy_hc: bool = False,
+                         block_checksum: bool = False) -> bytes:
+    """Minimal VALID LZ4 frame encoder: literal-only compressed blocks,
+    content checksum always present. Test/round-trip helper (real
+    producers send real compressors' output — the decoder above handles
+    matches, overlaps and uncompressed blocks). ``legacy_hc`` writes
+    the pre-KIP-57 Kafka header checksum variant."""
+    flg = 0x40 | 0x20 | 0x04  # v01, block-independent, content checksum
+    if block_checksum:
+        flg |= 0x10
+    bd = 0x40  # 64 KB max block size
+    header = bytes([flg, bd])
+    magic = b"\x04\x22\x4d\x18"
+    hc_src = magic + header if legacy_hc else header
+    out = bytearray(magic + header)
+    out.append((_xxh32(hc_src) >> 8) & 0xFF)
+    pos = 0
+    # Chunk so the STORED block (token + length ext + literals) stays
+    # within the 64 KiB maximum the BD byte declares — a spec decoder
+    # rejects oversized blocks (65200 literals need ≤ 257 header bytes).
+    while pos < len(data):
+        chunk = data[pos:pos + 65200]
+        pos += len(chunk)
+        block = bytearray()
+        lit = len(chunk)
+        token_lit = min(lit, 15)
+        block.append(token_lit << 4)
+        if token_lit == 15:
+            rest = lit - 15
+            while rest >= 255:
+                block.append(255)
+                rest -= 255
+            block.append(rest)
+        block += chunk
+        out += len(block).to_bytes(4, "little")
+        out += block
+        if block_checksum:
+            out += _xxh32(bytes(block)).to_bytes(4, "little")
+    out += (0).to_bytes(4, "little")  # EndMark
+    out += _xxh32(data).to_bytes(4, "little")
+    return bytes(out)
+
+
 def enc_string(s: Optional[str]) -> bytes:
     if s is None:
         return struct.pack(">h", -1)
@@ -304,8 +547,12 @@ def decode_message_set(data: bytes) -> List[Tuple[int, int, Optional[bytes],
     LogAppendTime wrapper (attr bit 0x08) overrides every inner
     timestamp — both per the Kafka message-format spec. Snappy sets
     (codec 2, raw or xerial-framed) decode via the pure-python
-    ``snappy_decompress``; LZ4/zstd still raise (the reference gets
-    them via the Flink Kafka connector's client, pom.xml:81)."""
+    ``snappy_decompress``; lz4 sets (codec 3, LZ4 frames incl. the
+    pre-KIP-57 legacy header checksum) via ``lz4_decompress``. zstd
+    (codec 4, KIP-110) requires message format v2, which this
+    pre-2.1-protocol client never negotiates — it still raises (the
+    reference gets every codec via the Flink Kafka connector's client,
+    pom.xml:81)."""
     out = []
     r = Reader(data)
     while r.remaining() >= 12:
@@ -332,16 +579,18 @@ def decode_message_set(data: bytes) -> List[Tuple[int, int, Optional[bytes],
                 f"compressed Kafka wrapper at offset {offset} has a null "
                 "value (corrupt message set)"
             )
-        if codec not in (1, 2):
-            name = {3: "lz4", 4: "zstd"}.get(codec, str(codec))
+        if codec not in (1, 2, 3):
+            name = {4: "zstd"}.get(codec, str(codec))
             raise NotImplementedError(
                 f"{name}-compressed Kafka message sets are not supported "
-                "by the built-in client (gzip and snappy decode natively; "
-                "for other codecs produce uncompressed or install "
-                "kafka-python)"
+                "by the built-in client (gzip, snappy and lz4 decode "
+                "natively; zstd needs the v2 record-batch protocol — "
+                "produce uncompressed or install kafka-python)"
             )
         if codec == 2:
             inner = decode_message_set(snappy_decompress(value))
+        elif codec == 3:
+            inner = decode_message_set(lz4_decompress(value))
         else:
             # wbits=47: auto-detect gzip or zlib framing.
             inner = decode_message_set(zlib.decompress(value, 47))
