@@ -19,6 +19,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <limits>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -501,8 +502,154 @@ int64_t sf_traj_stats(
   return n_starts;
 }
 
+// Pane-carry tJoin — the native CPU engine for the extreme-overlap
+// sliding trajectory join (ops/tjoin_panes.py is the device form; the
+// reference re-walks the whole window per fire,
+// tJoin/PointPointTJoinQuery.java:183+). Same algorithm as the device
+// scan, CPU-shaped:
+//
+// - per-cell point lists with amortized FRONT expiry (panes arrive in
+//   increasing order per cell, so expired points pop off the head —
+//   no capW rings, no overflow: EXACT by construction);
+// - the min-pane-indexed digest ring D[ppw][K²] with the hierarchical
+//   √ppw block level (reset row -> one block recompute; every min
+//   update maintains both levels; window emission = block-row min);
+// - per slide: probe new left pane vs right cells, insert left, probe
+//   new right pane vs left cells (covers new x new once), insert
+//   right, emit the window min for every trajectory pair.
+//
+// Events must arrive sorted by pane (the operator's pane binning) and
+// in-grid (cell in [0, grid_n²)). Distances are double
+// sqrt(dx*dx+dy*dy) — parity with the x64 device engine at 1e-12
+// (FMA contraction freedom; tests/test_tjoin_panes.py).
+//
+// out_wmins: caller-allocated (n_slides * K²) doubles; this function
+// fills every slot (absent pairs = +inf). Returns 0, or -1 on an
+// out-of-range oid/cell/pane.
+int64_t sf_tjoin_panes(
+    const int32_t* l_pane, const double* l_x, const double* l_y,
+    const int32_t* l_cell, const int32_t* l_oid, int64_t n_l,
+    const int32_t* r_pane, const double* r_x, const double* r_y,
+    const int32_t* r_cell, const int32_t* r_oid, int64_t n_r,
+    int64_t n_slides, int32_t grid_n, int32_t layers, int32_t ppw,
+    int32_t num_ids, double radius, double* out_wmins) {
+  const int64_t ncells = static_cast<int64_t>(grid_n) * grid_n;
+  const int64_t P = static_cast<int64_t>(num_ids) * num_ids;
+  const double inf = std::numeric_limits<double>::infinity();
+  for (int64_t i = 0; i < n_l; ++i)
+    if (l_oid[i] < 0 || l_oid[i] >= num_ids || l_cell[i] < 0 ||
+        l_cell[i] >= ncells || l_pane[i] < 0 || l_pane[i] >= n_slides ||
+        (i && l_pane[i] < l_pane[i - 1]))
+      return -1;
+  for (int64_t i = 0; i < n_r; ++i)
+    if (r_oid[i] < 0 || r_oid[i] >= num_ids || r_cell[i] < 0 ||
+        r_cell[i] >= ncells || r_pane[i] < 0 || r_pane[i] >= n_slides ||
+        (i && r_pane[i] < r_pane[i - 1]))
+      return -1;
+
+  struct Pt {
+    double x, y;
+    int32_t oid, pane;
+  };
+  struct Side {
+    std::vector<std::vector<Pt>> cells;
+    std::vector<size_t> head;  // amortized front expiry cursor
+    explicit Side(int64_t nc)
+        : cells(static_cast<size_t>(nc)), head(static_cast<size_t>(nc), 0) {}
+  };
+  Side left(ncells), right(ncells);
+
+  // Hierarchical digest ring (the device engine's block_size()).
+  int32_t bs = 1;
+  for (int32_t d = 1; static_cast<int64_t>(d) * d <= ppw; ++d)
+    if (ppw % d == 0) bs = d;
+  const int32_t nblk = ppw / bs;
+  std::vector<double> D(static_cast<size_t>(ppw) * P, inf);
+  std::vector<double> Bd(static_cast<size_t>(nblk) * P, inf);
+
+  // Probe one new point against a side's window cells; digest key row =
+  // the WINDOW point's pane (the earlier pane of the pair).
+  auto probe = [&](Side& side, int32_t t, double px, double py, int32_t pc,
+                   int32_t poid, bool new_is_left) {
+    const int32_t xi = pc / grid_n, yi = pc % grid_n;
+    for (int32_t dx = -layers; dx <= layers; ++dx) {
+      const int32_t nx = xi + dx;
+      if (nx < 0 || nx >= grid_n) continue;
+      for (int32_t dy = -layers; dy <= layers; ++dy) {
+        const int32_t ny = yi + dy;
+        if (ny < 0 || ny >= grid_n) continue;
+        const size_t c = static_cast<size_t>(nx) * grid_n + ny;
+        auto& v = side.cells[c];
+        size_t& h = side.head[c];
+        while (h < v.size() && v[h].pane <= t - ppw) ++h;  // expiry
+        if (h > 4096 && h * 2 > v.size()) {  // reclaim drained prefixes
+          v.erase(v.begin(), v.begin() + static_cast<int64_t>(h));
+          h = 0;
+        }
+        for (size_t s = h; s < v.size(); ++s) {
+          const double ddx = v[s].x - px, ddy = v[s].y - py;
+          const double d = std::sqrt(ddx * ddx + ddy * ddy);
+          if (!(d <= radius)) continue;
+          const int32_t lid = new_is_left ? poid : v[s].oid;
+          const int32_t rid = new_is_left ? v[s].oid : poid;
+          const int64_t row = v[s].pane % ppw;
+          const int64_t pair =
+              static_cast<int64_t>(lid) * num_ids + rid;
+          double& slot = D[static_cast<size_t>(row) * P + pair];
+          if (d < slot) slot = d;
+          double& bslot = Bd[static_cast<size_t>(row / bs) * P + pair];
+          if (d < bslot) bslot = d;
+        }
+      }
+    }
+  };
+
+  int64_t li = 0, ri = 0;
+  for (int64_t t = 0; t < n_slides; ++t) {
+    // Ring row t%ppw held pane t-ppw: reset + recompute its block.
+    const int64_t rrow = t % ppw;
+    std::fill_n(&D[static_cast<size_t>(rrow) * P], P, inf);
+    const int64_t blk = rrow / bs;
+    double* brow = &Bd[static_cast<size_t>(blk) * P];
+    std::fill_n(brow, P, inf);
+    for (int64_t m = blk * bs; m < (blk + 1) * bs; ++m) {
+      const double* drow = &D[static_cast<size_t>(m) * P];
+      for (int64_t p = 0; p < P; ++p)
+        if (drow[p] < brow[p]) brow[p] = drow[p];
+    }
+
+    const int64_t l0 = li, r0 = ri;
+    // Direction A: new LEFT pane x RIGHT window (panes < t).
+    for (int64_t i = l0; i < n_l && l_pane[i] == t; ++i)
+      probe(right, static_cast<int32_t>(t), l_x[i], l_y[i], l_cell[i],
+            l_oid[i], /*new_is_left=*/true);
+    // Insert the left pane.
+    for (; li < n_l && l_pane[li] == t; ++li)
+      left.cells[static_cast<size_t>(l_cell[li])].push_back(
+          {l_x[li], l_y[li], l_oid[li], static_cast<int32_t>(t)});
+    // Direction B: new RIGHT pane x LEFT window (panes <= t — covers
+    // new x new exactly once).
+    for (int64_t i = r0; i < n_r && r_pane[i] == t; ++i)
+      probe(left, static_cast<int32_t>(t), r_x[i], r_y[i], r_cell[i],
+            r_oid[i], /*new_is_left=*/false);
+    for (; ri < n_r && r_pane[ri] == t; ++ri)
+      right.cells[static_cast<size_t>(r_cell[ri])].push_back(
+          {r_x[ri], r_y[ri], r_oid[ri], static_cast<int32_t>(t)});
+
+    // Window ending at pane t: min over the block level.
+    double* out = &out_wmins[static_cast<size_t>(t) * P];
+    std::fill_n(out, P, inf);
+    for (int64_t b = 0; b < nblk; ++b) {
+      const double* br = &Bd[static_cast<size_t>(b) * P];
+      for (int64_t p = 0; p < P; ++p)
+        if (br[p] < out[p]) out[p] = br[p];
+    }
+  }
+  return 0;
+}
+
 // Bump whenever any exported signature changes; native.py refuses to bind
 // a library whose version differs (stale prebuilt .so protection).
-int32_t sf_abi_version() { return 3; }
+int32_t sf_abi_version() { return 4; }
 
 }  // extern "C"

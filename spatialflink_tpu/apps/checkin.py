@@ -76,3 +76,54 @@ def check_in_query(
             1 if ev.direction == "in" else -1
         )
         yield (room, room_capacities.get(room), occupancy[room], time.time())
+
+
+def check_in_query_soa(
+    events: Iterable[CheckInEvent],
+    room_capacities: Dict[str, int],
+) -> Iterator[Tuple[str, Optional[int], int, float]]:
+    """Device SoA path: the same (room, capacity, occupancy, wallclock)
+    stream as ``check_in_query``, computed as ONE jitted kernel dispatch
+    (ops/checkin.py:check_in_kernel — stable-sort consecutive-per-user
+    detection + segmented-cumsum occupancy) instead of the per-event
+    host walk. Bit-parity test: tests/test_apps.py. Bounded batches
+    (the count-window state is two events deep, so stream chunking at
+    any boundary per user is exact only within a batch — same contract
+    as the host path restarted per batch)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.checkin import check_in_kernel
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    events = list(events)
+    if not events:
+        return
+    n = len(events)
+    rooms: Dict[str, int] = {}
+    users: Dict[str, int] = {}
+    nb = next_bucket(n, minimum=8)
+    room_id = np.zeros(nb, np.int32)
+    user_id = np.zeros(nb, np.int32)
+    dirn = np.zeros(nb, np.int32)
+    ts = np.zeros(nb, np.int64)
+    for i, ev in enumerate(events):
+        room_id[i] = rooms.setdefault(ev.room, len(rooms))
+        user_id[i] = users.setdefault(ev.user_id, len(users))
+        dirn[i] = 1 if ev.direction == "in" else -1
+        ts[i] = ev.timestamp
+    valid = np.zeros(nb, bool)
+    valid[:n] = True
+    k = jitted(check_in_kernel, "num_rooms")
+    out_room, _d, _t, out_valid, occ = k(
+        jnp.asarray(user_id), jnp.asarray(room_id), jnp.asarray(dirn),
+        jnp.asarray(ts), jnp.asarray(valid), num_rooms=len(rooms),
+    )
+    names = {v: name for name, v in rooms.items()}
+    ov = np.asarray(out_valid)
+    orm = np.asarray(out_room)
+    oc = np.asarray(occ)
+    for s in np.nonzero(ov)[0]:
+        room = names[int(orm[s])]
+        yield (room, room_capacities.get(room), int(oc[s]), time.time())

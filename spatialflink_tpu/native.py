@@ -22,7 +22,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libsfnative.so")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 _abi_mismatch = False
-_ABI_VERSION = 3  # must match sf_abi_version() in sfnative.cpp
+_ABI_VERSION = 4  # must match sf_abi_version() in sfnative.cpp
 
 
 def ensure_built(quiet: bool = True) -> bool:
@@ -103,6 +103,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_int64, dbl_p, i64_p, i64_p,
     ]
     lib.sf_traj_stats.restype = ctypes.c_int64
+    lib.sf_tjoin_panes.argtypes = [
+        i32_p, dbl_p, dbl_p, i32_p, i32_p, ctypes.c_int64,
+        i32_p, dbl_p, dbl_p, i32_p, i32_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_double, dbl_p,
+    ]
+    lib.sf_tjoin_panes.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
@@ -146,6 +153,39 @@ def traj_stats_native(ts, x, y, oid, num_oids: int, size_ms: int,
         raise ValueError(f"oid out of [0, {num_oids}) in traj_stats_native")
     assert rc == n_starts
     return n_starts, spatial, temporal, count
+
+
+def tjoin_panes_native(l_pane, l_x, l_y, l_cell, l_oid,
+                       r_pane, r_x, r_y, r_cell, r_oid,
+                       n_slides: int, grid_n: int, layers: int, ppw: int,
+                       num_ids: int, radius: float):
+    """Pane-carry tJoin (sf_tjoin_panes) — the native CPU engine behind
+    TJoinQuery.run_soa_panes(backend='native'). Events must be sorted by
+    pane index (rebased to 0) and in-grid. EXACT by construction (no
+    capW/pair_sel budgets); returns the (n_slides, num_ids²) per-window
+    trajectory-pair min-distance matrix (+inf = no pair), or None when
+    the library is unavailable. Parity with the device engine at 1e-12
+    (FMA contraction freedom; tests/test_tjoin_panes.py)."""
+    lib = _load()
+    if lib is None:
+        return None
+    c32 = lambda a: np.ascontiguousarray(a, np.int32)
+    c64 = lambda a: np.ascontiguousarray(a, np.float64)
+    out = np.empty((n_slides, num_ids * num_ids), np.float64)
+    rc = lib.sf_tjoin_panes(
+        c32(l_pane), c64(l_x), c64(l_y), c32(l_cell), c32(l_oid),
+        len(l_pane),
+        c32(r_pane), c64(r_x), c64(r_y), c32(r_cell), c32(r_oid),
+        len(r_pane),
+        n_slides, grid_n, layers, ppw, num_ids, float(radius),
+        out.reshape(-1),
+    )
+    if rc < 0:
+        raise ValueError(
+            "tjoin_panes_native: oid/cell/pane out of range or panes "
+            "not sorted"
+        )
+    return out
 
 
 class _NativeInternerParser:

@@ -482,6 +482,8 @@ class TJoinQuery(SpatialOperator):
         cap_w: int = 64,
         pair_sel: int = 16,
         dtype=np.float64,
+        mesh=None,
+        backend: str = "auto",
     ):
         """Extreme-overlap sliding tJoin via the device pane-carry engine
         (ops/tjoin_panes.py): window state lives ON DEVICE in ring-buffer
@@ -499,6 +501,21 @@ class TJoinQuery(SpatialOperator):
         contract). Digest memory = ppw·num_segments²·4 bytes — sized
         for the domain's dozens-to-hundreds of vehicles; a guard raises
         past ~2 GB rather than OOMing the device.
+
+        ``mesh`` (defaults to the operator's): probe-parallel execution
+        over the ``data`` axis — pane points shard, window/digest state
+        replicates, contributions all-gather per slide
+        (ops/tjoin_panes.py). Bit-identical to single-device
+        (tests/test_parallel_operators.py).
+
+        ``backend``: "auto" routes to the NATIVE C++ engine on CPU hosts
+        (native/sfnative.cpp:sf_tjoin_panes — per-cell lists with
+        amortized expiry, no cap/sel budgets, exact by construction;
+        the same device/native split as traj_stats_sliding) and to the
+        device scan on TPU or when ``mesh`` is set; "device"/"native"
+        force a path (forced-native raises if the library is missing —
+        never silently measures the other engine). Native min-distances
+        match the x64 device engine to 1e-12 (FMA contraction freedom).
         """
         from spatialflink_tpu.operators.base import check_oid_range, jitted
         from spatialflink_tpu.ops.tjoin_panes import (
@@ -508,6 +525,7 @@ class TJoinQuery(SpatialOperator):
         from spatialflink_tpu.utils.padding import next_bucket as _nb
 
         conf = self.conf
+        mesh = mesh if mesh is not None else self.mesh
         size, slide = conf.window_size_ms, conf.slide_step_ms
         if size % slide != 0:
             raise ValueError("run_soa_panes requires size % slide == 0")
@@ -580,6 +598,9 @@ class TJoinQuery(SpatialOperator):
             counts = np.bincount(pane_s, minlength=n_slides).astype(np.int64)
             pc = int(_nb(max(int(counts.max()) if len(counts) else 1, 1),
                          minimum=8))
+            if mesh is not None:  # pane points shard over the data axis
+                nd = int(mesh.shape["data"])
+                pc = ((pc + nd - 1) // nd) * nd
             S = n_slides
             fx = np.zeros((S, pc), f_dtype)
             fy = np.zeros((S, pc), f_dtype)
@@ -606,21 +627,71 @@ class TJoinQuery(SpatialOperator):
             fxi[pane_s, lane] = xi[order].astype(np.int32)
             fyi[pane_s, lane] = yi[order].astype(np.int32)
             fcell[pane_s, lane] = cell[order]
-            from spatialflink_tpu.ops.tjoin_panes import pane_cell_ranks
+            if with_ranks:
+                # Ring-slot ranks are a DEVICE-engine input (fixed-cap
+                # scatter slots); the native engine's dynamic per-cell
+                # lists need none — skip the per-batch grouping sort.
+                from spatialflink_tpu.ops.tjoin_panes import pane_cell_ranks
 
-            frank[pane_s, lane] = pane_cell_ranks(
-                pane_s, cell[order]
-            ).astype(np.int32)
+                frank[pane_s, lane] = pane_cell_ranks(
+                    pane_s, cell[order]
+                ).astype(np.int32)
             return (fx, fy, fxi, fyi, fcell, frank, fo, fv), counts
 
+        if backend not in ("auto", "device", "native"):
+            raise ValueError(f"unknown tjoin panes backend {backend!r}")
+        use_native = False
+        if backend == "native" or (backend == "auto" and mesh is None):
+            from spatialflink_tpu import native as _native
+            from spatialflink_tpu.streams.panes import (
+                _device_backend_preferred,
+            )
+
+            native_ok = _native.available()
+            if backend == "native":
+                if mesh is not None:
+                    raise ValueError(
+                        "backend='native' cannot run on a mesh"
+                    )
+                if not native_ok:
+                    raise RuntimeError(
+                        "backend='native' was forced but the native "
+                        "library is unavailable (build native/ with "
+                        "make) — refusing to silently run the device "
+                        "engine instead"
+                    )
+                use_native = True
+            else:
+                use_native = native_ok and not _device_backend_preferred()
+
+        with_ranks = not use_native
         lfields, lcounts = pane_fields(lt, lx, ly, lo)
         rfields, rcounts = pane_fields(rt, rx, ry, ro)
         layers = g.candidate_layers(radius)
+
+        if use_native:
+            def flat(fields):
+                fx, fy, _xi, _yi, fcell, _rank, fo, fv = fields
+                m = fv.ravel()
+                S, pc = fv.shape
+                pane = np.repeat(
+                    np.arange(S, dtype=np.int32), pc
+                )[m]
+                return (pane, fx.ravel()[m], fy.ravel()[m],
+                        fcell.ravel()[m], fo.ravel()[m])
+
+            wmins = _native.tjoin_panes_native(
+                *flat(lfields), *flat(rfields),
+                n_slides, g.n, layers, ppw, num_segments, radius,
+            )
+        else:
+            wmins = None
         scan = jitted(
             tjoin_pane_scan,
             "grid_n", "cap_w", "layers", "ppw", "num_ids", "pair_sel",
+            "mesh",
         )
-        while True:
+        while wmins is None:  # device engine + overflow retry
             carry = tjoin_pane_init(
                 g.num_cells, cap_w, ppw, num_segments,
                 jnp.dtype(f_dtype),
@@ -636,7 +707,7 @@ class TJoinQuery(SpatialOperator):
                 tuple(jnp.asarray(a) for a in rfields),
                 radius,
                 grid_n=g.n, cap_w=cap_w, layers=layers, ppw=ppw,
-                num_ids=num_segments, pair_sel=pair_sel,
+                num_ids=num_segments, pair_sel=pair_sel, mesh=mesh,
             )
             cap_over = int(final.cap_overflow)
             sel_over = int(final.sel_overflow)
@@ -644,6 +715,7 @@ class TJoinQuery(SpatialOperator):
                 break
             # Bounded-stream retry: grow whichever budget overflowed and
             # re-scan (same idiom as the pruned joins' _pruned_block_pairs).
+            wmins = None  # this scan's output is inexact — re-scan
             if cap_over:
                 cap_w *= 2
             if sel_over:

@@ -1,0 +1,95 @@
+"""CheckIn device kernel — the count-window pipeline as array ops.
+
+The reference's CheckIn demo (apps/CheckIn.java:26-60) is two count
+windows: a per-user count(2,1) pass that synthesizes a missed opposite
+door event between two consecutive same-door events
+(ProcessWinForInsertingMissingValues, CheckIn.java:251-321), then a
+per-room running occupancy counter (ProcessForCountingObjects,
+CheckIn.java:208-249). The host path (apps/checkin.py) walks events one
+by one; this kernel runs a whole batch as ONE fixed-shape jit program —
+the app-layer analog of StayTime's ``stay_time_cells_kernel``:
+
+- consecutive-per-user detection = stable sort by user (stream order
+  survives within a user) + neighbor compare — no per-event Python;
+- the emission sequence is modeled as 2n SLOTS (slot 2i = optional
+  synthesized event, slot 2i+1 = event i), mask-don't-compact;
+- per-room running occupancy = a segmented cumulative sum in slot
+  order (stable sort by room, cumsum, per-segment rebase, scatter
+  back) — no data-dependent loops.
+
+Bit-parity with the host generator: tests/test_apps.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def check_in_kernel(
+    user: jnp.ndarray,
+    room: jnp.ndarray,
+    dirn: jnp.ndarray,
+    ts: jnp.ndarray,
+    valid: jnp.ndarray,
+    num_rooms: int,
+):
+    """(n,) interned event arrays → (2n,) emission-slot arrays.
+
+    ``user``/``room``: dense int32 ids; ``dirn``: +1 ("-in") / -1
+    ("-out"); ``valid``: padding mask. Returns (out_room, out_dir,
+    out_ts, out_valid, occupancy) where slot 2i carries event i's
+    synthesized opposite event (valid only when the per-user count(2,1)
+    window saw two same-door events) and slot 2i+1 carries event i;
+    ``occupancy`` is the room's running counter AFTER the slot's event —
+    exactly the host walk's emission order and values.
+    """
+    n = user.shape[0]
+    # Group by user, stream order preserved within each user (stable).
+    order = jnp.argsort(
+        jnp.where(valid, user, jnp.int32(jnp.iinfo(jnp.int32).max)),
+        stable=True,
+    )
+    u_s = user[order]
+    r_s = room[order]
+    d_s = dirn[order]
+    t_s = ts[order]
+    v_s = valid[order]
+    samep = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (u_s[1:] == u_s[:-1]) & (r_s[1:] == r_s[:-1])
+        & (d_s[1:] == d_s[:-1]) & v_s[1:] & v_s[:-1],
+    ])
+    prev_t = jnp.concatenate([t_s[:1], t_s[:-1]])
+    mid_s = (prev_t + t_s) // 2  # CheckIn.java:286-305 midpoint
+    # Back to stream order.
+    synth = jnp.zeros((n,), bool).at[order].set(samep)
+    mid = jnp.zeros((n,), ts.dtype).at[order].set(mid_s)
+
+    # Emission slots: [synth_0?, ev_0, synth_1?, ev_1, ...].
+    out_room = jnp.stack([room, room], axis=1).reshape(-1)
+    out_dir = jnp.stack([-dirn, dirn], axis=1).reshape(-1)
+    out_ts = jnp.stack([mid, ts], axis=1).reshape(-1)
+    out_valid = jnp.stack([synth & valid, valid], axis=1).reshape(-1)
+
+    # Per-room running occupancy over the slot sequence: segmented
+    # cumulative sum (invalid slots key to the drop segment num_rooms).
+    contrib = jnp.where(out_valid, out_dir, 0).astype(jnp.int32)
+    key = jnp.where(out_valid, out_room, num_rooms).astype(jnp.int32)
+    so = jnp.argsort(key, stable=True)  # slot order survives per room
+    c_s = contrib[so]
+    k_s = key[so]
+    cs = jnp.cumsum(c_s)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), k_s[1:] != k_s[:-1]]
+    )
+    segid = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    # Segment base = total before the segment's first slot (one nonzero
+    # contribution per segment → segment_sum gathers it exactly).
+    base = jax.ops.segment_sum(
+        jnp.where(seg_start, cs - c_s, 0), segid,
+        num_segments=2 * n, indices_are_sorted=True,
+    )
+    occ_sorted = cs - base[segid]
+    occupancy = jnp.zeros((2 * n,), jnp.int32).at[so].set(occ_sorted)
+    return out_room, out_dir, out_ts, out_valid, occupancy

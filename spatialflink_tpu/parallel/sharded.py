@@ -590,6 +590,106 @@ def _cached_sharded_gg_join(mesh: Mesh, a_polygonal: bool, b_polygonal: bool,
     ))
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_tstats_pane(mesh: Mesh, kb: int, slide_ms: int,
+                                ppw: int, n_panes: int):
+    from spatialflink_tpu.ops.trajectory import (
+        TrajPaneStats,
+        traj_stats_pane_kernel,
+    )
+
+    def local(tp, xp, yp, op_, vp):
+        # (1, nmax) point slice in, (kb, n_starts) oid-block rows out —
+        # P("data") on the output concatenates the blocks into the
+        # global (num_oids, n_starts) tables.
+        base = jax.lax.axis_index("data") * kb
+        return traj_stats_pane_kernel(
+            tp[0], xp[0], yp[0], (op_[0] - base).astype(jnp.int32), vp[0],
+            num_oids=kb, slide_ms=slide_ms, ppw=ppw, n_panes=n_panes,
+        )
+
+    return jax.jit(shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P("data"), P("data")),
+        out_specs=TrajPaneStats(P("data"), P("data"), P("data")),
+        check_vma=False,
+    ))
+
+
+def sharded_traj_stats_pane(
+    mesh: Mesh,
+    ts_rel: "np.ndarray",
+    x: "np.ndarray",
+    y: "np.ndarray",
+    oid: "np.ndarray",
+    valid: "np.ndarray",
+    num_oids: int,
+    slide_ms: int,
+    ppw: int,
+    n_panes: int,
+):
+    """Trajectory-parallel device tStats panes — the mesh execution of
+    ``ops/trajectory.py:traj_stats_pane_kernel``.
+
+    Sharding axis: TRAJECTORIES, not points. Every per-pane quantity in
+    the kernel (segment sums, cumsum windows, boundary corrections) is
+    per-oid independent, so contiguous oid BLOCKS shard over ``data``
+    with zero collectives and the per-oid rows come back bit-identical
+    to the single-device kernel (x64 parity:
+    tests/test_parallel_operators.py) — the trajectory analog of the
+    reference's keyBy(objID) partitioning (tStats pipelines key by
+    trajectory id; SURVEY §2.2).
+
+    Inputs are the single-device kernel's HOST arrays, sorted by
+    (oid, ts) with padding at the end (``valid`` False). The host half
+    here re-partitions them into per-shard contiguous slices (sorted
+    order makes each oid block a contiguous slice) padded to a common
+    bucket. ``num_oids`` must divide by the mesh's ``data`` axis."""
+    import numpy as np
+
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    ndev = int(mesh.shape["data"])
+    if num_oids % ndev:
+        raise ValueError(
+            f"num_oids ({num_oids}) must divide by the data axis ({ndev})"
+        )
+    kb = num_oids // ndev
+    tp = np.asarray(ts_rel)
+    xp = np.asarray(x)
+    yp = np.asarray(y)
+    op_ = np.asarray(oid)
+    vp = np.asarray(valid)
+    live = vp.astype(bool)
+    shard_of = op_[live] // kb
+    counts = np.bincount(shard_of, minlength=ndev)
+    nmax = next_bucket(max(int(counts.max()), 1), minimum=8)
+    sh = (ndev, nmax)
+    t2 = np.zeros(sh, tp.dtype)
+    x2 = np.zeros(sh, xp.dtype)
+    y2 = np.zeros(sh, yp.dtype)
+    o2 = np.zeros(sh, op_.dtype)
+    v2 = np.zeros(sh, bool)
+    tl, xl, yl, ol = tp[live], xp[live], yp[live], op_[live]
+    start = 0
+    for s in range(ndev):
+        c = int(counts[s])
+        sl = slice(start, start + c)  # oid-sorted ⇒ contiguous block
+        t2[s, :c] = tl[sl]
+        x2[s, :c] = xl[sl]
+        y2[s, :c] = yl[sl]
+        o2[s, :c] = ol[sl]
+        v2[s, :c] = True
+        o2[s, c:] = (s + 1) * kb - 1  # local padding stays in-shard
+        start += c
+    fn = _cached_sharded_tstats_pane(mesh, kb, slide_ms, ppw, n_panes)
+    return fn(
+        jnp.asarray(t2), jnp.asarray(x2), jnp.asarray(y2),
+        jnp.asarray(o2), jnp.asarray(v2),
+    )
+
+
 def sharded_geometry_geometry_join_pruned(
     mesh: Mesh,
     averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius,

@@ -234,8 +234,12 @@ class WireKafkaSource:
             for _ts, p, off, value in round_msgs:
                 # Offset advances as the record is HANDED OVER — a
                 # checkpoint between yields never loses or repeats a
-                # round's records (see class docstring).
-                offsets[p] = off + 1
+                # round's records (see class docstring). max(): if
+                # within-partition timestamps are non-monotone (producer
+                # retry / CreateTime skew) the ts sort can yield a later
+                # offset first — never step the position BACK, or the
+                # next fetch would re-deliver it as a duplicate.
+                offsets[p] = max(offsets[p], off + 1)
                 if value is None:
                     continue
                 try:

@@ -306,6 +306,8 @@ def lz4_decompress(data: bytes) -> bytes:
         pos += 8
     if has_dict:
         pos += 4
+    if pos >= len(data):
+        raise ValueError("corrupt lz4 frame: truncated header")
     hc = data[pos]
     spec_hc = (_xxh32(data[desc_start:pos]) >> 8) & 0xFF
     legacy_hc = (_xxh32(data[:pos]) >> 8) & 0xFF  # pre-KIP-57 Kafka

@@ -191,11 +191,15 @@ def _device_backend_preferred() -> bool:
         return False
 
 
-def _traj_stats_sliding_device(ts, xy, oid, num_oids, size_ms, slide_ms):
+def _traj_stats_sliding_device(ts, xy, oid, num_oids, size_ms, slide_ms,
+                               mesh=None):
     """Device pane engine wrapper: host (oid, ts) sort + pad, ONE jitted
     dispatch, host alive-filter. Bit-parity with the numpy path in f64
     (tests); f32 on non-x64 devices (segment sums associate in the same
-    pane order, spatial tolerance ~1e-6 relative)."""
+    pane order, spatial tolerance ~1e-6 relative). ``mesh``: shard
+    trajectories (contiguous oid blocks) over the mesh's ``data`` axis
+    (parallel/sharded.py:sharded_traj_stats_pane — bit-identical to
+    single-device; ``num_oids`` must divide by the axis)."""
     import jax
     import jax.numpy as jnp
 
@@ -239,14 +243,22 @@ def _traj_stats_sliding_device(ts, xy, oid, num_oids, size_ms, slide_ms):
     yp = np.concatenate([p[:, 1], np.zeros(pad)]).astype(f_dtype)
     vp = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
 
-    kernel = jitted(
-        traj_stats_pane_kernel, "num_oids", "slide_ms", "ppw", "n_panes",
-    )
-    res = kernel(
-        jnp.asarray(tp), jnp.asarray(xp), jnp.asarray(yp),
-        jnp.asarray(op_), jnp.asarray(vp),
-        num_oids=num_oids, slide_ms=slide_ms, ppw=ppw, n_panes=n_panes,
-    )
+    if mesh is not None:
+        from spatialflink_tpu.parallel.sharded import sharded_traj_stats_pane
+
+        res = sharded_traj_stats_pane(
+            mesh, tp, xp, yp, op_, vp,
+            num_oids=num_oids, slide_ms=slide_ms, ppw=ppw, n_panes=n_panes,
+        )
+    else:
+        kernel = jitted(
+            traj_stats_pane_kernel, "num_oids", "slide_ms", "ppw", "n_panes",
+        )
+        res = kernel(
+            jnp.asarray(tp), jnp.asarray(xp), jnp.asarray(yp),
+            jnp.asarray(op_), jnp.asarray(vp),
+            num_oids=num_oids, slide_ms=slide_ms, ppw=ppw, n_panes=n_panes,
+        )
     w_d = np.asarray(res.spatial).T
     w_dt = np.asarray(res.temporal).T.astype(np.int64)  # int32-exact sums
     w_cnt = np.asarray(res.count).T
@@ -270,6 +282,7 @@ def traj_stats_sliding(
     size_ms: int,
     slide_ms: int,
     backend: str = "auto",
+    mesh=None,
 ) -> TrajPaneWindows:
     """Pane-decomposed sliding trajectory statistics — tStats through
     extreme-overlap windows (e.g. the reference's 10s/10ms configs) in
@@ -304,12 +317,16 @@ def traj_stats_sliding(
             empty.astype(np.int64), _size_ms=size_ms,
         )
 
-    if backend not in ("auto", "device", "native", "numpy"):
+    if backend not in ("auto", "device", "numpy", "native"):
         raise ValueError(f"unknown traj_stats backend {backend!r}")
-    if backend == "device" or (backend == "auto" and
-                               _device_backend_preferred()):
+    if mesh is not None and backend in ("numpy", "native"):
+        raise ValueError(
+            f"mesh execution requires the device backend, not {backend!r}"
+        )
+    if mesh is not None or backend == "device" or (
+            backend == "auto" and _device_backend_preferred()):
         return _traj_stats_sliding_device(
-            ts, xy, oid, num_oids, size_ms, slide_ms
+            ts, xy, oid, num_oids, size_ms, slide_ms, mesh=mesh
         )
 
     ts_sorted = len(ts) <= 1 or bool(np.all(ts[1:] >= ts[:-1]))

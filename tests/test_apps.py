@@ -201,3 +201,33 @@ def test_cell_stay_time_soa_suppresses_fully_filtered_windows():
     # window [0,10s): only filtered oid=1 events -> suppressed;
     # window [10s,20s): one kept oid=0 event -> fires empty
     assert [(s_, e, len(c)) for s_, e, c, _ in out] == [(10_000, 20_000, 0)]
+
+
+def test_checkin_soa_matches_host_walk(rng):
+    """The device kernel (ops/checkin.py) must reproduce the host
+    count-window walk exactly: same emission sequence (synthesized
+    missing events included) and same running occupancy values."""
+    from spatialflink_tpu.apps.checkin import check_in_query_soa
+
+    rooms = [f"room{i}" for i in range(6)]
+    users = [f"u{i}" for i in range(9)]
+    evs = []
+    for i in range(400):
+        evs.append(CheckInEvent(
+            f"e{i}",
+            f"{rooms[int(rng.integers(0, 6))]}-"
+            f"{'in' if rng.integers(0, 2) else 'out'}",
+            users[int(rng.integers(0, 9))],
+            timestamp=1000 + i * 7,
+        ))
+    caps = {"room0": 5, "room3": 2}
+    host = [(r, c, o) for r, c, o, _w in check_in_query(iter(evs), caps)]
+    soa = [(r, c, o) for r, c, o, _w in check_in_query_soa(iter(evs), caps)]
+    assert soa == host
+    assert len(host) > 400  # synthesized events actually occurred
+
+
+def test_checkin_soa_empty_stream():
+    from spatialflink_tpu.apps.checkin import check_in_query_soa
+
+    assert list(check_in_query_soa(iter([]), {})) == []

@@ -296,6 +296,10 @@ def test_lz4_corrupt_inputs_raise():
     # snappy bytes labeled lz4 must fail loudly, not return garbage
     with pytest.raises((ValueError, IndexError)):
         kw.lz4_decompress(kw.snappy_compress_literal(b"not lz4"))
+    # content-size flag set but the header is truncated: ValueError with
+    # context, not a bare IndexError (r5 code review)
+    with pytest.raises(ValueError, match="truncated header"):
+        kw.lz4_decompress(b"\x04\x22\x4d\x18" + bytes([0x48, 0x40, 0x00]))
     # token promises a match but only 1 byte remains for the offset —
     # must raise, not silently decode partial garbage (r5 code review)
     with pytest.raises(ValueError, match="match offset"):
@@ -625,6 +629,30 @@ def test_multi_partition_timestamp_merge(monkeypatch):
             kafka_source("t", f"127.0.0.1:{b.port}", parser=str), 20
         ))
         assert got == [f"r{t}" for t in range(20)]
+    finally:
+        b.close()
+
+
+def test_multi_partition_nonmonotone_ts_no_duplicates(monkeypatch):
+    """Within-partition timestamp skew (producer retry / CreateTime)
+    must never step a partition's offset backwards — the ts-only merge
+    sort can yield a later offset first, and a regressed position would
+    re-deliver the earlier record next round (r5 code review)."""
+    _no_libs(monkeypatch)
+    from spatialflink_tpu.streams.kafka import WireKafkaSource
+
+    b = FakeBroker(num_partitions=2)
+    try:
+        client = kw.KafkaWireClient(f"127.0.0.1:{b.port}")
+        # partition 0: offsets 0,1 carry ts 100, 50 (NON-monotone)
+        client.produce("t", 0, [(b"p0a", None, 100), (b"p0b", None, 50)])
+        client.produce("t", 1, [(b"p1a", None, 60), (b"p1b", None, 70)])
+        client.close()
+        src = WireKafkaSource("t", f"127.0.0.1:{b.port}", parser=str)
+        got = list(itertools.islice(iter(src), 4))
+        src.close()
+        assert sorted(got) == ["p0a", "p0b", "p1a", "p1b"], got
+        assert len(set(got)) == 4, f"duplicate delivery: {got}"
     finally:
         b.close()
 

@@ -38,7 +38,8 @@ def _runsoa_key(results):
     return out
 
 
-def _parity(rng, conf, radius, n=1500, n_obj=24, t_span=4_000):
+def _parity(rng, conf, radius, n=1500, n_obj=24, t_span=4_000,
+            backend="auto"):
     left = _chunks(rng, n, t_span, n_obj)
     right = _chunks(rng, n, t_span, n_obj, seed_shift=0.3)
     op1 = TJoinQuery(conf, GRID)
@@ -49,7 +50,7 @@ def _parity(rng, conf, radius, n=1500, n_obj=24, t_span=4_000):
     op2 = TJoinQuery(conf, GRID)
     panes = _runsoa_key(op2.run_soa_panes(
         iter([dict(c) for c in left]), iter([dict(c) for c in right]),
-        radius, num_segments=n_obj,
+        radius, num_segments=n_obj, backend=backend,
     ))
     assert soa, "no windows fired"
     hits = 0
@@ -61,18 +62,21 @@ def _parity(rng, conf, radius, n=1500, n_obj=24, t_span=4_000):
 
 
 @pytest.mark.slow
-def test_tjoin_panes_matches_run_soa_sliding(rng):
+@pytest.mark.parametrize("backend", ["device", "native"])
+def test_tjoin_panes_matches_run_soa_sliding(rng, backend):
     conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
                               slide_step=0.1)
-    _parity(rng, conf, radius=0.4)
+    _parity(rng, conf, radius=0.4, backend=backend)
 
 
 @pytest.mark.slow
-def test_tjoin_panes_matches_run_soa_extreme_overlap(rng):
+@pytest.mark.parametrize("backend", ["device", "native"])
+def test_tjoin_panes_matches_run_soa_extreme_overlap(rng, backend):
     """ppw=100 — the 10s/10ms window shape at test scale."""
     conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
                               slide_step=0.01)
-    _parity(rng, conf, radius=0.3, n=800, n_obj=16, t_span=2_500)
+    _parity(rng, conf, radius=0.3, n=800, n_obj=16, t_span=2_500,
+            backend=backend)
 
 
 @pytest.mark.slow
@@ -90,7 +94,7 @@ def test_tjoin_panes_retry_on_tiny_budgets(rng):
     ))
     got = _runsoa_key(TJoinQuery(conf, GRID).run_soa_panes(
         iter([dict(c) for c in left]), iter([dict(c) for c in right]),
-        0.5, num_segments=n_obj, cap_w=2, pair_sel=1,
+        0.5, num_segments=n_obj, cap_w=2, pair_sel=1, backend="device",
     ))
     for start, pairs in ref.items():
         assert got[start] == pairs
@@ -177,3 +181,66 @@ def test_tjoin_panes_single_pane_cell_flood_retries(rng):
     ))
     for start, pairs in ref.items():
         assert got[start] == pairs
+
+
+def test_tjoin_panes_native_matches_device(rng):
+    """The native CPU engine (sf_tjoin_panes) against the device scan on
+    the same stream — same windows, same pair sets, min dists to 1e-12
+    (double FMA contraction freedom between g++ and XLA)."""
+    from spatialflink_tpu import native as _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.2)
+    n, n_obj = 1_000, 12
+    left = _chunks(rng, n, 3_000, n_obj)
+    right = _chunks(rng, n, 3_000, n_obj, seed_shift=0.25)
+
+    def run(backend):
+        return {
+            s: list(zip(map(int, lo), map(int, ro), dd))
+            for s, e, lo, ro, dd, c, ov in TJoinQuery(conf, GRID)
+            .run_soa_panes(
+                iter([dict(c) for c in left]),
+                iter([dict(c) for c in right]),
+                0.45, num_segments=n_obj, backend=backend,
+            )
+        }
+
+    dev = run("device")
+    nat = run("native")
+    assert dev.keys() == nat.keys()
+    pairs_total = 0
+    for s in dev:
+        dpairs = {(a, b): d for a, b, d in dev[s]}
+        npairs = {(a, b): d for a, b, d in nat[s]}
+        assert dpairs.keys() == npairs.keys(), f"window {s} pair set"
+        for k in dpairs:
+            assert abs(dpairs[k] - npairs[k]) <= 1e-12 * max(
+                abs(dpairs[k]), 1e-30)
+        pairs_total += len(dpairs)
+    assert pairs_total > 0
+
+
+def test_tjoin_panes_backend_validation(rng):
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=1,
+                              slide_step=0.5)
+    chunk = [{
+        "ts": np.asarray([100], np.int64), "x": np.asarray([5.0]),
+        "y": np.asarray([5.0]), "oid": np.asarray([0], np.int32),
+    }]
+    with pytest.raises(ValueError, match="backend"):
+        list(TJoinQuery(conf, GRID).run_soa_panes(
+            iter(chunk), iter([dict(chunk[0])]), 0.5, num_segments=4,
+            backend="cuda",
+        ))
+    import unittest.mock as mock
+
+    from spatialflink_tpu import native as _native
+    with mock.patch.object(_native, "available", return_value=False):
+        with pytest.raises(RuntimeError, match="native library"):
+            list(TJoinQuery(conf, GRID).run_soa_panes(
+                iter([dict(chunk[0])]), iter([dict(chunk[0])]), 0.5,
+                num_segments=4, backend="native",
+            ))
