@@ -581,8 +581,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         ``slides``: iterable of (3, n_i) uint16 PLANE-MAJOR pane arrays
         in the 6 B/pt wire format (streams/wire.py) — rows x_q, y_q,
         interned-int16-oid bits — one array per ``slide_step`` pane, in
-        event-time order (the kafka wire client and the native CSV
-        parser both produce these planes). Pane i covers
+        event-time order (``streams/wire.py:wire_panes`` produces them
+        from any SoA chunk stream, e.g. the native CSV parser's arrays
+        or a batched Kafka consumer). Pane i covers
         [start_ms + i·slide, start_ms + (i+1)·slide); every window
         OVERLAPPING a received pane fires — including the leading
         partial windows (negative-offset starts, matching

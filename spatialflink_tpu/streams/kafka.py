@@ -231,15 +231,25 @@ class WireKafkaSource:
                     else:
                         round_msgs.append((ts_ms, p, off, value))
             round_msgs.sort(key=lambda m: m[0])
+            # Positions advance CONTIGUOUSLY as records are handed over:
+            # if within-partition timestamps are non-monotone (producer
+            # retry / CreateTime skew) the ts sort can yield a later
+            # offset first — advancing straight to it would make a
+            # mid-round checkpoint SKIP the earlier, not-yet-yielded
+            # record. Out-of-sequence yields park in `ahead` until the
+            # gap closes; a mid-round resume then re-delivers them
+            # (at-least-once under ts skew; exactly-once for the normal
+            # monotone case — same degradation as any replaying source).
+            ahead: dict = {}
             for _ts, p, off, value in round_msgs:
-                # Offset advances as the record is HANDED OVER — a
-                # checkpoint between yields never loses or repeats a
-                # round's records (see class docstring). max(): if
-                # within-partition timestamps are non-monotone (producer
-                # retry / CreateTime skew) the ts sort can yield a later
-                # offset first — never step the position BACK, or the
-                # next fetch would re-deliver it as a duplicate.
-                offsets[p] = max(offsets[p], off + 1)
+                if off == offsets[p]:
+                    offsets[p] = off + 1
+                    parked = ahead.get(p)
+                    while parked and offsets[p] in parked:
+                        parked.remove(offsets[p])
+                        offsets[p] += 1
+                elif off > offsets[p]:
+                    ahead.setdefault(p, set()).add(off)
                 if value is None:
                     continue
                 try:

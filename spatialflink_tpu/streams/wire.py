@@ -95,3 +95,70 @@ class WireFormat:
     def bytes_per_point(self) -> int:
         """uint16 x + uint16 y + int16 interned oid."""
         return 6
+
+
+def wire_panes(chunks, wire_format: WireFormat, slide_ms: int,
+               start_ms: int):
+    """SoA chunks → successive (3, n) uint16 PLANE-MAJOR pane arrays.
+
+    The producer half of the wire-pane operator seam: feeds
+    ``PointPointKNNQuery.run_wire_panes`` (and the bench.py headline
+    program) from any SoA chunk stream ``{"ts", "x", "y", "oid"}`` —
+    e.g. the native CSV parser's arrays or a batched Kafka consumer.
+    Pane i covers [start_ms + i·slide_ms, start_ms + (i+1)·slide_ms);
+    EVERY pane in order is yielded, including empty (3, 0) panes in
+    event-time gaps, so downstream window indexing stays aligned.
+
+    In-order streams only (the pane-path contract): a pane is emitted
+    once an event at/after its end arrives, so an event earlier than
+    the current pane raises rather than being silently mis-binned.
+    ``oid`` must already be interned into int16 range. The final,
+    possibly partial, pane is flushed when the chunk stream ends.
+    """
+    pend_ts = np.zeros(0, np.int64)
+    pend_xy = np.zeros((0, 2), np.float64)
+    pend_oid = np.zeros(0, np.int64)
+    cur = int(start_ms)
+
+    def pack(xy, oid):
+        q = wire_format.quantize(xy)
+        o = np.asarray(oid, np.int16).view(np.uint16)
+        return np.ascontiguousarray(
+            np.concatenate([q, o[:, None]], axis=1).T
+        )
+
+    for ch in chunks:
+        ts = np.asarray(ch["ts"], np.int64)
+        if len(ts) == 0:
+            continue
+        xy = np.stack(
+            [np.asarray(ch["x"], np.float64),
+             np.asarray(ch["y"], np.float64)], axis=1
+        )
+        oid = np.asarray(ch["oid"])
+        # Full in-order check: against the open pane, against the
+        # pending tail, AND within the chunk (searchsorted below is a
+        # binary search — unsorted input would silently mis-bin).
+        prev_last = int(pend_ts[-1]) if len(pend_ts) else cur
+        if int(ts[0]) < max(cur, prev_last) or (
+                len(ts) > 1 and bool(np.any(np.diff(ts) < 0))):
+            raise ValueError(
+                "out-of-order event stream: wire_panes requires "
+                "non-decreasing timestamps (the pane-path contract); "
+                f"open pane starts at {cur} ms"
+            )
+        pend_ts = np.concatenate([pend_ts, ts])
+        pend_xy = np.concatenate([pend_xy, xy])
+        pend_oid = np.concatenate([pend_oid, oid])
+        # Emit every pane strictly BEFORE the newest event's pane (the
+        # in-order watermark: a later event closes all earlier panes).
+        newest = int(pend_ts[-1])
+        while cur + slide_ms <= newest:
+            hi = int(np.searchsorted(pend_ts, cur + slide_ms, "left"))
+            yield pack(pend_xy[:hi], pend_oid[:hi])
+            pend_ts = pend_ts[hi:]
+            pend_xy = pend_xy[hi:]
+            pend_oid = pend_oid[hi:]
+            cur += slide_ms
+    if len(pend_ts):
+        yield pack(pend_xy, pend_oid)

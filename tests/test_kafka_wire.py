@@ -657,6 +657,39 @@ def test_multi_partition_nonmonotone_ts_no_duplicates(monkeypatch):
         b.close()
 
 
+def test_mid_round_checkpoint_nonmonotone_ts_no_loss(monkeypatch):
+    """A checkpoint taken mid round while ts skew made a LATER offset
+    yield first must not skip the earlier, not-yet-yielded record:
+    positions advance contiguously, so the resume re-delivers the
+    parked record (at-least-once) instead of losing the earlier one
+    (r5 code review)."""
+    _no_libs(monkeypatch)
+    from spatialflink_tpu.streams.kafka import WireKafkaSource
+
+    b = FakeBroker(num_partitions=2)
+    try:
+        bs = f"127.0.0.1:{b.port}"
+        client = kw.KafkaWireClient(bs)
+        # partition 0: off 0 carries the LATER ts — it yields second
+        client.produce("t", 0, [(b"late", None, 200), (b"early", None, 100)])
+        client.produce("t", 1, [(b"mid", None, 150)])
+        client.close()
+        src1 = WireKafkaSource("t", bs, parser=str)
+        first = list(itertools.islice(iter(src1), 1))
+        assert first == ["early"]  # off 1, parked out-of-sequence
+        snap = src1.offsets
+        src1.close()
+        assert snap.get(0, 0) == 0, "position must not skip offset 0"
+        src2 = WireKafkaSource("t", bs, parser=str, start_offsets=snap)
+        rest = list(itertools.islice(iter(src2), 3))
+        src2.close()
+        # no loss: every record observed across the checkpoint; the
+        # parked record may legitimately repeat (at-least-once).
+        assert set(first) | set(rest) == {"late", "early", "mid"}
+    finally:
+        b.close()
+
+
 def test_kill_and_resume_replays_no_gap_no_dup(monkeypatch):
     """The VERDICT r4 missing item: consumer offsets snapshot through
     checkpoint.py so a killed ingest resumes exactly where it left off —

@@ -216,6 +216,79 @@ def test_run_wire_panes_matches_run_soa_panes(rng, strategy):
     assert matched_neighbors > 0, "degenerate: every window empty"
 
 
+def test_wire_panes_producer_feeds_run_wire_panes(rng):
+    """streams/wire.py:wire_panes (the SoA→plane-major producer) must
+    bin identically to hand-built slides — incl. EMPTY panes inside
+    event-time gaps — so the full ingest→operator seam matches
+    run_soa_panes end to end."""
+    from spatialflink_tpu.streams.wire import wire_panes
+
+    n = 2000
+    ts = np.sort(rng.integers(0, 30_000, n)).astype(np.int64)
+    ts[(ts >= 8_000) & (ts < 14_000)] = 7_999  # a 3-pane event gap
+    ts = np.sort(ts)
+    wire, xyf, oid = _wire(rng, n)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
+                              slide_step=2)
+    q, r, k = Point(x=5.0, y=5.0), 2.0, 6
+    slide_ms = conf.slide_step_ms
+
+    chunks = [
+        {"ts": ts[a:b], "x": xyf[a:b, 0].astype(np.float64),
+         "y": xyf[a:b, 1].astype(np.float64), "oid": oid[a:b]}
+        for a, b in zip(range(0, n, 300), list(range(300, n, 300)) + [n])
+    ]
+    produced = list(wire_panes(chunks, WF, slide_ms, start_ms=0))
+    manual = []
+    for ps in range(0, int(ts[-1]) + 1, slide_ms):
+        sel = (ts >= ps) & (ts < ps + slide_ms)
+        manual.append(np.ascontiguousarray(wire[:, sel]))
+    assert len(produced) == len(manual)
+    assert any(p.shape[1] == 0 for p in produced), "gap panes missing"
+    for a, b in zip(produced, manual):
+        np.testing.assert_array_equal(a, b)
+
+    soa = {
+        (s, e): (list(map(int, oo)), np.asarray(dd))
+        for s, e, oo, dd, nv in PointPointKNNQuery(conf, GRID).run_soa_panes(
+            _soa_chunks(ts, xyf, oid), q, r, k,
+            num_segments=NSEG, dtype=np.float32,
+        )
+    }
+    got = {
+        (s, e): (list(map(int, oo)), np.asarray(dd))
+        for s, e, oo, dd, nv in PointPointKNNQuery(conf, GRID)
+        .run_wire_panes(produced, q, r, k, NSEG, WF, start_ms=0)
+    }
+    assert set(soa) <= set(got)
+    for key in soa:
+        assert soa[key][0] == got[key][0]
+        np.testing.assert_allclose(got[key][1], soa[key][1], rtol=5e-7,
+                                   atol=0)
+
+
+def test_wire_panes_rejects_out_of_order():
+    from spatialflink_tpu.streams.wire import wire_panes
+
+    chunks = [
+        {"ts": np.asarray([5_000], np.int64), "x": np.asarray([1.0]),
+         "y": np.asarray([1.0]), "oid": np.asarray([0])},
+        {"ts": np.asarray([1_000], np.int64), "x": np.asarray([1.0]),
+         "y": np.asarray([1.0]), "oid": np.asarray([0])},
+    ]
+    with pytest.raises(ValueError, match="out-of-order"):
+        list(wire_panes(chunks, WF, 2_000, start_ms=0))
+    # disorder WITHIN one chunk must raise too (binary-search binning
+    # would silently mis-bin; r5 code review)
+    bad = [{
+        "ts": np.asarray([11_000, 8_500, 12_000], np.int64),
+        "x": np.asarray([1.0, 1.0, 1.0]), "y": np.asarray([1.0, 1.0, 1.0]),
+        "oid": np.asarray([0, 0, 0]),
+    }]
+    with pytest.raises(ValueError, match="out-of-order"):
+        list(wire_panes(bad, WF, 2_000, start_ms=0))
+
+
 def test_run_wire_panes_rejects_bad_input():
     conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
                               slide_step=2)
