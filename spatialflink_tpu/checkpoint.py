@@ -15,8 +15,9 @@ Snapshottable components:
     state machine;
   - TAggregateQuery: the per-(cell, objID) min/max timestamp MapState;
   - TStatsQuery: per-objID running spatial/temporal state;
-  - kNN pane-digest carry (query_panes / run_soa_panes) and join
-    pane-block carry (query_panes) — the incremental sliding-window
+  - kNN pane-digest carry (query_panes / run_soa_panes / run_wire_panes'
+    digest ring + next-pane index) and join pane-block carry
+    (query_panes) — the incremental sliding-window
     state, the ListState-carry analog of
     range/PointPointRangeQuery.java:234-246. Device digests are pulled
     to numpy at snapshot time; a resumed operator continues the stream
@@ -138,6 +139,15 @@ def operator_state(op) -> Dict[str, Any]:
             ps: None if v is None else (np.asarray(v[0]), np.asarray(v[1]))
             for ps, v in soa_pane.items()
         }
+    wire_pane = getattr(op, "_wire_pane_carry", None)
+    if wire_pane is not None:  # kNN run_wire_panes digest ring
+        out["knn_wire_pane_carry"] = {
+            "next_pane": int(wire_pane["next_pane"]),
+            "digests": [
+                (np.asarray(s), np.asarray(r))
+                for s, r in wire_pane["digests"]
+            ],
+        }
     jcarry = getattr(op, "_join_pane_carry", None)
     if jcarry is not None:  # join query_panes pane events + pair blocks
         out["join_pane_carry"] = {
@@ -190,6 +200,16 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
             ps: None if v is None else (v[0], v[1])
             for ps, v in state["knn_pane_carry_soa"].items()
         }
+    if "knn_wire_pane_carry" in state:
+        op._wire_pane_carry = {
+            "next_pane": int(state["knn_wire_pane_carry"]["next_pane"]),
+            "digests": [
+                (s, r) for s, r in state["knn_wire_pane_carry"]["digests"]
+            ],
+        }
+        # Consumed by the NEXT run_wire_panes call only — the
+        # index-based carry must never leak into an ordinary fresh run.
+        op._wire_pane_restored = True
     if "join_pane_carry" in state:
         # Pane batches are derived data — rebuild through the operator's
         # own batcher (the interner restored above keeps ids stable).

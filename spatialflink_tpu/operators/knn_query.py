@@ -625,9 +625,42 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         merge = jitted(knn_merge_digest_list, "k")
         no_bases = np.zeros(ppw, np.int32)  # indices unused by this yield
         jstep = None
-        digests: list = []
-        empty = None  # lazy: absent-pane digest (leading/trailing partials)
         self.last_wire_digest_kind = None
+        empty = (
+            jnp.full((num_segments,),
+                     np.float32(np.finfo(np.float32).max), jnp.float32),
+            jnp.full((num_segments,), np.iinfo(np.int32).max, jnp.int32),
+        )
+
+        # Operator-owned, checkpointable state (the wire path's
+        # ListState analog): the live digest ring + the next logical
+        # pane index. checkpoint.py:operator_state snapshots it; a
+        # restored operator continues MID-WINDOW when the caller feeds
+        # the remaining panes (paired with WireKafkaSource's offsets,
+        # kill-and-resume covers ingest + operator;
+        # tests/test_checkpoint_panes.py). The carry is consumed ONLY
+        # right after restore_operator (the _wire_pane_restored flag):
+        # unlike the timestamp-keyed run_soa_panes carry, this one is
+        # pane-INDEX based, so resuming it on an ordinary second call
+        # would silently time-shift every window.
+        saved = None
+        if getattr(self, "_wire_pane_restored", False):
+            saved = getattr(self, "_wire_pane_carry", None)
+        self._wire_pane_restored = False
+        if saved is not None:
+            pane0 = int(saved["next_pane"])
+            digests = [
+                (jnp.asarray(s), jnp.asarray(r)) for s, r in saved["digests"]
+            ]
+        else:
+            pane0 = 0
+            # Seed the ring with ppw-1 empty digests so the LEADING
+            # partial windows fire (run_soa_panes parity: its assembler
+            # starts at earliest_window_of the first event).
+            digests = [empty] * (ppw - 1)
+        self._wire_pane_carry = {
+            "next_pane": pane0, "digests": list(digests),
+        }
 
         def fire(pane_i):
             res = merge(
@@ -641,8 +674,8 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
             )
 
-        i = -1
-        for i, wire_p in enumerate(slides):
+        i = pane0 - 1
+        for i, wire_p in enumerate(slides, start=pane0):
             wire_p = np.asarray(wire_p)
             if (wire_p.ndim != 2 or wire_p.shape[0] != 3
                     or wire_p.dtype != np.uint16):
@@ -666,22 +699,18 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 )
                 self.last_wire_digest_kind = kind
                 jstep = jax.jit(step)
-                # Seed the ring with ppw-1 empty digests so the LEADING
-                # partial windows fire (run_soa_panes parity: its
-                # assembler starts at earliest_window_of the first
-                # event, streams/soa.py).
-                empty = (
-                    jnp.full((num_segments,), np.float32(
-                        np.finfo(np.float32).max), jnp.float32),
-                    jnp.full((num_segments,), np.iinfo(np.int32).max,
-                             jnp.int32),
-                )
-                digests.extend([empty] * (ppw - 1))
             d = jstep(wire_d, jnp.int32(n), q, scale, origin, r32)
             digests.append((d.seg_min, d.rep))
             del digests[:-ppw]
+            self._wire_pane_carry = {
+                "next_pane": i + 1, "digests": list(digests),
+            }
             yield fire(i)
-        if flush_at_end and i >= 0:
+        # Flush iff ≥1 REAL pane exists in the logical stream: consumed
+        # this call (i advanced past pane0-1) or before the checkpoint
+        # (pane0 > 0). A restore taken before any pane must NOT flush —
+        # an uninterrupted empty run yields nothing.
+        if flush_at_end and (i >= pane0 or pane0 > 0):
             # Trailing partial windows: panes shift out, empties in.
             for j in range(1, ppw):
                 digests.append(empty)

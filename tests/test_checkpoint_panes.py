@@ -171,3 +171,97 @@ def test_knn_soa_pane_carry_kill_and_resume(rng, tmp_path):
 
     assert part1 + part2 == baseline
     assert part1 and part2
+
+
+def test_knn_wire_pane_carry_kill_and_resume(rng, tmp_path):
+    """run_wire_panes (the wire-ingest headline path) resumes
+    mid-window: the digest ring + next pane index snapshot through
+    operator_state; a restored operator fed the REMAINING panes (the
+    WireKafkaSource-offsets pairing) continues identically to an
+    uninterrupted run."""
+    from spatialflink_tpu.streams.wire import WireFormat, wire_panes
+
+    wf = WireFormat.for_grid(GRID)
+    n = 5_000
+    ts = np.sort(rng.integers(0, 40_000, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    wq = wf.quantize(xy)
+    xyf = wf.dequantize_np(wq)
+    oids = rng.integers(0, 32, n).astype(np.int32)
+    q = Point(x=5.0, y=5.0)
+    r, k, nseg = 3.0, 6, 32
+    slide_ms = CONF.slide_step_ms
+
+    panes = list(wire_panes(
+        [{"ts": ts, "x": xyf[:, 0].astype(np.float64),
+          "y": xyf[:, 1].astype(np.float64), "oid": oids}],
+        wf, slide_ms, start_ms=0,
+    ))
+
+    def collect(gen):
+        return [
+            (s, e, list(map(int, o)), [round(float(x), 6) for x in d], nv)
+            for s, e, o, d, nv in gen
+        ]
+
+    def run(op, pane_list, flush=True):
+        return collect(op.run_wire_panes(
+            pane_list, q, r, k, nseg, wf, start_ms=0, flush_at_end=flush,
+        ))
+
+    baseline = run(PointPointKNNQuery(CONF, GRID), panes)
+
+    cut = len(panes) // 3
+    op1 = PointPointKNNQuery(CONF, GRID)
+    part1 = run(op1, panes[:cut], flush=False)
+    path = str(tmp_path / "wire.ckpt")
+    save_checkpoint(path, op=operator_state(op1))
+    del op1
+
+    op2 = PointPointKNNQuery(CONF, GRID)
+    restore_operator(op2, load_checkpoint(path)["op"])
+    part2 = run(op2, panes[cut:])
+
+    assert part1 + part2 == baseline
+    assert part1 and part2
+
+
+def test_knn_wire_pane_carry_not_reentrant_leak(rng, tmp_path):
+    """The index-based wire carry is consumed only right after restore:
+    an ordinary SECOND call on the same operator must be a fresh run
+    (identical output), not a silent time-shifted resume — and a
+    checkpoint taken before ANY pane restores to a run that flushes
+    nothing on an empty remainder (r5 code review)."""
+    from spatialflink_tpu.streams.wire import WireFormat, wire_panes
+
+    wf = WireFormat.for_grid(GRID)
+    n = 1_500
+    ts = np.sort(rng.integers(0, 20_000, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    xyf = wf.dequantize_np(wf.quantize(xy))
+    oids = rng.integers(0, 16, n).astype(np.int32)
+    q = Point(x=5.0, y=5.0)
+    panes = list(wire_panes(
+        [{"ts": ts, "x": xyf[:, 0].astype(np.float64),
+          "y": xyf[:, 1].astype(np.float64), "oid": oids}],
+        wf, CONF.slide_step_ms, start_ms=0,
+    ))
+
+    def collect(gen):
+        return [(s, e, list(map(int, o)), nv) for s, e, o, _d, nv in gen]
+
+    op = PointPointKNNQuery(CONF, GRID)
+    first = collect(op.run_wire_panes(panes, q, 3.0, 5, 16, wf))
+    second = collect(op.run_wire_panes(panes, q, 3.0, 5, 16, wf))
+    assert first == second
+
+    # checkpoint before any pane → restore + empty remainder = nothing
+    op1 = PointPointKNNQuery(CONF, GRID)
+    none = collect(op1.run_wire_panes([], q, 3.0, 5, 16, wf,
+                                      flush_at_end=False))
+    assert none == []
+    path = str(tmp_path / "wire0.ckpt")
+    save_checkpoint(path, op=operator_state(op1))
+    op2 = PointPointKNNQuery(CONF, GRID)
+    restore_operator(op2, load_checkpoint(path)["op"])
+    assert collect(op2.run_wire_panes([], q, 3.0, 5, 16, wf)) == []
