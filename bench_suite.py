@@ -1158,7 +1158,20 @@ def main():
         help="run on the single-device CPU backend and write the measured "
              "points/s of every config to CPU_BASELINE.json",
     )
+    ap.add_argument(
+        "--configs", default=None,
+        help="comma-separated substrings; run only configs whose name "
+             "matches one (e.g. --configs knn_k50,tjoin_panes). A flaky "
+             "tunnel day: capture configs one at a time instead of "
+             "risking the whole suite on one dial.",
+    )
     args = ap.parse_args()
+    if args.cpu_baseline and args.configs:
+        ap.error(
+            "--configs cannot combine with --cpu-baseline: the baseline "
+            "file is written whole, so a filtered run would silently "
+            "drop every non-matching config's entry"
+        )
 
     if args.cpu_baseline:
         # Must happen before jax import: force the CPU backend, one device.
@@ -1177,20 +1190,41 @@ def main():
     from spatialflink_tpu.grid import UniformGrid
 
     grid = UniformGrid(100, min_x=115.5, max_x=117.6, min_y=39.6, max_y=41.1)
-    results = [
-        bench_range_window(jax, jnp, grid, args.quick),
-        bench_knn_k(jax, jnp, grid, 10, args.quick),
-        bench_knn_k(jax, jnp, grid, 50, args.quick),
-        bench_knn_k(jax, jnp, grid, 500, args.quick),
-        bench_polygon_range(jax, jnp, grid, args.quick),
-        bench_join(jax, jnp, grid, args.quick),
-        bench_point_polygon_join(jax, jnp, grid, args.quick),
-        bench_tjoin_sliding(jax, jnp, grid, args.quick),
-        bench_tjoin_panes(jax, jnp, grid, args.quick),
-        bench_tknn(jax, jnp, grid, args.quick),
-        bench_tstats_pane(jax, jnp, grid, args.quick),
-        bench_knn_multi_query(jax, jnp, grid, args.quick),
+    all_benches = [
+        ("range_pp_r500m_10s_tumbling",
+         lambda: bench_range_window(jax, jnp, grid, args.quick)),
+        ("continuous_knn_k10_5s_sliding",
+         lambda: bench_knn_k(jax, jnp, grid, 10, args.quick)),
+        ("continuous_knn_k50_5s_sliding",
+         lambda: bench_knn_k(jax, jnp, grid, 50, args.quick)),
+        ("continuous_knn_k500_5s_sliding",
+         lambda: bench_knn_k(jax, jnp, grid, 500, args.quick)),
+        ("range_point_1000polygons",
+         lambda: bench_polygon_range(jax, jnp, grid, args.quick)),
+        ("join_two_streams_r200m",
+         lambda: bench_join(jax, jnp, grid, args.quick)),
+        ("join_point_1000polygons",
+         lambda: bench_point_polygon_join(jax, jnp, grid, args.quick)),
+        ("tjoin_10s_1s_sliding",
+         lambda: bench_tjoin_sliding(jax, jnp, grid, args.quick)),
+        ("tjoin_panes_10s_10ms",
+         lambda: bench_tjoin_panes(jax, jnp, grid, args.quick)),
+        ("trajectory_knn_k20_per_objid",
+         lambda: bench_tknn(jax, jnp, grid, args.quick)),
+        ("tstats_pane_10s_10ms",
+         lambda: bench_tstats_pane(jax, jnp, grid, args.quick)),
+        ("knn_multi_64queries_k10",
+         lambda: bench_knn_multi_query(jax, jnp, grid, args.quick)),
     ]
+    if args.configs:
+        wanted = [w.strip() for w in args.configs.split(",") if w.strip()]
+        all_benches = [
+            (name, fn) for name, fn in all_benches
+            if any(w in name for w in wanted)
+        ]
+        if not all_benches:
+            raise SystemExit(f"--configs matched nothing: {args.configs}")
+    results = [fn() for _name, fn in all_benches]
     if args.cpu_baseline:
         results.append(bench_headline_knn_1m(jax, jnp, grid))
         payload = {
