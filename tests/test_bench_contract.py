@@ -33,6 +33,11 @@ def _run(tmp_path, extra_env, last_good=None):
         **os.environ,
         "SFT_BENCH_BACKOFFS": "0",
         "SFT_BENCH_LAST_GOOD": str(lg),
+        # These contract tests never dial the device, but a down/half-
+        # open tunnel can hang ANY interpreter start via the axon
+        # sitecustomize register() (CLAUDE.md) — skip plugin
+        # registration in the spawned processes.
+        "PALLAS_AXON_POOL_IPS": "",
         **extra_env,
     }
     env.pop("SFT_BENCH_CHILD", None)
