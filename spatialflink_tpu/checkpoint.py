@@ -230,6 +230,20 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
         }
 
 
+def wire_pane_assembler_state(asm) -> Dict[str, Any]:
+    """Snapshot a streams/wire.py:WirePaneAssembler — the open pane's
+    buffered events + position (slide/wire-format identity included;
+    restore refuses a mismatched config). With the consumer offsets and
+    the operator's wire digest ring, the full wire pipeline resumes —
+    snapshots must be taken with all completed panes drained (the
+    pane-boundary alignment note on the class)."""
+    return asm.state()
+
+
+def restore_wire_pane_assembler(asm, state: Dict[str, Any]) -> None:
+    asm.restore(state)
+
+
 def kafka_source_state(src) -> Dict[str, Any]:
     """Snapshot a streams/kafka.py:WireKafkaSource — the checkpointed
     consumer-offsets role of Flink's Kafka consumer

@@ -97,40 +97,57 @@ class WireFormat:
         return 6
 
 
-def wire_panes(chunks, wire_format: WireFormat, slide_ms: int,
-               start_ms: int):
-    """SoA chunks → successive (3, n) uint16 PLANE-MAJOR pane arrays.
+class WirePaneAssembler:
+    """Stateful SoA → (3, n) uint16 PLANE-MAJOR pane binner.
 
     The producer half of the wire-pane operator seam: feeds
     ``PointPointKNNQuery.run_wire_panes`` (and the bench.py headline
     program) from any SoA chunk stream ``{"ts", "x", "y", "oid"}`` —
     e.g. the native CSV parser's arrays or a batched Kafka consumer.
     Pane i covers [start_ms + i·slide_ms, start_ms + (i+1)·slide_ms);
-    EVERY pane in order is yielded, including empty (3, 0) panes in
+    EVERY pane in order is emitted, including empty (3, 0) panes in
     event-time gaps, so downstream window indexing stays aligned.
 
     In-order streams only (the pane-path contract): a pane is emitted
     once an event at/after its end arrives, so an event earlier than
     the current pane raises rather than being silently mis-binned.
-    ``oid`` must already be interned into int16 range. The final,
-    possibly partial, pane is flushed when the chunk stream ends.
-    """
-    pend_ts = np.zeros(0, np.int64)
-    pend_xy = np.zeros((0, 2), np.float64)
-    pend_oid = np.zeros(0, np.int64)
-    cur = int(start_ms)
+    ``oid`` must already be interned into int16 range. ``flush()``
+    emits the final, possibly partial, pane at end of stream.
 
-    def pack(xy, oid):
-        q = wire_format.quantize(xy)
+    ``state()``/``restore()`` snapshot the OPEN pane's buffered events
+    + position (checkpoint.py:wire_pane_assembler_state): together
+    with the consumer offsets and the operator digest ring, the whole
+    wire pipeline resumes
+    (tests/test_kafka_wire.py::test_full_wire_pipeline_kill_and_resume).
+    Snapshot ALIGNMENT: every pane ``feed()`` has returned must be
+    drained downstream before snapshotting — a completed pane held
+    in-flight (e.g. the second of a multi-pane burst across an
+    event-time gap) lives in neither this state nor the operator's, so
+    a snapshot taken mid-burst loses it. This is the pane-boundary
+    barrier any checkpointing runtime imposes.
+    """
+
+    def __init__(self, wire_format: WireFormat, slide_ms: int,
+                 start_ms: int):
+        self._wf = wire_format
+        self._slide = int(slide_ms)
+        self._cur = int(start_ms)
+        self._pend_ts = np.zeros(0, np.int64)
+        self._pend_xy = np.zeros((0, 2), np.float64)
+        self._pend_oid = np.zeros(0, np.int64)
+
+    def _pack(self, xy, oid):
+        q = self._wf.quantize(xy)
         o = np.asarray(oid, np.int16).view(np.uint16)
         return np.ascontiguousarray(
             np.concatenate([q, o[:, None]], axis=1).T
         )
 
-    for ch in chunks:
+    def feed(self, ch) -> list:
+        """One SoA chunk in → the panes it completed (possibly [])."""
         ts = np.asarray(ch["ts"], np.int64)
         if len(ts) == 0:
-            continue
+            return []
         xy = np.stack(
             [np.asarray(ch["x"], np.float64),
              np.asarray(ch["y"], np.float64)], axis=1
@@ -139,26 +156,85 @@ def wire_panes(chunks, wire_format: WireFormat, slide_ms: int,
         # Full in-order check: against the open pane, against the
         # pending tail, AND within the chunk (searchsorted below is a
         # binary search — unsorted input would silently mis-bin).
-        prev_last = int(pend_ts[-1]) if len(pend_ts) else cur
-        if int(ts[0]) < max(cur, prev_last) or (
+        prev_last = (int(self._pend_ts[-1]) if len(self._pend_ts)
+                     else self._cur)
+        if int(ts[0]) < max(self._cur, prev_last) or (
                 len(ts) > 1 and bool(np.any(np.diff(ts) < 0))):
             raise ValueError(
-                "out-of-order event stream: wire_panes requires "
+                "out-of-order event stream: wire panes require "
                 "non-decreasing timestamps (the pane-path contract); "
-                f"open pane starts at {cur} ms"
+                f"open pane starts at {self._cur} ms"
             )
-        pend_ts = np.concatenate([pend_ts, ts])
-        pend_xy = np.concatenate([pend_xy, xy])
-        pend_oid = np.concatenate([pend_oid, oid])
+        self._pend_ts = np.concatenate([self._pend_ts, ts])
+        self._pend_xy = np.concatenate([self._pend_xy, xy])
+        self._pend_oid = np.concatenate([self._pend_oid, oid])
         # Emit every pane strictly BEFORE the newest event's pane (the
         # in-order watermark: a later event closes all earlier panes).
-        newest = int(pend_ts[-1])
-        while cur + slide_ms <= newest:
-            hi = int(np.searchsorted(pend_ts, cur + slide_ms, "left"))
-            yield pack(pend_xy[:hi], pend_oid[:hi])
-            pend_ts = pend_ts[hi:]
-            pend_xy = pend_xy[hi:]
-            pend_oid = pend_oid[hi:]
-            cur += slide_ms
-    if len(pend_ts):
-        yield pack(pend_xy, pend_oid)
+        out = []
+        newest = int(self._pend_ts[-1])
+        while self._cur + self._slide <= newest:
+            hi = int(np.searchsorted(
+                self._pend_ts, self._cur + self._slide, "left"
+            ))
+            out.append(self._pack(self._pend_xy[:hi], self._pend_oid[:hi]))
+            self._pend_ts = self._pend_ts[hi:]
+            self._pend_xy = self._pend_xy[hi:]
+            self._pend_oid = self._pend_oid[hi:]
+            self._cur += self._slide
+        return out
+
+    def flush(self) -> list:
+        """End of stream: the open pane's events as one final pane."""
+        if not len(self._pend_ts):
+            return []
+        out = [self._pack(self._pend_xy, self._pend_oid)]
+        self._pend_ts = np.zeros(0, np.int64)
+        self._pend_xy = np.zeros((0, 2), np.float64)
+        self._pend_oid = np.zeros(0, np.int64)
+        self._cur += self._slide
+        return out
+
+    def state(self) -> dict:
+        return {
+            "cur": int(self._cur),
+            "slide_ms": int(self._slide),
+            # wire-format identity: a checkpoint quantized against one
+            # grid extent must not restore into another
+            "wire_origin": [float(v) for v in self._wf.origin],
+            "wire_scale": [float(v) for v in self._wf.scale],
+            "pend_ts": np.asarray(self._pend_ts),
+            "pend_xy": np.asarray(self._pend_xy),
+            "pend_oid": np.asarray(self._pend_oid),
+        }
+
+    def restore(self, state: dict) -> None:
+        if int(state.get("slide_ms", self._slide)) != self._slide:
+            raise ValueError(
+                f"checkpoint slide_ms {state['slide_ms']} != this "
+                f"assembler's {self._slide} — pane boundaries would "
+                "silently shift"
+            )
+        want = ([float(v) for v in self._wf.origin],
+                [float(v) for v in self._wf.scale])
+        got = (state.get("wire_origin", want[0]),
+               state.get("wire_scale", want[1]))
+        if got != want:
+            raise ValueError(
+                "checkpoint wire format (origin/scale) does not match "
+                "this assembler's grid extent"
+            )
+        self._cur = int(state["cur"])
+        self._pend_ts = np.asarray(state["pend_ts"], np.int64)
+        self._pend_xy = np.asarray(state["pend_xy"], np.float64)
+        self._pend_oid = np.asarray(state["pend_oid"])
+
+
+def wire_panes(chunks, wire_format: WireFormat, slide_ms: int,
+               start_ms: int):
+    """Generator form of ``WirePaneAssembler`` (see its docstring):
+    chunks in, every completed pane out, final partial pane flushed at
+    end of stream."""
+    asm = WirePaneAssembler(wire_format, slide_ms, start_ms)
+    for ch in chunks:
+        yield from asm.feed(ch)
+    yield from asm.flush()

@@ -740,6 +740,135 @@ def test_kill_and_resume_replays_no_gap_no_dup(monkeypatch):
         b.close()
 
 
+def test_full_wire_pipeline_kill_and_resume(monkeypatch):
+    """THE round-5 resume story end to end over a real socket: Kafka
+    CSV records → WireKafkaSource (checkpointed offsets) →
+    WirePaneAssembler (checkpointed open-pane buffer) →
+    run_wire_panes (checkpointed digest ring). Killed between two
+    windows and restored from the three snapshots, the pipeline's
+    remaining windows equal an uninterrupted run's exactly.
+
+    Checkpoint alignment note: snapshots are taken between yielded
+    windows, i.e. at pane boundaries; the stream's ts deltas stay under
+    one slide so a single record never completes more than one pane
+    (multi-pane bursts must drain before snapshotting — the barrier
+    alignment any checkpointing runtime imposes)."""
+    _no_libs(monkeypatch)
+    from spatialflink_tpu.checkpoint import (
+        kafka_source_state,
+        load_checkpoint,
+        operator_state,
+        restore_kafka_source_offsets,
+        restore_operator,
+        restore_wire_pane_assembler,
+        save_checkpoint,
+        wire_pane_assembler_state,
+    )
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.models.objects import Point
+    from spatialflink_tpu.operators import (
+        PointPointKNNQuery,
+        QueryConfiguration,
+        QueryType,
+    )
+    from spatialflink_tpu.streams.kafka import WireKafkaSource
+    from spatialflink_tpu.streams.wire import WireFormat, WirePaneAssembler
+
+    grid = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+    wf = WireFormat.for_grid(grid)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=4,
+                              slide_step=2)
+    slide_ms = conf.slide_step_ms
+    q, radius, k, nseg = Point(x=5.0, y=5.0), 2.0, 5, 32
+
+    rng = np.random.default_rng(77)
+    n = 1_200
+    ts = np.cumsum(rng.integers(1, slide_ms // 2, n)).astype(np.int64)
+    xy = np.stack([rng.uniform(0, 10, n), rng.uniform(0, 10, n)], axis=1)
+    xyf = wf.dequantize_np(wf.quantize(xy))  # the coords on the wire
+    oid = rng.integers(0, nseg, n).astype(np.int64)
+
+    b = FakeBroker()
+    try:
+        bs = f"127.0.0.1:{b.port}"
+        client = kw.KafkaWireClient(bs)
+        # float() wrap: numpy>=2 reprs f32 scalars as "np.float32(...)"
+        # (the CLAUDE.md f-string gotcha — this killed the parser once)
+        client.produce("gps", 0, [
+            (f"{ts[i]},{float(xyf[i, 0])!r},{float(xyf[i, 1])!r},"
+             f"{oid[i]}".encode(), None, int(ts[i]))
+            for i in range(n)
+        ])
+        client.close()
+
+        def parse(line):
+            t, x, y, o = line.split(",")
+            return int(t), float(x), float(y), int(o)
+
+        def windows(src, asm, op):
+            def panes():
+                for t, x, y, o in iter(src):
+                    for p in asm.feed({"ts": [t], "x": [x], "y": [y],
+                                       "oid": [o]}):
+                        yield p
+
+            yield from op.run_wire_panes(
+                panes(), q, radius, k, nseg, wf, start_ms=0,
+                flush_at_end=False,
+            )
+
+        def collect(gen, count):
+            return [
+                (s, e, list(map(int, oo)), [round(float(d), 9) for d in dd])
+                for s, e, oo, dd, nv in itertools.islice(gen, count)
+            ]
+
+        total = int(ts[-1] // slide_ms) - 2  # full panes only
+
+        src0 = WireKafkaSource("gps", bs, parser=parse)
+        asm0 = WirePaneAssembler(wf, slide_ms, start_ms=0)
+        baseline = collect(
+            windows(src0, asm0, PointPointKNNQuery(conf, grid)), total
+        )
+        src0.close()
+
+        cut = total // 3
+        src1 = WireKafkaSource("gps", bs, parser=parse)
+        asm1 = WirePaneAssembler(wf, slide_ms, start_ms=0)
+        op1 = PointPointKNNQuery(conf, grid)
+        part1 = collect(windows(src1, asm1, op1), cut)
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            path = f"{d}/pipeline.ckpt"
+            save_checkpoint(
+                path,
+                source=kafka_source_state(src1),
+                panes=wire_pane_assembler_state(asm1),
+                op=operator_state(op1),
+            )
+            src1.close()  # kill
+            del asm1, op1
+
+            snap = load_checkpoint(path)
+            src2 = WireKafkaSource(
+                "gps", bs, parser=parse,
+                start_offsets=restore_kafka_source_offsets(
+                    snap["source"], "gps"),
+            )
+            asm2 = WirePaneAssembler(wf, slide_ms, start_ms=0)
+            restore_wire_pane_assembler(asm2, snap["panes"])
+            op2 = PointPointKNNQuery(conf, grid)
+            restore_operator(op2, snap["op"])
+        part2 = collect(windows(src2, asm2, op2), total - cut)
+        src2.close()
+
+        assert part1 + part2 == baseline
+        assert part1 and part2
+        assert sum(len(w[2]) for w in baseline) > 0
+    finally:
+        b.close()
+
+
 def test_kafka_available_via_builtin(monkeypatch):
     _no_libs(monkeypatch)
     from spatialflink_tpu.streams.kafka import _import_kafka, kafka_available

@@ -303,3 +303,25 @@ def test_run_wire_panes_rejects_bad_input():
             [np.zeros((3, 100), np.float32)], Point(x=5.0, y=5.0),
             2.0, 5, NSEG, WF,
         ))
+
+
+def test_wire_pane_assembler_restore_rejects_mismatched_config():
+    """A checkpoint from one (slide, wire-format) must not restore into
+    another — pane boundaries/quantization would silently shift (r5
+    code review)."""
+    from spatialflink_tpu.streams.wire import WireFormat, WirePaneAssembler
+
+    asm = WirePaneAssembler(WF, 2_000, start_ms=0)
+    asm.feed({"ts": np.asarray([100], np.int64), "x": np.asarray([1.0]),
+              "y": np.asarray([1.0]), "oid": np.asarray([0])})
+    snap = asm.state()
+    other = WirePaneAssembler(WF, 1_000, start_ms=0)
+    with pytest.raises(ValueError, match="slide_ms"):
+        other.restore(snap)
+    wf2 = WireFormat(0.0, 20.0, 0.0, 20.0)
+    other2 = WirePaneAssembler(wf2, 2_000, start_ms=0)
+    with pytest.raises(ValueError, match="wire format"):
+        other2.restore(snap)
+    ok = WirePaneAssembler(WF, 2_000, start_ms=0)
+    ok.restore(snap)
+    assert ok.state()["cur"] == snap["cur"]
