@@ -120,7 +120,9 @@ def _record_last_good(record: dict) -> None:
     import os
     import subprocess
 
-    if not record.get("value"):
+    if not record.get("value") or record.get("smoke"):
+        # Toy-size smoke captures (SFT_BENCH_SMOKE contract runs) must
+        # never shadow a real chip number in the last-good store.
         return
     sha = None
     try:
@@ -233,6 +235,7 @@ def _supervise() -> None:
 
 
 def main() -> None:
+    global WINDOW, SLIDE, N_WINDOWS, NUM_SEGMENTS, RADIUS, CAND
     import os as _os
     import threading
 
@@ -290,6 +293,26 @@ def main() -> None:
 
     dev = jax.devices()[0]
     _init_ok.set()  # device reachable — disarm the watchdog
+
+    smoke = bool(_os.environ.get("SFT_BENCH_SMOKE"))
+    if smoke:
+        # Contract-test preset (tests/test_bench_contract.py): the SAME
+        # program at toy sizes — window stays 2× slide, density/radius
+        # chosen so every window still fills its top-50 — runnable on
+        # XLA:CPU in seconds. Never persisted to the last-good store.
+        WINDOW, SLIDE, N_WINDOWS = 4_096, 2_048, 8
+        NUM_SEGMENTS, RADIUS, CAND = 512, 0.5, 256
+
+    from spatialflink_tpu.telemetry import instrument_jit, telemetry
+
+    # Runtime telemetry rides the measured run: recompile detection on the
+    # jitted steps, host→device bytes at the staging device_puts,
+    # device→host bytes + true-sync timing at the fetches the loops
+    # already do (zero extra round trips), window latency from the
+    # latency-probe spans. Summary lands in the JSON line's "telemetry"
+    # block; SFT_TRACE_PATH additionally captures a Chrome-trace file.
+    telemetry.enable(trace_path=_os.environ.get("SFT_TRACE_PATH"))
+
     grid = UniformGrid(**BEIJING_GRID_ARGS)
     wf = WireFormat.for_grid(grid)
     q = np.asarray(QUERY_POINT, np.float32)
@@ -307,14 +330,17 @@ def main() -> None:
     oid16 = (rng.integers(0, NUM_SEGMENTS, total)).astype(np.int16)
     wire = np.concatenate([xyq, oid16.view(np.uint16)[:, None]], axis=1)
 
-    step = build_headline_step(jnp, wf)
-    jstep = jax.jit(step)
+    step = build_headline_step(jnp, wf, slide=SLIDE, nseg=NUM_SEGMENTS,
+                               radius=RADIUS, cand=CAND)
+    jstep = instrument_jit(jax.jit(step), name="headline_step")
     # Throughput loops donate the carried digest buffers: without
     # donation every dispatch materializes fresh (nseg,) seg/rep outputs
     # and the runtime schedules carry copies (~230 ms per 100 steps in
     # the round-3 profiler trace, BASELINE.md). Donated inputs are dead
     # after the call, so resets re-copy seg0/rep0 device-side.
-    jstep_d = jax.jit(step, donate_argnums=(0, 1))
+    jstep_d = instrument_jit(
+        jax.jit(step, donate_argnums=(0, 1)), name="headline_step_donated"
+    )
     jcopy = jax.jit(lambda a: a.copy())
     q_d = jax.device_put(jnp.asarray(q), dev)
     big = np.float32(np.finfo(np.float32).max)
@@ -327,9 +353,9 @@ def main() -> None:
 
     def slide_wire(i):
         # plane-major (3, SLIDE) — see build_headline_step's layout note
-        return jax.device_put(
-            np.ascontiguousarray(wire[i * SLIDE:(i + 1) * SLIDE].T), dev
-        )
+        host = np.ascontiguousarray(wire[i * SLIDE:(i + 1) * SLIDE].T)
+        telemetry.account_h2d(host.nbytes)
+        return jax.device_put(host, dev)
 
     # Warm-up (compile) + slide-0 digest (its ingest precedes window 0).
     seg0, rep0, warm = jstep(empty_seg, empty_rep, slide_wire(0), q_d)
@@ -350,13 +376,18 @@ def main() -> None:
         try:
             from spatialflink_tpu.ops.wire_knn import digests_agree
 
-            pstep = build_headline_step(jnp, wf, pallas=True)
-            jp = jax.jit(pstep)
+            pstep = build_headline_step(jnp, wf, slide=SLIDE,
+                                        nseg=NUM_SEGMENTS, radius=RADIUS,
+                                        cand=CAND, pallas=True)
+            jp = instrument_jit(jax.jit(pstep), name="headline_step_pallas")
             s_p, r_p, res_p = jp(empty_seg, empty_rep, slide_wire(0), q_d)
             if digests_agree(s_p, r_p, seg0, rep0):
                 step = pstep
                 jstep = jp
-                jstep_d = jax.jit(pstep, donate_argnums=(0, 1))
+                jstep_d = instrument_jit(
+                    jax.jit(pstep, donate_argnums=(0, 1)),
+                    name="headline_step_pallas_donated",
+                )
                 seg0, rep0 = s_p, r_p  # slide-0 digest from the same step
                 step_kind = "pallas"
         except Exception as e:  # pragma: no cover - lowering failure
@@ -394,7 +425,7 @@ def main() -> None:
                 staged.append(slide_wire(w + 3))
             sp, rp, res = jstep_d(sp, rp, staged.pop(0), q_d)
             fired.append(res.num_valid)
-        results = [int(v) for v in jax.device_get(fired)]
+        results = [int(v) for v in telemetry.fetch(fired)]
         return time.perf_counter() - t0, results
 
     with trace_ctx:
@@ -412,9 +443,16 @@ def main() -> None:
         wire_s = slide_wire(w + 1)
         jax.device_get(wire_s[:1])  # staged before window close
         t0 = time.perf_counter()
-        sp, rp, res = jstep(sp, rp, wire_s, q_d)
-        int(res.num_valid)
-        latencies.append(time.perf_counter() - t0)
+        # window.* span → FixedBucketLatency → telemetry p50/p95. The
+        # timed region holds ONLY dispatch + the true-sync device_get
+        # (the probe's own fetch); all telemetry work — d2h accounting,
+        # trace emits, the span-exit write — happens after the clock
+        # stops, so lock/json/disk time never lands in the headline p50.
+        with telemetry.span("window.headline", window=w):
+            sp, rp, res = jstep(sp, rp, wire_s, q_d)
+            nv = jax.device_get(res.num_valid)
+            latencies.append(time.perf_counter() - t0)
+            telemetry.account_d2h(np.asarray(nv).nbytes)
 
     # ---- Device-resident throughput: ingest off the critical path. ----
     # Slides 1..N stay staged in HBM (60 MB of wire records); one
@@ -424,11 +462,11 @@ def main() -> None:
     # carried digest (a wrap-around continuous stream); one fetch at the
     # end is the only sync. This is the silicon number comparable to the
     # measured XLA:CPU in-RAM baseline.
-    wire_all = jax.device_put(
-        np.ascontiguousarray(
-            wire[SLIDE:].reshape(N_WINDOWS, SLIDE, 3).transpose(0, 2, 1)
-        ), dev,
+    wire_all_host = np.ascontiguousarray(
+        wire[SLIDE:].reshape(N_WINDOWS, SLIDE, 3).transpose(0, 2, 1)
     )
+    telemetry.account_h2d(wire_all_host.nbytes)
+    wire_all = jax.device_put(wire_all_host, dev)
 
     def resident_pass(seg_prev, rep_prev, wire_r):
         def body(carry, wire_s):
@@ -437,7 +475,9 @@ def main() -> None:
         carry, outs = jax.lax.scan(body, (seg_prev, rep_prev), wire_r)
         return carry[0], carry[1], outs
 
-    jresident = jax.jit(resident_pass, donate_argnums=(0, 1))
+    jresident = instrument_jit(
+        jax.jit(resident_pass, donate_argnums=(0, 1)), name="resident_pass"
+    )
 
     # Compile + force staging, then calibrate the pass count so a timed
     # run spans ~2 s (amortizes the final fetch's tunnel round trip).
@@ -457,7 +497,7 @@ def main() -> None:
         for _ in range(passes):
             sp, rp, outs = jresident(sp, rp, wire_all)
             handles.append(outs)
-        all_out = jax.device_get(handles)  # the only true sync
+        all_out = telemetry.fetch(handles)  # the only true sync
         return time.perf_counter() - t0, all_out
 
     res_runs = [resident_run() for _ in range(5)]
@@ -494,7 +534,14 @@ def main() -> None:
         "device_resident_points_per_sec": round(resident_pps, 1),
         "device_resident_passes": passes,
         "device_resident_vs_baseline": round(resident_pps / BASELINE_EPS, 2),
+        # Runtime-telemetry summary (telemetry.py): XLA compile count from
+        # the recompile detector, device-boundary bytes both ways, window
+        # latency p50/p95 from the probe spans, watermark gauges (0 here —
+        # the bench's synthetic stream is in order by construction).
+        "telemetry": telemetry.summary(),
     }
+    if smoke:
+        out["smoke"] = True
     # Measured CPU-backend throughput of the same fused program on this
     # host (bench_suite.py --cpu-baseline) — the measured counterpart to
     # the reference's configured 20k EPS target.

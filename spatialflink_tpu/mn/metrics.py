@@ -10,7 +10,36 @@ from __future__ import annotations
 
 import bisect
 import math
+import numbers
+import threading
 from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def json_safe(value):
+    """Builtin-type mirror of a metrics/telemetry value: numpy scalars →
+    ``int``/``float``, arrays → lists, containers recursed. Every snapshot
+    crosses this at its boundary so ``json.dumps(snapshot)`` can never
+    raise (the ``np.float32`` f-string/serialization bug has shipped twice)
+    and f-strings format cleanly under numpy ≥2."""
+    if isinstance(value, dict):
+        return {k: json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_safe(v) for v in value]
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, np.bool_):  # not registered with numbers on np≥2
+        return bool(value)
+    if isinstance(value, numbers.Integral):  # np.int32/64, …
+        return int(value)
+    if isinstance(value, numbers.Real):  # np.float32/64, …
+        return float(value)
+    if isinstance(value, np.generic):  # any other numpy scalar
+        return value.item()
+    return value
 
 # NES buckets in ms — upper bounds ("le" semantics), FixedBucketLatency.java:15-16.
 BUCKETS_MS = [0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000, 2000, 5000,
@@ -41,26 +70,42 @@ class MetricNames:
 
 
 class MetricRegistry:
-    """Counters + gauges, the host-side MetricGroup analog."""
+    """Counters + gauges, the host-side MetricGroup analog.
+
+    Thread-safe: operator threads ``inc`` while the NESFileReporter timer
+    thread snapshots — increments and copies share one lock (a bare
+    ``dict(registry.counters)`` mid-resize raised RuntimeError and could
+    tear read-modify-write increments)."""
 
     def __init__(self):
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, Callable[[], float]] = {}
+        self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1):
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def counter(self, name: str) -> int:
-        return self.counters.get(name, 0)
+        with self._lock:
+            return self.counters.get(name, 0)
 
     def gauge(self, name: str, fn: Callable[[], float]):
-        self.gauges[name] = fn
+        with self._lock:
+            self.gauges[name] = fn
+
+    def snapshot_counters(self) -> Dict[str, int]:
+        """Consistent counter copy for reporter threads."""
+        with self._lock:
+            return dict(self.counters)
 
     def snapshot(self) -> Dict[str, float]:
-        out: Dict[str, float] = dict(self.counters)
-        for name, fn in self.gauges.items():
+        out: Dict[str, float] = self.snapshot_counters()
+        with self._lock:
+            gauges = list(self.gauges.items())
+        for name, fn in gauges:
             out[name] = fn()
-        return out
+        return json_safe(out)
 
 
 class FixedBucketLatency:

@@ -53,7 +53,9 @@ class NESFileReporter:
         interval = max(now - self._last_time, 1e-9)
         self._last_time = now
 
-        counters = dict(self.registry.counters)
+        # Locked copy: the timer thread must not iterate the dict while
+        # operator threads mutate it (mn/metrics.py:MetricRegistry).
+        counters = self.registry.snapshot_counters()
         # First report counts everything since start (reference initializes
         # last to the current value on first sight, yielding 0 — we prefer
         # the informative first delta; both converge immediately after).
@@ -82,6 +84,20 @@ class NESFileReporter:
             line += (
                 f" dist_comp_total={opcounters.dist_computations}"
                 f" candidate_lanes_total={opcounters.candidate_lanes}"
+            )
+        # Telemetry columns (telemetry.py) append while the runtime
+        # telemetry layer is enabled: watermark lag + late drops from the
+        # window assemblers, compile count from the recompile detector,
+        # device-boundary bytes from the operator shipping/fetch hooks.
+        from spatialflink_tpu.telemetry import telemetry
+
+        if telemetry.enabled:
+            line += (
+                f" watermark_lag_ms_max={telemetry.max_watermark_lag_ms}"
+                f" late_dropped_total={telemetry.late_drops}"
+                f" compiles_total={telemetry.compile_count}"
+                f" h2d_bytes_total={telemetry.h2d_bytes}"
+                f" d2h_bytes_total={telemetry.d2h_bytes}"
             )
         with open(self.stats_path, "a") as f:
             f.write(line + "\n")

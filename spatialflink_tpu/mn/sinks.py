@@ -17,6 +17,15 @@ class _CountingLatencySinkBase:
                  histogram: Optional[FixedBucketLatency] = None):
         self.registry = registry
         self.histogram = histogram or FixedBucketLatency(registry)
+        # Sink-owned registries carry the runtime-telemetry gauges
+        # (watermark lag, late drops, compiles, device-boundary bytes) so
+        # registry.snapshot() gains the columns. Registered
+        # unconditionally: the gauges read live singleton state, so
+        # telemetry enabled AFTER the pipeline is built still reports
+        # (zeros while disabled).
+        from spatialflink_tpu.telemetry import telemetry
+
+        telemetry.register_metrics(registry)
 
     def _account(self, rendered: str, ingest_ns: Optional[int]):
         self.registry.inc(MetricNames.SINK_OUT)

@@ -30,6 +30,7 @@ from spatialflink_tpu.streams.windows import (
     WindowAssembler,
     WindowBatch,
 )
+from spatialflink_tpu.telemetry import instrument_jit, telemetry
 from spatialflink_tpu.utils.interning import Interner
 
 
@@ -149,12 +150,15 @@ class SpatialOperator:
     def device_q(self, coords, dtype):
         """Device-ready coordinates (any (..., 2) array-like): origin-
         centered before sub-f64 casts. The one centering entry point —
-        device_xy/device_verts are shape-documenting aliases."""
+        device_xy/device_verts are shape-documenting aliases. Telemetry's
+        host→device byte accounting hooks here (the host array's nbytes,
+        read BEFORE the ship — no extra device traffic)."""
         import jax.numpy as jnp
 
-        return jnp.asarray(
-            center_coords(self.grid, np.asarray(coords, np.float64), dtype)
-        )
+        host = center_coords(self.grid, np.asarray(coords, np.float64), dtype)
+        if telemetry.enabled:
+            telemetry.account_h2d(host.nbytes)
+        return jnp.asarray(host)
 
     def device_xy(self, batch: PointBatch, dtype):
         """Device-ready point-batch coordinates."""
@@ -260,6 +264,24 @@ def check_oid_range(oid, num_segments: int) -> None:
         )
 
 
+def ship(*arrays):
+    """``jnp.asarray`` each host array with host→device byte accounting.
+
+    THE ship entry point for telemetry: tallies are taken here — at the
+    conversion that actually crosses the tunnel — never inside batch
+    builders, so ``bytes_h2d`` counts exactly the lanes a path ships
+    (``None`` lanes pass through unconverted and uncounted). Reads host
+    ``nbytes`` before the transfer — no extra device traffic.
+    """
+    import jax.numpy as jnp
+
+    if telemetry.enabled:
+        telemetry.account_h2d(
+            sum(np.asarray(a).nbytes for a in arrays if a is not None)
+        )
+    return tuple(None if a is None else jnp.asarray(a) for a in arrays)
+
+
 def device_point_args(grid: UniformGrid, xy64: np.ndarray, oid, dtype):
     """One SoA point-slice → device-ready padded (xy, valid, cell, oid).
 
@@ -273,6 +295,10 @@ def device_point_args(grid: UniformGrid, xy64: np.ndarray, oid, dtype):
     n = len(xy64)
     b = next_bucket(n)
     cell = grid.assign_cells_np(xy64)
+    # Host-side padding only — no byte accounting here: callers ship
+    # different subsets of these lanes (run_soa drops oid, the pane digest
+    # path replaces valid/cell), so h2d tallies live at the actual
+    # jnp.asarray ship sites (base.ship) to stay truthful.
     return (
         pad_to_bucket(center_coords(grid, xy64, dtype), b),
         pad_to_bucket(np.ones(n, bool), b, fill=False),
@@ -310,8 +336,15 @@ def soa_point_batches(grid: UniformGrid, chunks, conf: QueryConfiguration,
 
 @functools.lru_cache(maxsize=None)
 def jitted(fn: Callable, *static: str):
-    """Module-level jit cache so every operator instance reuses programs."""
-    return jax.jit(fn, static_argnames=static) if static else jax.jit(fn)
+    """Module-level jit cache so every operator instance reuses programs.
+
+    Wrapped with the telemetry recompile detector (telemetry.py): each
+    distinct abstract-shape signature entering a kernel is one XLA compile
+    (~1-2 s + a tunnel round trip here), so bucket-size churn surfaces as
+    recorded compile events / a RecompileWarning instead of silent
+    slowness. Free when telemetry is disabled (one attribute check)."""
+    jfn = jax.jit(fn, static_argnames=static) if static else jax.jit(fn)
+    return instrument_jit(jfn, name=getattr(fn, "__name__", str(fn)))
 
 
 def window_program(mesh, kernel, data_idx, n_args, topk=False, reduce=False,
@@ -327,8 +360,13 @@ def window_program(mesh, kernel, data_idx, n_args, topk=False, reduce=False,
     if mesh is not None:
         from spatialflink_tpu.parallel.sharded import sharded_window_kernel
 
-        return sharded_window_kernel(
+        prog = sharded_window_kernel(
             mesh, kernel, data_idx, n_args, topk=topk, reduce=reduce,
             **statics,
+        )
+        # Mesh programs jit inside sharded.py; track their signatures under
+        # a distinct label so recompiles stay visible on this path too.
+        return instrument_jit(
+            prog, name=f"sharded:{getattr(kernel, '__name__', kernel)}"
         )
     return functools.partial(jitted(kernel, *sorted(statics)), **statics)

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
-from spatialflink_tpu.operators.base import SpatialOperator, jitted
+from spatialflink_tpu.operators.base import SpatialOperator, jitted, ship
+from spatialflink_tpu.telemetry import telemetry
 from spatialflink_tpu.ops.join import (
     cross_join_kernel,
     geometry_geometry_join_kernel,
@@ -259,48 +260,54 @@ class PointPointJoinQuery(SpatialOperator):
             if not left_ev or not right_ev:
                 yield JoinWindowResult(win.start, win.end, [], 0, len(win.events))
                 continue
-            lb = self.point_batch(left_ev)
-            rb = self.point_batch(right_ev)
-            if opcounters.enabled:
-                if naive:
-                    cand = len(left_ev) * len(right_ev)
-                else:
-                    cand = count_join_candidates(
-                        self.grid, lb.cell, len(left_ev), rb.cell,
-                        len(right_ev), self.grid.candidate_layers(radius),
-                    )
-                opcounters.record_window(len(win.events), cand, cand)
-            if naive:
-                res = ck(
-                    self.device_xy(lb, dtype), jnp.asarray(lb.valid),
-                    self.device_xy(rb, dtype), jnp.asarray(rb.valid),
-                    self._filter_radius(radius),
-                )
-                pm = np.asarray(res.pair_mask)
-                ri = np.asarray(res.right_index)
-                dd = np.asarray(res.dist)
-                pairs = []
-                for i in np.nonzero(pm.any(axis=1))[0]:
-                    for s in np.nonzero(pm[i])[0]:
-                        pairs.append(
-                            (left_ev[i], right_ev[int(ri[i, s])], float(dd[i, s]))
+            with telemetry.span(
+                "window.join", start=win.start, events=len(win.events)
+            ):
+                lb = self.point_batch(left_ev)
+                rb = self.point_batch(right_ev)
+                if opcounters.enabled:
+                    if naive:
+                        cand = len(left_ev) * len(right_ev)
+                    else:
+                        cand = count_join_candidates(
+                            self.grid, lb.cell, len(left_ev), rb.cell,
+                            len(right_ev), self.grid.candidate_layers(radius),
                         )
-                overflow = int(res.overflow)
-            else:
-                # Device-compacted pairs with the persistent-budget retry
-                # contract (_compact_block): a window whose match count
-                # exceeds the budget retries once with a doubled
-                # power-of-two budget that persists across windows.
-                li, ri, dd, overflow = self._compact_block(
-                    lb, rb, radius, offsets, dtype, mesh
+                    opcounters.record_window(len(win.events), cand, cand)
+                if naive:
+                    lv_d, rv_d = ship(lb.valid, rb.valid)
+                    res = ck(
+                        self.device_xy(lb, dtype), lv_d,
+                        self.device_xy(rb, dtype), rv_d,
+                        self._filter_radius(radius),
+                    )
+                    pm, ri, dd = telemetry.fetch(
+                        (res.pair_mask, res.right_index, res.dist)
+                    )
+                    pairs = []
+                    for i in np.nonzero(pm.any(axis=1))[0]:
+                        for s in np.nonzero(pm[i])[0]:
+                            pairs.append(
+                                (left_ev[i], right_ev[int(ri[i, s])],
+                                 float(dd[i, s]))
+                            )
+                    overflow = int(res.overflow)
+                else:
+                    # Device-compacted pairs with the persistent-budget retry
+                    # contract (_compact_block): a window whose match count
+                    # exceeds the budget retries once with a doubled
+                    # power-of-two budget that persists across windows.
+                    li, ri, dd, overflow = self._compact_block(
+                        lb, rb, radius, offsets, dtype, mesh
+                    )
+                    pairs = [
+                        (left_ev[int(a)], right_ev[int(b)], float(d))
+                        for a, b, d in zip(li, ri, dd)
+                    ]
+                out = JoinWindowResult(
+                    win.start, win.end, pairs, overflow, len(win.events)
                 )
-                pairs = [
-                    (left_ev[int(a)], right_ev[int(b)], float(d))
-                    for a, b, d in zip(li, ri, dd)
-                ]
-            yield JoinWindowResult(
-                win.start, win.end, pairs, overflow, len(win.events)
-            )
+            yield out
 
 
     def _compact_block(self, lb, rb, radius, offsets, dtype, mesh):
@@ -489,11 +496,15 @@ class PointPointJoinQuery(SpatialOperator):
                     int(rvalid.sum()), layers,
                 )
                 opcounters.record_candidates(cand, cand)
+            # Ship once, outside the budget-retry loop (lanes are reused by
+            # every retry; counted once in bytes_h2d).
+            lxy_d, lvalid_d, lcell_d, rxy_d, rvalid_d, rcell_d = ship(
+                lxy, lvalid, lcell, rxy, rvalid, rcell
+            )
             while True:
                 fn = kernel_for(budget)
                 res = fn(
-                    jnp.asarray(lxy), jnp.asarray(lvalid), jnp.asarray(lcell),
-                    jnp.asarray(rxy), jnp.asarray(rvalid), jnp.asarray(rcell),
+                    lxy_d, lvalid_d, lcell_d, rxy_d, rvalid_d, rcell_d,
                     grid_n=self.grid.n, layers=layers, radius=fr,
                     cap_left=self.cap, cap_right=self.cap, max_pairs=budget,
                 )

@@ -25,6 +25,7 @@ from spatialflink_tpu.operators.base import (
     flags_for_queries,
     jitted,
     pack_query_geometries,
+    ship,
     window_program,
 )
 from spatialflink_tpu.ops.knn import (
@@ -33,6 +34,7 @@ from spatialflink_tpu.ops.knn import (
     knn_polygon_fused,
     knn_polyline_fused,
 )
+from spatialflink_tpu.telemetry import telemetry
 from spatialflink_tpu.utils.padding import next_bucket
 
 
@@ -127,30 +129,52 @@ class _PointStreamKNNQuery(SpatialOperator):
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events)
-            if counters.enabled:
-                cand = count_candidates(flags, batch.cell, len(win.events))
-                counters.record_window(len(win.events), cand, cand)
-            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            kp, kpoly = programs(nseg)
-            args = (
-                self.device_xy(batch, dtype),
-                jnp.asarray(batch.valid),
-                jnp.asarray(batch.cell),
-                flags_d,
-                jnp.asarray(batch.oid),
-            )
-            if self.query_kind == "point":
-                res = kp(*args, q, radius)
-            else:
-                res = kpoly(*args, qv, qe, radius)
-            yield self._decode(win, res, k)
+            # Telemetry phases per window: assemble (host batch build) →
+            # ship (host→device) → compute (kernel dispatch) → fetch
+            # (device→host decode). The yield stays OUTSIDE the window
+            # span so consumer time never pollutes window latency.
+            with telemetry.span(
+                "window.knn", start=win.start, events=len(win.events)
+            ):
+                with telemetry.span("assemble"):
+                    batch = self.point_batch(win.events)
+                    if counters.enabled:
+                        cand = count_candidates(
+                            flags, batch.cell, len(win.events)
+                        )
+                        counters.record_window(len(win.events), cand, cand)
+                    nseg = next_bucket(
+                        max(self.interner.num_segments, 1), minimum=64
+                    )
+                    kp, kpoly = programs(nseg)
+                with telemetry.span("ship"):
+                    valid_d, cell_d, oid_d = ship(
+                        batch.valid, batch.cell, batch.oid
+                    )
+                    args = (
+                        self.device_xy(batch, dtype),
+                        valid_d,
+                        cell_d,
+                        flags_d,
+                        oid_d,
+                    )
+                with telemetry.span("compute"):
+                    if self.query_kind == "point":
+                        res = kp(*args, q, radius)
+                    else:
+                        res = kpoly(*args, qv, qe, radius)
+                out = self._decode(win, res, k)
+            yield out
 
     def _decode(self, win, res, k) -> KnnWindowResult:
-        nv = int(res.num_valid)
-        segs = np.asarray(res.segment[:nv])
-        dists = np.asarray(res.dist[:nv])
-        idxs = np.asarray(res.index[:nv])
+        # telemetry.fetch is the SAME device_get the bare np.asarray would
+        # do — it replaces the fetch (true sync + d2h byte accounting),
+        # never adds one.
+        with telemetry.span("fetch"):
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists, idxs = telemetry.fetch(
+                (res.segment[:nv], res.dist[:nv], res.index[:nv])
+            )
         neighbors = [
             (self.interner.lookup(int(s)), float(d), win.events[int(i)])
             for s, d, i in zip(segs, dists, idxs)
@@ -277,55 +301,66 @@ class _PointStreamKNNQuery(SpatialOperator):
                 if not evs:
                     panes[ps] = None
                     continue
-                batch = self.point_batch(evs)
-                nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-                in_grid = batch.valid & (batch.cell < self.grid.num_cells)
-                args = (
-                    self.device_xy(batch, dtype),
-                    jnp.asarray(in_grid),
-                    None,  # cell/flags skipped — see comment above
-                    None,
-                    jnp.asarray(batch.oid),
-                )
-                if self.query_kind == "point":
-                    d = digest_fn(*args, q, radius, zero, num_segments=nseg)
-                else:
-                    d = digest_fn(*args, qv, qe, radius, zero,
-                                  num_segments=nseg)
-                panes[ps] = (nseg, d.seg_min, d.rep, evs)
+                with telemetry.span("pane.digest", pane=ps, events=len(evs)):
+                    batch = self.point_batch(evs)
+                    nseg = next_bucket(
+                        max(self.interner.num_segments, 1), minimum=64
+                    )
+                    in_grid = batch.valid & (batch.cell < self.grid.num_cells)
+                    in_grid_d, oid_d = ship(in_grid, batch.oid)
+                    args = (
+                        self.device_xy(batch, dtype),
+                        in_grid_d,
+                        None,  # cell/flags skipped — see comment above
+                        None,
+                        oid_d,
+                    )
+                    if self.query_kind == "point":
+                        d = digest_fn(*args, q, radius, zero,
+                                      num_segments=nseg)
+                    else:
+                        d = digest_fn(*args, qv, qe, radius, zero,
+                                      num_segments=nseg)
+                    panes[ps] = (nseg, d.seg_min, d.rep, evs)
             for ps in [p for p in panes if p < win.start]:
                 del panes[ps]
 
-            nseg = max(p[0] for p in panes.values() if p is not None)
-            for ps in starts:
-                if panes[ps] is not None and panes[ps][0] < nseg:
-                    panes[ps] = grow(panes[ps], nseg)
-            live = [panes[ps] for ps in starts]
-            emt = empty_digest(nseg)
-            sms = tuple(emt[0] if p is None else p[1] for p in live)
-            rps = tuple(emt[1] if p is None else p[2] for p in live)
-            bases, acc = [], 0
-            for p in live:
-                bases.append(acc)
-                acc += 0 if p is None else len(p[3])
-            res = merge(sms, rps, np.asarray(bases, np.int32), k=k)
+            with telemetry.span("window.knn_panes", start=win.start,
+                                events=len(win.events)):
+                nseg = max(p[0] for p in panes.values() if p is not None)
+                for ps in starts:
+                    if panes[ps] is not None and panes[ps][0] < nseg:
+                        panes[ps] = grow(panes[ps], nseg)
+                live = [panes[ps] for ps in starts]
+                emt = empty_digest(nseg)
+                sms = tuple(emt[0] if p is None else p[1] for p in live)
+                rps = tuple(emt[1] if p is None else p[2] for p in live)
+                bases, acc = [], 0
+                for p in live:
+                    bases.append(acc)
+                    acc += 0 if p is None else len(p[3])
+                res = merge(sms, rps, np.asarray(bases, np.int32), k=k)
 
-            spans = [(b, p[3]) for b, p in zip(bases, live) if p is not None]
-            nv = int(res.num_valid)
-            segs = np.asarray(res.segment[:nv])  # bulk fetches, no per-
-            dists = np.asarray(res.dist[:nv])  # element tunnel round trips
-            idxs = np.asarray(res.index[:nv])
-            neighbors = []
-            for s, d, gi in zip(segs, dists, idxs):
-                ev = None
-                for base, evs in spans:
-                    if base <= gi < base + len(evs):
-                        ev = evs[gi - base]
-                        break
-                neighbors.append(
-                    (self.interner.lookup(int(s)), float(d), ev)
+                spans = [(b, p[3]) for b, p in zip(bases, live)
+                         if p is not None]
+                nv = int(telemetry.fetch(res.num_valid))
+                segs, dists, idxs = telemetry.fetch(  # bulk fetches, no per-
+                    (res.segment[:nv], res.dist[:nv], res.index[:nv])
+                )  # element tunnel round trips
+                neighbors = []
+                for s, d, gi in zip(segs, dists, idxs):
+                    ev = None
+                    for base, evs in spans:
+                        if base <= gi < base + len(evs):
+                            ev = evs[gi - base]
+                            break
+                    neighbors.append(
+                        (self.interner.lookup(int(s)), float(d), ev)
+                    )
+                out = KnnWindowResult(
+                    win.start, win.end, neighbors, len(win.events)
                 )
-            yield KnnWindowResult(win.start, win.end, neighbors, len(win.events))
+            yield out
 
 
 class PointPointKNNQuery(_PointStreamKNNQuery):
@@ -356,20 +391,22 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
-            check_oid_range(oid[:win.count], num_segments)
-            if counters.enabled:
-                cand = count_candidates(flags, cell, win.count)
-                counters.record_candidates(cand, cand)
-            res = kp(
-                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
-                flags_d, jnp.asarray(oid),
-                q, radius, k=k, num_segments=num_segments,
-            )
-            nv = int(res.num_valid)
-            yield (
-                win.start, win.end,
-                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
-            )
+            with telemetry.span("window.knn_soa", start=win.start,
+                                events=win.count):
+                check_oid_range(oid[:win.count], num_segments)
+                if counters.enabled:
+                    cand = count_candidates(flags, cell, win.count)
+                    counters.record_candidates(cand, cand)
+                xy_d, valid_d, cell_d, oid_d = ship(xy, valid, cell, oid)
+                res = kp(
+                    xy_d, valid_d, cell_d, flags_d, oid_d,
+                    q, radius, k=k, num_segments=num_segments,
+                )
+                nv = int(telemetry.fetch(res.num_valid))
+                segs, dists = telemetry.fetch(
+                    (res.segment[:nv], res.dist[:nv])
+                )
+            yield (win.start, win.end, segs, dists, nv)
 
 
     def run_multi(
@@ -425,12 +462,13 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         for win in self.windows(stream):
             batch = self.point_batch(win.events)
             nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            valid_d, cell_d, oid_d = ship(batch.valid, batch.cell, batch.oid)
             args = (
                 self.device_xy(batch, dtype),
-                jnp.asarray(batch.valid),
-                jnp.asarray(batch.cell),
+                valid_d,
+                cell_d,
                 tables_d,
-                jnp.asarray(batch.oid),
+                oid_d,
                 q_d,
             )
             if mesh is not None:
@@ -443,10 +481,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 res = kernel(
                     *args, radius, k=k, num_segments=nseg, query_block=block,
                 )
-            segs = np.asarray(res.segment)  # (Q, k) bulk fetches
-            dists = np.asarray(res.dist)
-            idxs = np.asarray(res.index)
-            nvs = np.asarray(res.num_valid)
+            segs, dists, idxs, nvs = telemetry.fetch(  # (Q, k) bulk fetches
+                (res.segment, res.dist, res.index, res.num_valid)
+            )
             per_query = []
             for qi in range(nq):
                 nv = int(nvs[qi])
@@ -533,9 +570,11 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                     self.grid, xy64, win.arrays["oid"][lo:hi], dtype
                 )
                 in_grid = valid_p & (cell_p < self.grid.num_cells)
+                # cell_p is used host-side only on this path (the kernel
+                # gets cell=None) — ship exactly the three shipped lanes.
+                xy_d, in_grid_d, oid_d = ship(xy_p, in_grid, oid_p)
                 d = digest(
-                    jnp.asarray(xy_p), jnp.asarray(in_grid),
-                    None, None, jnp.asarray(oid_p),
+                    xy_d, in_grid_d, None, None, oid_d,
                     q, radius, np.int32(0), num_segments=num_segments,
                 )
                 panes[ps] = (d.seg_min, d.rep)
@@ -552,11 +591,9 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             sms = tuple(emt[0] if p is None else p[0] for p in live)
             rps = tuple(emt[1] if p is None else p[1] for p in live)
             res = merge(sms, rps, no_bases, k=k)
-            nv = int(res.num_valid)
-            yield (
-                win.start, win.end,
-                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
-            )
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists = telemetry.fetch((res.segment[:nv], res.dist[:nv]))
+            yield (win.start, win.end, segs, dists, nv)
 
 
     def run_wire_panes(
@@ -667,12 +704,10 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 tuple(s for s, _ in digests),
                 tuple(r for _, r in digests), no_bases, k=k,
             )
-            nv = int(res.num_valid)
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists = telemetry.fetch((res.segment[:nv], res.dist[:nv]))
             w_start = start_ms + (pane_i - ppw + 1) * slide_ms
-            return (
-                w_start, w_start + size,
-                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
-            )
+            return (w_start, w_start + size, segs, dists, nv)
 
         i = pane0 - 1
         for i, wire_p in enumerate(slides, start=pane0):
@@ -690,7 +725,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 wire_p = np.concatenate(
                     [wire_p, np.zeros((3, nb - n), np.uint16)], axis=1
                 )
-            wire_d = jnp.asarray(wire_p)
+            (wire_d,) = ship(wire_p)
             if jstep is None:
                 kind, step = select_wire_digest_step(
                     wire_d, jnp.int32(n), q, scale, origin, r32,
@@ -804,17 +839,11 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                     mesh, knn_geometry_bbox_kernel, (0, 1, 2, 3), 6,
                     topk=True, k=k, num_segments=nseg,
                 )
-                res = ka(
-                    jnp.asarray(
-                        _centered_bbox(self.grid, batch.bbox, dtype,
-                                       pad=False)
-                    ),
-                    jnp.asarray(batch.valid),
-                    jnp.asarray(oflags),
-                    jnp.asarray(batch.oid),
-                    qbb,
-                    radius,
+                bb_d, valid_d, oflags_d, oid_d = ship(
+                    _centered_bbox(self.grid, batch.bbox, dtype, pad=False),
+                    batch.valid, oflags, batch.oid,
                 )
+                res = ka(bb_d, valid_d, oflags_d, oid_d, qbb, radius)
             else:
                 statics = dict(
                     k=k, num_segments=nseg,
@@ -825,24 +854,20 @@ class _GeometryStreamKNNQuery(SpatialOperator):
                     mesh, knn_geometry_query_kernel, (0, 1, 2, 3, 4), 8,
                     topk=True, **statics,
                 )
+                ev_d, valid_d, oflags_d, oid_d = ship(
+                    batch.edge_valid, batch.valid, oflags, batch.oid
+                )
                 res = kg(
                     self.device_verts(batch.verts, dtype),
-                    jnp.asarray(batch.edge_valid),
-                    jnp.asarray(batch.valid),
-                    jnp.asarray(oflags),
-                    jnp.asarray(batch.oid),
-                    qv,
-                    qe,
-                    radius,
+                    ev_d, valid_d, oflags_d, oid_d, qv, qe, radius,
                 )
-            nv = int(res.num_valid)
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists, idxs = telemetry.fetch(  # bulk fetches, no per-
+                (res.segment[:nv], res.dist[:nv], res.index[:nv])
+            )  # element tunnel round trips
             neighbors = [
-                (
-                    self.interner.lookup(int(res.segment[i])),
-                    float(res.dist[i]),
-                    win.events[int(res.index[i])],
-                )
-                for i in range(nv)
+                (self.interner.lookup(int(s)), float(d), win.events[int(i)])
+                for s, d, i in zip(segs, dists, idxs)
             ]
             yield KnnWindowResult(win.start, win.end, neighbors, len(win.events))
 
@@ -903,30 +928,22 @@ class _GeometryStreamKNNQuery(SpatialOperator):
             )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
             if approx:
-                res = ka(
-                    jnp.asarray(
-                        _centered_bbox(self.grid, batch.bbox, dtype,
-                                       pad=False)
-                    ),
-                    jnp.asarray(batch.valid),
-                    jnp.asarray(oflags),
-                    jnp.asarray(batch.oid),
-                    qbb, radius,
+                bb_d, valid_d, oflags_d, oid_d = ship(
+                    _centered_bbox(self.grid, batch.bbox, dtype, pad=False),
+                    batch.valid, oflags, batch.oid,
                 )
+                res = ka(bb_d, valid_d, oflags_d, oid_d, qbb, radius)
             else:
+                ev_d, valid_d, oflags_d, oid_d = ship(
+                    batch.edge_valid, batch.valid, oflags, batch.oid
+                )
                 res = kg(
                     self.device_verts(batch.verts, dtype),
-                    jnp.asarray(batch.edge_valid),
-                    jnp.asarray(batch.valid),
-                    jnp.asarray(oflags),
-                    jnp.asarray(batch.oid),
-                    qv, qe, radius,
+                    ev_d, valid_d, oflags_d, oid_d, qv, qe, radius,
                 )
-            nv = int(res.num_valid)
-            yield (
-                win.start, win.end,
-                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
-            )
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists = telemetry.fetch((res.segment[:nv], res.dist[:nv]))
+            yield (win.start, win.end, segs, dists, nv)
 
 
 class PolygonPointKNNQuery(_GeometryStreamKNNQuery):

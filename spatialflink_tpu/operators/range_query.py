@@ -32,6 +32,7 @@ from spatialflink_tpu.operators.base import (
     jitted,
     pack_query_geometries,
     pack_query_points,
+    ship,
     window_program,
 )
 from spatialflink_tpu.ops.range import (
@@ -40,6 +41,7 @@ from spatialflink_tpu.ops.range import (
     range_polygons_fused,
     range_polylines_fused,
 )
+from spatialflink_tpu.telemetry import telemetry
 
 
 @dataclass
@@ -159,23 +161,40 @@ class _PointStreamRangeQuery(SpatialOperator):
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
         for win in self.windows(stream):
-            batch = self.point_batch(win.events)
-            if counters.enabled:
-                cand = count_candidates(flags, batch.cell, len(win.events))
-                counters.record_window(
-                    len(win.events), cand, cand * len(query_set)
+            # assemble → ship → compute → fetch phase spans (see
+            # knn_query.run); yield outside the window span.
+            with telemetry.span(
+                "window.range", start=win.start, events=len(win.events)
+            ):
+                with telemetry.span("assemble"):
+                    batch = self.point_batch(win.events)
+                    if counters.enabled:
+                        cand = count_candidates(
+                            flags, batch.cell, len(win.events)
+                        )
+                        counters.record_window(
+                            len(win.events), cand, cand * len(query_set)
+                        )
+                with telemetry.span("ship"):
+                    valid_d, cell_d = ship(
+                        batch.valid, batch.cell
+                    )
+                    common = (
+                        self.device_xy(batch, dtype),
+                        valid_d,
+                        cell_d,
+                        flags_d,
+                    )
+                with telemetry.span("compute"):
+                    keep, dist = evaluate(common)
+                with telemetry.span("fetch"):
+                    keep, dist = telemetry.fetch((keep, dist))
+                idx = np.nonzero(keep)[0]
+                objs = [win.events[i] for i in idx]
+                out = RangeResult(
+                    win.start, win.end, objs, dist[idx], len(win.events)
                 )
-            keep, dist = evaluate((
-                self.device_xy(batch, dtype),
-                jnp.asarray(batch.valid),
-                jnp.asarray(batch.cell),
-                flags_d,
-            ))
-            keep = np.asarray(keep)
-            dist = np.asarray(dist)
-            idx = np.nonzero(keep)[0]
-            objs = [win.events[i] for i in idx]
-            yield RangeResult(win.start, win.end, objs, dist[idx], len(win.events))
+            yield out
 
 
     def run_soa(
@@ -208,10 +227,12 @@ class _PointStreamRangeQuery(SpatialOperator):
             if counters.enabled:
                 cand = count_candidates(flags, cell, win.count)
                 counters.record_candidates(cand, cand * len(query_set))
-            keep, dist = evaluate((
-                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
-                flags_d,
-            ))
+            # ship/fetch through telemetry: the oid lane is NOT shipped on
+            # this path, so accounting at the ship site keeps bytes_h2d
+            # honest; the fetch is the same device_get np.asarray would do.
+            xy_d, valid_d, cell_d = ship(xy, valid, cell)
+            keep, dist = evaluate((xy_d, valid_d, cell_d, flags_d))
+            keep, dist = telemetry.fetch((keep, dist))
             n = win.count
             keep = np.asarray(keep)[:n]
             idx = np.nonzero(keep)[0]
@@ -273,9 +294,9 @@ class PointPointRangeQuery(_PointStreamRangeQuery):
             ]
             if new_events:
                 batch = self.point_batch(new_events)
+                valid_d, cell_d = ship(batch.valid, batch.cell)
                 keep, dist = pk(
-                    self.device_xy(batch, dtype), jnp.asarray(batch.valid),
-                    jnp.asarray(batch.cell), flags_d,
+                    self.device_xy(batch, dtype), valid_d, cell_d, flags_d,
                     q, radius, approximate=self.conf.approximate_query,
                 )
                 keep = np.asarray(keep)
@@ -365,22 +386,28 @@ class _GeometryStreamRangeQuery(SpatialOperator):
 
         prefix = flag_prefix_planes(self.grid, flags)
         for win in self.windows(stream):
-            batch = self.geometry_batch(win.events, mesh=mesh)
-            oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
-            keep, dist = gk(
-                self.device_verts(batch.verts, dtype),
-                jnp.asarray(batch.edge_valid),
-                jnp.asarray(batch.valid),
-                jnp.asarray(oflags),
-                qv,
-                qe,
-                radius,
-            )
-            keep = np.asarray(keep)
-            dist = np.asarray(dist)
-            idx = np.nonzero(keep)[0]
-            objs = [win.events[i] for i in idx]
-            yield RangeResult(win.start, win.end, objs, dist[idx], len(win.events))
+            with telemetry.span(
+                "window.range_geometry", start=win.start,
+                events=len(win.events),
+            ):
+                batch = self.geometry_batch(win.events, mesh=mesh)
+                oflags = batch.any_cell_flagged(
+                    self.grid, flags, prefix=prefix
+                )
+                ev_d, valid_d, oflags_d = ship(
+                    batch.edge_valid, batch.valid, oflags
+                )
+                keep, dist = gk(
+                    self.device_verts(batch.verts, dtype),
+                    ev_d, valid_d, oflags_d, qv, qe, radius,
+                )
+                keep, dist = telemetry.fetch((keep, dist))
+                idx = np.nonzero(keep)[0]
+                objs = [win.events[i] for i in idx]
+                out = RangeResult(
+                    win.start, win.end, objs, dist[idx], len(win.events)
+                )
+            yield out
 
     def run_soa(
         self,
@@ -422,14 +449,14 @@ class _GeometryStreamRangeQuery(SpatialOperator):
                 edge_valid_flat=win.edge_valid, dtype=np.float64,
             )
             oflags = batch.any_cell_flagged(self.grid, flags, prefix=prefix)
+            ev_d, valid_d, oflags_d = ship(
+                batch.edge_valid, batch.valid, oflags
+            )
             keep, dist = gk(
                 self.device_verts(batch.verts, dtype),
-                jnp.asarray(batch.edge_valid),
-                jnp.asarray(batch.valid),
-                jnp.asarray(oflags),
-                qv, qe, radius,
+                ev_d, valid_d, oflags_d, qv, qe, radius,
             )
-            keep = np.asarray(keep)
+            keep, dist = telemetry.fetch((keep, dist))
             idx = np.nonzero(keep)[0]
             yield (
                 win.start, win.end, idx, win.oid[idx],

@@ -24,9 +24,11 @@ from spatialflink_tpu.operators.base import (
     flags_for_queries,
     jitted,
     pack_query_geometries,
+    ship,
     window_program,
 )
 from spatialflink_tpu.operators.join_query import _TaggedEvent, merge_by_timestamp
+from spatialflink_tpu.telemetry import telemetry
 from spatialflink_tpu.ops.knn import knn_points_fused
 from spatialflink_tpu.ops.trajectory import (
     traj_cell_spans_kernel,
@@ -129,9 +131,9 @@ class TRangeQuery(SpatialOperator):
             self.grid, chunks, self.conf, dtype
         ):
             check_oid_range(oid[:win.count], num_segments)
-            hits = np.asarray(program(
-                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(oid),
-                qv, qe, num_segments=num_segments,
+            xy_d, valid_d, oid_d = ship(xy, valid, oid)
+            hits = telemetry.fetch(program(
+                xy_d, valid_d, oid_d, qv, qe, num_segments=num_segments,
             ))
             yield (win.start, win.end, np.flatnonzero(hits), win.count)
 
@@ -216,16 +218,14 @@ class TKNNQuery(SpatialOperator):
         for win, xy, valid, cell, oid in soa_point_batches(
             self.grid, chunks, self.conf, dtype
         ):
+            xy_d, valid_d, cell_d, oid_d = ship(xy, valid, cell, oid)
             res = kern(
-                jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
-                flags_d, jnp.asarray(oid), q, radius,
+                xy_d, valid_d, cell_d, flags_d, oid_d, q, radius,
                 k=k, num_segments=num_segments,
             )
-            nv = int(res.num_valid)
-            yield (
-                win.start, win.end,
-                np.asarray(res.segment[:nv]), np.asarray(res.dist[:nv]), nv,
-            )
+            nv = int(telemetry.fetch(res.num_valid))
+            segs, dists = telemetry.fetch((res.segment[:nv], res.dist[:nv]))
+            yield (win.start, win.end, segs, dists, nv)
 
 
 class PointPointTKNNQuery(TKNNQuery):
@@ -440,11 +440,17 @@ class TJoinQuery(SpatialOperator):
             r_loc[:rwin.count] = r_inv
             num_l = int(_nb(max(len(l_uniq), 1), minimum=16))
             num_r = int(_nb(max(len(r_uniq), 1), minimum=16))
+            # Ship once, outside the budget-retry loops: retries reuse the
+            # same (immutable) device buffers instead of re-crossing the
+            # tunnel, and bytes_h2d counts each lane exactly once.
+            lxy_d, lvalid_d, lcell_d, rxy_d, rvalid_d, rcell_d = ship(
+                lxy, lvalid, lcell, rxy, rvalid, rcell
+            )
+            l_loc_d, r_loc_d = ship(l_loc, r_loc)
             while True:
                 fn = kernel_for(budget)
                 res = fn(
-                    jnp.asarray(lxy), jnp.asarray(lvalid), jnp.asarray(lcell),
-                    jnp.asarray(rxy), jnp.asarray(rvalid), jnp.asarray(rcell),
+                    lxy_d, lvalid_d, lcell_d, rxy_d, rvalid_d, rcell_d,
                     grid_n=self.grid.n, layers=layers, radius=radius,
                     cap_left=self.cap, cap_right=self.cap, max_pairs=budget,
                 )
@@ -454,7 +460,7 @@ class TJoinQuery(SpatialOperator):
             while True:
                 tp = dedup(
                     res.left_index, res.right_index, res.dist,
-                    jnp.asarray(l_loc), jnp.asarray(r_loc),
+                    l_loc_d, r_loc_d,
                     num_left=num_l, num_right=num_r,
                     max_tpairs=self._max_tpairs,
                 )
@@ -949,38 +955,45 @@ class TStatsQuery(SpatialOperator):
                 # out-of-order tuples as they arrive (TStatsQuery.java:118).
                 yield self._realtime_update(win, win.events)
                 continue
-            events = sorted(win.events, key=lambda p: (p.obj_id, p.timestamp))
-            batch = PointBatch.from_points(events, interner=self.interner,
-                                           dtype=np.float64)
-            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
-            if mesh is not None:
-                # Sequence-parallel: (oid, ts)-sorted points sharded over
-                # the data axis, shard-boundary pairs recovered by the
-                # ppermute halo (parallel/sharded.py:sharded_traj_stats).
-                from spatialflink_tpu.parallel.sharded import sharded_traj_stats
-
-                sp, tp, cnt, _speed = sharded_traj_stats(
-                    mesh,
-                    self.device_q(batch.xy, dtype),
-                    jnp.asarray(batch.ts),
-                    jnp.asarray(batch.oid), jnp.asarray(batch.valid),
-                    num_segments=nseg,
+            with telemetry.span(
+                "window.tstats", start=win.start, events=len(win.events)
+            ):
+                events = sorted(win.events,
+                                key=lambda p: (p.obj_id, p.timestamp))
+                batch = PointBatch.from_points(events, interner=self.interner,
+                                               dtype=np.float64)
+                nseg = next_bucket(max(self.interner.num_segments, 1),
+                                   minimum=64)
+                ts_d, oid_d, valid_d = ship(
+                    batch.ts, batch.oid, batch.valid
                 )
-                spatial = np.asarray(sp)
-                temporal = np.asarray(tp)
-                count = np.asarray(cnt)
-                yield self._decode_window(win, events, spatial, temporal, count)
-                continue
-            res = kern(
-                self.device_q(batch.xy, dtype),
-                jnp.asarray(batch.ts),
-                jnp.asarray(batch.oid), jnp.asarray(batch.valid),
-                num_segments=nseg,
-            )
-            spatial = np.asarray(res.spatial_length)
-            temporal = np.asarray(res.temporal_length)
-            count = np.asarray(res.count)
-            yield self._decode_window(win, events, spatial, temporal, count)
+                if mesh is not None:
+                    # Sequence-parallel: (oid, ts)-sorted points sharded over
+                    # the data axis, shard-boundary pairs recovered by the
+                    # ppermute halo (parallel/sharded.py:sharded_traj_stats).
+                    from spatialflink_tpu.parallel.sharded import (
+                        sharded_traj_stats,
+                    )
+
+                    sp, tp, cnt, _speed = sharded_traj_stats(
+                        mesh,
+                        self.device_q(batch.xy, dtype),
+                        ts_d, oid_d, valid_d,
+                        num_segments=nseg,
+                    )
+                    spatial, temporal, count = telemetry.fetch((sp, tp, cnt))
+                else:
+                    res = kern(
+                        self.device_q(batch.xy, dtype),
+                        ts_d, oid_d, valid_d,
+                        num_segments=nseg,
+                    )
+                    spatial, temporal, count = telemetry.fetch(
+                        (res.spatial_length, res.temporal_length, res.count)
+                    )
+                out = self._decode_window(win, events, spatial, temporal,
+                                          count)
+            yield out
 
     def _decode_window(self, win, events, spatial, temporal, count) -> TStatsResult:
         stats = {}
@@ -1014,16 +1027,14 @@ class TStatsQuery(SpatialOperator):
                 counters.record_candidates(n - 1, n - 1)
             ts = np.zeros(len(valid), np.int64)
             ts[:n] = np.asarray(win.arrays["ts"], np.int64)
+            xy_d, ts_d, oid_d, valid_d = ship(xy, ts, oid, valid)
             res = kern(
-                jnp.asarray(xy), jnp.asarray(ts), jnp.asarray(oid),
-                jnp.asarray(valid), num_segments=num_segments,
+                xy_d, ts_d, oid_d, valid_d, num_segments=num_segments,
             )
-            yield (
-                win.start, win.end,
-                np.asarray(res.spatial_length),
-                np.asarray(res.temporal_length),
-                np.asarray(res.count),
+            spatial, temporal, count = telemetry.fetch(
+                (res.spatial_length, res.temporal_length, res.count)
             )
+            yield (win.start, win.end, spatial, temporal, count)
 
     def _realtime_update(self, win, events) -> TStatsResult:
         stats = {}

@@ -53,13 +53,17 @@ class KernelCounters:
         return self.points_in / elapsed
 
     def snapshot(self) -> Dict[str, float]:
-        return {
+        from spatialflink_tpu.mn.metrics import json_safe
+
+        # json_safe at the boundary: tallies may arrive as numpy ints and
+        # json.dumps of a snapshot must never raise.
+        return json_safe({
             "windows": self.windows,
             "points_in": self.points_in,
             "candidate_lanes": self.candidate_lanes,
             "dist_computations": self.dist_computations,
             "throughput_eps": round(self.throughput_eps(), 2),
-        }
+        })
 
     def reset(self):
         self.windows = 0

@@ -374,10 +374,8 @@ def tjoin_pane_scan(
 
         return jax.lax.scan(body, carry, (ts, lps, rps))
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover - jax < 0.7
-        from jax.experimental.shard_map import shard_map
+    # Shim handles both the symbol's home and check_rep→check_vma.
+    from spatialflink_tpu.utils.shardmap_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ndev = int(mesh.shape["data"])

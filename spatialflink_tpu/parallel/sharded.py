@@ -26,10 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# Handles both the symbol's home and the check_rep→check_vma rename.
+from spatialflink_tpu.utils.shardmap_compat import shard_map
 
 from spatialflink_tpu.ops.distances import point_point_distance
 from spatialflink_tpu.ops.join import JoinResult, join_kernel
@@ -318,7 +316,10 @@ def sharded_traj_stats(
     from spatialflink_tpu.ops.distances import point_point_distance
 
     def local(xy_l, ts_l, oid_l, valid_l):
-        n_shards = jax.lax.axis_size("data")
+        # The ppermute ring needs a STATIC shard count; read it from the
+        # mesh (lax.axis_size only exists on newer jax releases — same era
+        # as the check_vma rename, see utils/shardmap_compat.py).
+        n_shards = int(mesh.shape["data"])
         # Ring halo: receive the previous shard's last (xy, ts, oid, valid).
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
         prev_xy = jax.lax.ppermute(xy_l[-1], "data", perm)

@@ -20,6 +20,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from spatialflink_tpu.telemetry import telemetry
+
 
 def earliest_window_of(ts_val: int, size: int, slide: int) -> int:
     """Start of the earliest sliding window containing ``ts_val`` — the one
@@ -85,14 +87,16 @@ class _SlidingAssemblerBase:
         """End of stream: fire everything up to the last event."""
         if self._max_ts is None:
             return []
-        return self._fire(self._max_ts + self.size + 1)
+        # record_lag=False: the flush watermark is artificial (max_ts +
+        # size + 1), not a late watermark — it must not pollute the gauge.
+        return self._fire(self._max_ts + self.size + 1, record_lag=False)
 
     def stream(self, chunks):
         for c in chunks:
             yield from self.feed(c)
         yield from self.flush()
 
-    def _fire(self, wm: int):
+    def _fire(self, wm: int, record_lag: bool = True):
         out = []
         if self._next_start is None or self._next_start + self.size > wm:
             return out
@@ -102,12 +106,17 @@ class _SlidingAssemblerBase:
         late = int(np.searchsorted(ts, self._next_start, side="left"))
         if late:
             self.dropped_late += late
+            telemetry.record_late_drop(late)
         while self._next_start + self.size <= wm:
             s, e = self._next_start, self._next_start + self.size
             lo = int(np.searchsorted(ts, s, side="left"))
             hi = int(np.searchsorted(ts, e, side="left"))
             if hi > lo:
                 out.append(self._window(s, e, lo, hi))
+                if record_lag:
+                    # Event-time ms between window end and the watermark
+                    # that fired it.
+                    telemetry.record_watermark_lag(wm - e)
                 self._next_start += self.slide
             elif lo < len(ts):
                 # Empty window: fast-forward to the earliest window holding

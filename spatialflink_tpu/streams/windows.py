@@ -22,6 +22,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Iterable, Iterator, List, Optional, Tuple, TypeVar
 
+from spatialflink_tpu.telemetry import telemetry
+
 T = TypeVar("T")
 
 
@@ -151,6 +153,7 @@ class WindowAssembler(Generic[T]):
             # only when every window it belongs to is past the lateness
             # horizon — not once per expired window assignment.
             self.dropped_late += 1
+            telemetry.record_late_drop()
 
         fired.extend(self._advance(wm))
         return fired
@@ -161,6 +164,9 @@ class WindowAssembler(Generic[T]):
             if spec.end <= wm and not self._fired.get(spec):
                 fired.append(WindowBatch(spec.start, spec.end, list(self._buffers[spec])))
                 self._fired[spec] = True
+                # Watermark lag: event-time ms between window end and the
+                # watermark that fired it (how late the firing was).
+                telemetry.record_watermark_lag(wm - spec.end)
         # Garbage-collect windows past the lateness horizon. The fired-flag
         # entry goes too: re-entry of a GC'd window is already blocked by the
         # spec.end + lateness <= wm check in feed(), and keeping the flags

@@ -1,0 +1,443 @@
+"""Runtime telemetry: spans, device-boundary accounting, recompile
+detection, watermark-lag gauges.
+
+The reference instruments its pipelines with Flink/NES metrics (``com/mn/``);
+those host-side counters are ported in ``mn/``. This module adds the layer
+the JVM build never needed: visibility at the HOST↔DEVICE boundary, where
+every perf pathology this codebase has hit lives —
+
+- per-window eager-op recompiles (~1-2 s each over the tunnel) → the
+  recompile detector keyed by (kernel, abstract shape signature);
+- transfers over a ±50% ~28 MB/s tunnel → host→device / device→host byte
+  accounting at the batch-shipping entry points (``operators/base.py``);
+- ``jax.block_until_ready`` being a NO-OP over the axon tunnel → the
+  ``fetch`` true-sync helper times via a real ``jax.device_get`` (the only
+  actual synchronization point; the bug that once produced a bogus
+  106M pts/s number);
+- windows firing late / events dropped → watermark-lag and late-drop
+  gauges fed by the ``streams/`` assemblers.
+
+Contract: **disabled by default and free when disabled** (operator hot
+paths do one ``telemetry.enabled`` attribute check per window, nothing
+per event); when enabled, instrumentation adds **zero device round trips**
+beyond the operator's own fetches — byte accounting reads host-array
+``nbytes`` before shipping, and ``fetch`` REPLACES (never duplicates) the
+operator's existing device→host materialization.
+
+Spans emit Chrome-trace/Perfetto-compatible complete events ("ph": "X",
+microsecond ts/dur) as JSON-lines; ``load_trace`` wraps a trace file into
+the standard ``{"traceEvents": [...]}`` document. Spans named
+``window.*`` additionally feed a ``FixedBucketLatency`` histogram, so
+p50/p95 window latency lands in NES reporter lines and bench.py's JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
+
+
+class RecompileWarning(UserWarning):
+    """One kernel crossed the distinct-abstract-shape threshold — bucket
+    churn or an accidentally dynamic shape is forcing XLA recompiles."""
+
+
+def _arg_signature(a):
+    """One argument's contribution to the abstract signature. Arrays →
+    (shape, dtype) — the aval; tuples/lists recurse (jit flattens pytrees,
+    so a container of arrays recompiles whenever ANY leaf's shape changes
+    — e.g. the knn pane digests repadded to a grown nseg); other leaves
+    contribute only their type (jit treats distinct Python scalars of one
+    type as one aval)."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    if isinstance(a, (tuple, list)):
+        return (type(a).__name__, tuple(_arg_signature(x) for x in a))
+    return type(a).__name__
+
+
+def abstract_signature(args: tuple, kwargs: Optional[dict] = None) -> Tuple:
+    """Hashable proxy of jax.jit's cache key for a call.
+
+    Positional arguments go through ``_arg_signature`` (avals for arrays,
+    recursive for containers); keyword arguments contribute
+    (name, repr(value)) because every kwarg in this codebase is a static
+    argument, where the VALUE keys the compile cache.
+    """
+    parts = [_arg_signature(a) for a in args]
+    for k in sorted(kwargs or ()):
+        v = kwargs[k]
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append((k, (tuple(shape), str(dtype))))
+        else:
+            parts.append((k, repr(v)))
+    return tuple(parts)
+
+
+class _NullSpan:
+    """No-op context manager returned while telemetry is disabled — one
+    shared instance, so the disabled-path cost is a truthiness check."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tel", "name", "args", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str, args: Dict[str, Any]):
+        self._tel = tel
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._tel._emit_span(
+            self.name, self._t0, time.perf_counter_ns() - self._t0, self.args
+        )
+        return False
+
+
+class Telemetry:
+    """Process-global telemetry registry (the ``ops/counters.py`` idiom:
+    one module singleton, ``enable()`` to opt in)."""
+
+    def __init__(self, max_events: int = 262_144):
+        self.enabled = False
+        self.max_events = max_events
+        self.recompile_warn_threshold = 8
+        self.trace_path: Optional[str] = None
+        self._trace_file = None
+        self._lock = threading.RLock()
+        self._reset_state()
+
+    def _reset_state(self):
+        self.events: list = []
+        self.dropped_events = 0
+        self._since_flush = 0
+        self.h2d_bytes = 0
+        self.h2d_transfers = 0
+        self.d2h_bytes = 0
+        self.d2h_transfers = 0
+        self.compile_events: list = []  # (kernel, signature), append order
+        self._shapes_seen: Dict[str, set] = {}
+        self._warned_kernels: set = set()
+        self.max_watermark_lag_ms = 0
+        self.late_drops = 0
+        self.window_latency = FixedBucketLatency()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def enable(self, trace_path: Optional[str] = None,
+               recompile_warn_threshold: int = 8):
+        """Reset all state and start recording. ``trace_path``: optional
+        Chrome-trace JSON-lines file (events also buffer in memory, capped
+        at ``max_events``)."""
+        with self._lock:
+            self.disable()
+            self._reset_state()
+            self.recompile_warn_threshold = int(recompile_warn_threshold)
+            self.trace_path = trace_path
+            if trace_path:
+                d = os.path.dirname(os.path.abspath(trace_path))
+                os.makedirs(d, exist_ok=True)
+                self._trace_file = open(trace_path, "w")
+            self.enabled = True
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+            if self._trace_file is not None:
+                self._trace_file.close()  # close flushes buffered events
+                self._trace_file = None
+
+    FLUSH_EVERY = 256
+
+    def _write_trace(self, event: dict):
+        """Buffered trace write (caller holds the lock). No per-event
+        flush — a synchronous flush per span would serialize operator
+        threads through disk I/O and distort the spans being measured;
+        the buffer drains every FLUSH_EVERY events and on disable()."""
+        self._trace_file.write(json.dumps(event) + "\n")
+        self._since_flush += 1
+        if self._since_flush >= self.FLUSH_EVERY:
+            self._trace_file.flush()
+            self._since_flush = 0
+
+    # -- spans ----------------------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Context manager timing one phase. Nesting renders naturally in
+        Chrome tracing (same tid, contained ts/dur). ``window.*`` spans
+        also feed the window-latency histogram."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def _emit_span(self, name, t0_ns, dur_ns, args):
+        if not self.enabled:  # disabled mid-span
+            return
+        ev = {
+            "name": name,
+            "cat": "telemetry",
+            "ph": "X",
+            "ts": t0_ns // 1000,
+            "dur": dur_ns // 1000,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = json_safe(args)
+        self._emit(ev)
+        if name.startswith("window"):
+            with self._lock:
+                self.window_latency.observe(dur_ns / 1e6)
+
+    def _emit(self, event: dict):
+        with self._lock:
+            if len(self.events) < self.max_events:
+                self.events.append(event)
+            else:
+                self.dropped_events += 1
+            if self._trace_file is not None:
+                self._write_trace(event)
+
+    # -- device-boundary accounting -------------------------------------------
+
+    def account_h2d(self, nbytes: int):
+        """Bytes about to ship host→device (read from the HOST array before
+        the transfer — no device round trip)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_transfers += 1
+            if self._trace_file is not None:
+                self._write_trace({
+                    "name": "h2d_bytes", "ph": "C",
+                    "ts": time.perf_counter_ns() // 1000,
+                    "pid": os.getpid(), "args": {"bytes": self.h2d_bytes},
+                })
+
+    def account_d2h(self, nbytes: int):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+            self.d2h_transfers += 1
+
+    def fetch(self, x):
+        """True-sync device→host fetch with timing + byte accounting.
+
+        ``jax.block_until_ready`` is a NO-OP over the axon tunnel — it
+        returns before transfers/compute finish (CLAUDE.md) — so a real
+        ``jax.device_get`` is the ONLY honest synchronization point.
+        Accepts any pytree; returns host numpy. Use this IN PLACE OF the
+        operator's ``np.asarray``/``device_get`` so accounting rides the
+        fetch the operator was doing anyway (zero extra round trips).
+        """
+        import jax
+
+        if not self.enabled:
+            return jax.device_get(x)
+        t0 = time.perf_counter_ns()
+        out = jax.device_get(x)
+        dur_ns = time.perf_counter_ns() - t0
+        nbytes = 0
+        for leaf in jax.tree_util.tree_leaves(out):
+            nbytes += getattr(leaf, "nbytes", 0)
+        self.account_d2h(nbytes)
+        self._emit({
+            "name": "fetch", "cat": "telemetry", "ph": "X",
+            "ts": t0 // 1000, "dur": dur_ns // 1000,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": {"bytes": int(nbytes)},
+        })
+        return out
+
+    # -- recompile detection --------------------------------------------------
+
+    def record_jit_call(self, kernel: str, signature: Tuple):
+        """Record a call into a jitted kernel. A signature not seen before
+        for this kernel is one XLA compile (jit's cache key is the abstract
+        shapes + statics this signature proxies). Crossing
+        ``recompile_warn_threshold`` distinct signatures warns once —
+        catching bucket-size churn and accidentally dynamic shapes."""
+        if not self.enabled:
+            return
+        warn_n = None
+        with self._lock:
+            seen = self._shapes_seen.setdefault(kernel, set())
+            if signature in seen:
+                return
+            seen.add(signature)
+            self.compile_events.append((kernel, signature))
+            if (len(seen) >= self.recompile_warn_threshold
+                    and kernel not in self._warned_kernels):
+                self._warned_kernels.add(kernel)
+                warn_n = len(seen)
+        self._emit({
+            "name": f"compile:{kernel}", "cat": "telemetry", "ph": "i",
+            "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
+            "tid": threading.get_ident(), "s": "t",
+            "args": {"signature": repr(signature)},
+        })
+        if warn_n is not None:
+            warnings.warn(
+                f"kernel '{kernel}' has compiled for {warn_n} distinct "
+                f"abstract shapes (threshold "
+                f"{self.recompile_warn_threshold}): each is ~1-2 s of XLA "
+                "compile + a tunnel round trip — check for bucket-size "
+                "churn or an un-bucketed dynamic dimension",
+                RecompileWarning,
+                stacklevel=3,
+            )
+
+    @property
+    def compile_count(self) -> int:
+        return len(self.compile_events)
+
+    def distinct_shapes(self, kernel: str) -> int:
+        with self._lock:
+            return len(self._shapes_seen.get(kernel, ()))
+
+    # -- watermark / lateness gauges ------------------------------------------
+
+    def record_watermark_lag(self, lag_ms: int):
+        """Event-time ms between a fired window's end and the watermark at
+        fire time — how late the window fired relative to its span."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if lag_ms > self.max_watermark_lag_ms:
+                self.max_watermark_lag_ms = int(lag_ms)
+
+    def record_late_drop(self, n: int = 1):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.late_drops += int(n)
+
+    # -- export ---------------------------------------------------------------
+
+    def register_metrics(self, registry):
+        """Wire the telemetry gauges into an ``mn.metrics.MetricRegistry``
+        so ``snapshot()`` (and anything reading it — NES reporter lines,
+        sink-owned registries) carries the new columns."""
+        registry.gauge("watermark_lag_ms_max",
+                       lambda: self.max_watermark_lag_ms)
+        registry.gauge("late_dropped_total", lambda: self.late_drops)
+        registry.gauge("telemetry_compiles_total",
+                       lambda: len(self.compile_events))
+        registry.gauge("h2d_bytes_total", lambda: self.h2d_bytes)
+        registry.gauge("d2h_bytes_total", lambda: self.d2h_bytes)
+
+    def summary(self) -> Dict[str, Any]:
+        """The bench.py JSON block: strictly JSON-safe (numpy scalars →
+        builtins, NaN percentiles → None so strict parsers never choke)."""
+        with self._lock:
+            p50 = self.window_latency.percentile(0.50)
+            p95 = self.window_latency.percentile(0.95)
+            out = {
+                "compiles": len(self.compile_events),
+                "bytes_h2d": self.h2d_bytes,
+                "bytes_d2h": self.d2h_bytes,
+                "window_latency_p50_ms": None if p50 != p50 else p50,
+                "window_latency_p95_ms": None if p95 != p95 else p95,
+                "max_watermark_lag_ms": self.max_watermark_lag_ms,
+                "late_dropped": self.late_drops,
+            }
+        return json_safe(out)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full JSON-safe state dump (summary + transfer/trace counts)."""
+        out = self.summary()
+        with self._lock:
+            out.update(
+                h2d_transfers=self.h2d_transfers,
+                d2h_transfers=self.d2h_transfers,
+                events=len(self.events),
+                dropped_events=self.dropped_events,
+                kernels={k: len(v) for k, v in self._shapes_seen.items()},
+            )
+        return json_safe(out)
+
+
+telemetry = Telemetry()
+
+
+def enable(trace_path: Optional[str] = None, recompile_warn_threshold: int = 8):
+    telemetry.enable(trace_path, recompile_warn_threshold)
+
+
+def disable():
+    telemetry.disable()
+
+
+def span(name: str, **args):
+    return telemetry.span(name, **args)
+
+
+def fetch(x):
+    return telemetry.fetch(x)
+
+
+def instrument_jit(fn, name: Optional[str] = None):
+    """Wrap a compiled callable with recompile-signature tracking.
+
+    ``operators/base.py:jitted`` routes every operator kernel through this;
+    bench.py wraps its hand-jitted steps the same way. Disabled-path cost:
+    one attribute check per call (calls here are per WINDOW, never per
+    record). Attributes of the underlying jit object (``lower``, …) pass
+    through.
+    """
+    label = name or getattr(fn, "__name__", repr(fn))
+
+    class _Instrumented:
+        __slots__ = ()
+
+        def __call__(self, *args, **kwargs):
+            if telemetry.enabled:
+                telemetry.record_jit_call(
+                    label, abstract_signature(args, kwargs)
+                )
+            return fn(*args, **kwargs)
+
+        def __getattr__(self, attr):
+            return getattr(fn, attr)
+
+    wrapped = _Instrumented()
+    return wrapped
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Read a JSON-lines trace file into the standard Chrome-trace document
+    ``{"traceEvents": [...]}`` (loadable by chrome://tracing / Perfetto)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return {"traceEvents": events}

@@ -1,0 +1,25 @@
+"""shard_map import shim across the jax 0.4→0.8 API moves.
+
+Two things moved under us: the symbol's home
+(``jax.experimental.shard_map`` → ``jax.shard_map``) and the
+replication-check kwarg's name (``check_rep`` → ``check_vma``). Callers
+here write the NEW spelling (``check_vma``); on an older jax the shim
+forwards it as ``check_rep`` so one codebase runs on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)

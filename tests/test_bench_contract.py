@@ -18,6 +18,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
@@ -152,3 +154,73 @@ class TestLastGoodStore:
         assert seed["record"]["value"] > 1e6
         assert seed["record"]["device"] == "TPU v5 lite0"
         assert len(seed["git_sha"]) == 40
+
+
+class TestTelemetryBlock:
+    def test_fake_record_with_telemetry_relays_verbatim(self, tmp_path):
+        """The supervisor must relay the telemetry block untouched."""
+        good = {
+            "metric": "continuous_knn_k50_1M_window_points_per_sec_per_chip",
+            "value": 99.0,
+            "unit": "points/s",
+            "vs_baseline": 0.005,
+            "telemetry": {
+                "compiles": 3,
+                "bytes_h2d": 663552,
+                "bytes_d2h": 1546420,
+                "window_latency_p50_ms": 1.0,
+                "window_latency_p95_ms": 2.0,
+                "max_watermark_lag_ms": 0,
+                "late_dropped": 0,
+            },
+        }
+        p, lines, _ = _run(
+            tmp_path, {"SFT_BENCH_FAKE_RECORD": json.dumps(good)}
+        )
+        assert p.returncode == 0
+        assert json.loads(lines[0])["telemetry"] == good["telemetry"]
+
+    @pytest.mark.slow
+    def test_smoke_run_emits_telemetry_summary(self, tmp_path):
+        """SFT_BENCH_SMOKE runs the REAL measured program at toy sizes on
+        XLA:CPU: still exactly ONE JSON line, now with the telemetry
+        summary, and the Chrome-trace side channel loads as valid JSON."""
+        trace = tmp_path / "bench_trace.jsonl"
+        env = {
+            **os.environ,
+            "SFT_BENCH_SMOKE": "1",
+            "SFT_BENCH_BACKOFFS": "0",
+            "SFT_BENCH_LAST_GOOD": str(tmp_path / "lg.json"),
+            "SFT_TRACE_PATH": str(trace),
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        env.pop("SFT_BENCH_CHILD", None)
+        p = subprocess.run(
+            [sys.executable, BENCH], env=env, capture_output=True,
+            text=True, timeout=540,
+        )
+        assert p.returncode == 0, p.stderr[-4000:]
+        lines = [ln for ln in p.stdout.strip().splitlines() if ln]
+        assert len(lines) == 1, f"driver contract: ONE line, got {lines}"
+        rec = json.loads(lines[0])
+        assert rec["smoke"] is True
+        assert rec["value"] > 0
+        tel = rec["telemetry"]
+        assert tel["compiles"] >= 1  # headline step compiled at least once
+        assert tel["bytes_h2d"] > 0
+        assert tel["bytes_d2h"] > 0
+        assert tel["window_latency_p50_ms"] is not None
+        assert tel["window_latency_p95_ms"] >= tel["window_latency_p50_ms"]
+        assert tel["max_watermark_lag_ms"] == 0  # in-order synthetic stream
+        # Toy numbers must never enter the last-good store.
+        assert not (tmp_path / "lg.json").exists()
+        # The child's trace file is a loadable Chrome-trace document.
+        from spatialflink_tpu.telemetry import load_trace
+
+        doc = load_trace(str(trace))
+        assert doc["traceEvents"], "trace captured no events"
+        json.dumps(doc)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "window.headline" in names
+        assert any(n.startswith("compile:") for n in names)

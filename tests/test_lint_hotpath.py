@@ -1,0 +1,133 @@
+"""Tier-1 hot-path lint (tools/lint_hotpath.py): the repo's ``ops/``
+kernels must stay free of import-time jax.numpy dispatches and in-kernel
+wall-clock reads, and the lint itself must catch both leak classes.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_hotpath  # noqa: E402
+
+
+def _lint(src: str, name: str = "mod.py"):
+    return lint_hotpath.lint_source(name, textwrap.dedent(src))
+
+
+def test_repo_ops_is_clean():
+    violations = lint_hotpath.lint_paths([lint_hotpath.default_target()])
+    assert violations == [], "\n".join(
+        f"{p}:{ln}: {m}" for p, ln, m in violations
+    )
+
+
+def test_flags_module_level_jnp_call():
+    (v,) = _lint("""
+        import jax.numpy as jnp
+        PAD = jnp.zeros((8,))
+    """)
+    assert v[1] == 3 and "module-level jax.numpy" in v[2]
+
+
+def test_flags_from_jax_import_numpy_and_direct_name():
+    vs = _lint("""
+        from jax import numpy as jn
+        from jax.numpy import full
+        A = jn.ones(4)
+        B = full((2,), 0.0)
+    """)
+    assert [v[1] for v in vs] == [4, 5]
+
+
+def test_function_scoped_jnp_is_fine():
+    assert _lint("""
+        import jax.numpy as jnp
+        def kernel(x):
+            return jnp.sum(x)
+    """) == []
+
+
+def test_default_arg_counts_as_module_level():
+    (v,) = _lint("""
+        import jax.numpy as jnp
+        def kernel(x, pad=jnp.zeros(4)):
+            return x + pad
+    """)
+    assert "module-level" in v[2]
+
+
+def test_flags_wall_clock_inside_function():
+    vs = _lint("""
+        import time
+        from time import perf_counter as pc
+        def kernel(x):
+            t0 = time.time()
+            t1 = pc()
+            return x, t0, t1
+    """)
+    assert [v[1] for v in vs] == [5, 6]
+    assert all("wall-clock" in v[2] for v in vs)
+
+
+def test_module_level_wall_clock_not_flagged():
+    # Import-time timestamps run once on the host — not a kernel leak.
+    assert _lint("""
+        import time
+        T0 = time.time()
+    """) == []
+
+
+def test_pragma_suppresses():
+    assert _lint("""
+        import time
+        def host_tally():
+            return time.time()  # hotpath: ok
+    """) == []
+
+
+def test_lambda_default_counts_as_module_level():
+    (v,) = _lint("""
+        import jax.numpy as jnp
+        f = lambda x, p=jnp.zeros(8): x + p
+    """)
+    assert "module-level jax.numpy call" in v[2]
+
+
+def test_pragma_suppresses_on_any_line_of_a_multiline_call():
+    # Formatter-wrapped calls keep their suppression: the pragma can sit
+    # on any line the call spans, not just the first.
+    assert _lint("""
+        import jax.numpy as jnp
+        PAD = jnp.full(
+            (8,), 0.0,
+        )  # hotpath: ok
+    """) == []
+
+
+def test_allowlisted_host_module_skipped(tmp_path):
+    bad = "import time\ndef f():\n    return time.time()\n"
+    allowed = tmp_path / "counters.py"
+    allowed.write_text(bad)
+    flagged = tmp_path / "kern.py"
+    flagged.write_text(bad)
+    assert lint_hotpath.lint_file(str(allowed)) == []
+    assert len(lint_hotpath.lint_file(str(flagged))) == 1
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("import numpy as np\nX = np.zeros(3)\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import jax.numpy as jnp\nX = jnp.zeros(3)\n")
+    tool = os.path.join(REPO, "tools", "lint_hotpath.py")
+    ok = subprocess.run([sys.executable, tool, str(clean)],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0 and ok.stdout == ""
+    bad = subprocess.run([sys.executable, tool, str(dirty)],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "dirty.py:2" in bad.stdout

@@ -134,3 +134,133 @@ def test_all_instrumented_queries_run(tmp_path):
         }
         rep = fn(iter(_csv_lines(1500)), props)
         assert rep.metrics["source_in_total"] == 1500, q
+
+
+# -- MetricRegistry thread safety ---------------------------------------------
+
+
+def test_registry_concurrent_inc_and_snapshot():
+    """Operator threads inc() while a reporter thread snapshots: no
+    RuntimeError from mid-resize iteration and NO lost increments (the
+    unlocked read-modify-write could drop counts under preemption)."""
+    import threading
+
+    reg = MetricRegistry()
+    n_threads, n_incs, n_keys = 4, 8_000, 8
+    reg.gauge("g", lambda: 1.0)
+    errors = []
+    stop = threading.Event()
+
+    def snapshotter():
+        while not stop.is_set():
+            try:
+                reg.snapshot_counters()
+                reg.snapshot()
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+                return
+
+    def incrementer():
+        for i in range(n_incs):
+            reg.inc(f"c{i % n_keys}")
+
+    snap = threading.Thread(target=snapshotter)
+    snap.start()
+    threads = [threading.Thread(target=incrementer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    snap.join()
+    assert not errors, errors
+    for k in range(n_keys):
+        assert reg.counter(f"c{k}") == n_threads * n_incs // n_keys
+
+
+# -- JSON-safe snapshots ------------------------------------------------------
+
+
+def test_json_safe_converts_numpy_at_the_boundary():
+    """json.dumps of any snapshot must never raise, and f-strings must
+    format cleanly (the np.float32 repr bug shipped twice)."""
+    import json
+
+    import numpy as np
+
+    from spatialflink_tpu.mn.metrics import json_safe
+
+    safe = json_safe({
+        "f32": np.float32(1.5),
+        "i64": np.int64(7),
+        "b": np.bool_(True),
+        "arr": np.arange(3, dtype=np.float32),
+        "nested": {"t": (np.float64(2.5), "s", None)},
+    })
+    json.dumps(safe)
+    assert type(safe["f32"]) is float and f"{safe['f32']}" == "1.5"
+    assert type(safe["i64"]) is int
+    assert type(safe["b"]) is bool
+    assert safe["arr"] == [0.0, 1.0, 2.0]
+    assert safe["nested"]["t"] == [2.5, "s", None]
+
+
+def test_registry_snapshot_is_json_safe():
+    import json
+
+    import numpy as np
+
+    reg = MetricRegistry()
+    reg.inc("n", 3)
+    reg.gauge("npval", lambda: np.float32(0.25))
+    snap = reg.snapshot()
+    json.dumps(snap)
+    assert type(snap["npval"]) is float
+
+
+def test_kernel_counters_snapshot_is_json_safe():
+    import json
+
+    import numpy as np
+
+    from spatialflink_tpu.ops.counters import KernelCounters
+
+    kc = KernelCounters(enabled=True)
+    kc.record_window(np.int64(100), np.int32(40), np.int64(40))
+    json.dumps(kc.snapshot())
+
+
+# -- NESFileReporter timer-thread mode ----------------------------------------
+
+
+def test_reporter_timer_thread_lifecycle(tmp_path):
+    import time as _time
+
+    reg = MetricRegistry()
+    rep = NESFileReporter(reg, "qthr", out_dir=str(tmp_path),
+                          interval_s=0.05)
+    path = tmp_path / "EngineStats_qthr_proc.stats"
+    rep.start()
+    first = rep._thread
+    assert first is not None and first.is_alive()
+    rep.start()  # idempotent: no duplicate thread spawned
+    assert rep._thread is first
+
+    reg.inc(MetricNames.SOURCE_IN, 10)
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        if path.exists() and path.read_text().count("\n") >= 2:
+            break
+        _time.sleep(0.02)
+    rep.stop()  # joins cleanly
+    assert rep._thread is None
+    assert not first.is_alive()
+    rep.stop()  # second stop is a no-op
+
+    lines = path.read_text().splitlines()
+    assert len(lines) >= 2
+    assert all(ln.startswith("METRICS ts=") for ln in lines)
+    # No further lines appended after stop().
+    n = len(lines)
+    _time.sleep(0.15)
+    assert path.read_text().count("\n") == n
