@@ -72,9 +72,13 @@ class NESFileReporter:
         mbps = d_bytes / interval / 1_000_000.0
 
         ts = datetime.fromtimestamp(now, tz=timezone.utc).isoformat()
+        # float() wraps: numpy ≥2 scalars would print np.float64(…) into
+        # the METRICS line (sfcheck fstring-numpy).
         line = (
-            f"METRICS ts={ts} eps_in_avg={eps_in:.2f} eps_out_avg={eps_out:.2f} "
-            f"selectivity_e2e={sel:.4f} throughput_mb_s={mbps:.4f}"
+            f"METRICS ts={ts} eps_in_avg={float(eps_in):.2f} "
+            f"eps_out_avg={float(eps_out):.2f} "
+            f"selectivity_e2e={float(sel):.4f} "
+            f"throughput_mb_s={float(mbps):.4f}"
         )
         # Kernel-level counters (Point.java:220-235 distance-computation
         # analog) append when the global registry is enabled.

@@ -193,7 +193,7 @@ def join_window_pallas(
     interpreter for CPU testing.
     """
     f32 = jnp.float32
-    max_pairs = int(max_pairs)
+    max_pairs = int(max_pairs)  # sfcheck: ok=trace-hygiene -- static shape budget, a Python int at trace time (never traced)
     max_pairs += (-max_pairs) % 128  # whole 128-lane output rows
     max_rows = max_pairs // 128
     span = 2 * layers + 1

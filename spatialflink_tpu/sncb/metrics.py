@@ -76,9 +76,11 @@ class MetricsSink:
         eps = self._count / dt
         mb = self._count * self.bytes_per_record / 1e6
         avg_lat = self._latency_sum_ms / self._count if self._count else 0.0
+        # float() wraps: numpy ≥2 scalars would print np.float64(…) into
+        # the CSV row (sfcheck fstring-numpy).
         row = (
-            f"{now - self._t0:.1f},{self._count},{mb:.3f},{eps:.1f},"
-            f"{mb / dt:.3f},{avg_lat:.2f}"
+            f"{float(now - self._t0):.1f},{self._count},{float(mb):.3f},"
+            f"{float(eps):.1f},{float(mb / dt):.3f},{float(avg_lat):.2f}"
         )
         if self.include_opcounters:
             from spatialflink_tpu.ops.counters import counters as opcounters

@@ -98,9 +98,14 @@ def trajectory_wkt(events: Sequence[GpsEvent]) -> str:
     pts = sorted(events, key=lambda e: e.ts)
     if not pts:
         return "POINT EMPTY"
+    # float() wraps: event coords may be numpy scalars (SoA decode), and
+    # numpy ≥2 would print np.float64(…) into the WKT (sfcheck
+    # fstring-numpy).
     if len(pts) == 1:
-        return f"POINT ({pts[0].lon:g} {pts[0].lat:g})"
-    return "LINESTRING (" + ", ".join(f"{e.lon:g} {e.lat:g}" for e in pts) + ")"
+        return f"POINT ({float(pts[0].lon):g} {float(pts[0].lat):g})"
+    return ("LINESTRING ("
+            + ", ".join(f"{float(e.lon):g} {float(e.lat):g}" for e in pts)
+            + ")")
 
 
 def traj_speed(events: Sequence[GpsEvent]) -> tuple:

@@ -1,0 +1,14 @@
+"""fixed-shape true positives: every data-dependent-shape spelling."""
+
+import jax.numpy as jnp
+
+
+def compact(x, mask):
+    idx = jnp.nonzero(mask)            # no size= → data-dependent shape
+    hits = jnp.where(mask)             # single-arg where = nonzero
+    uniq = jnp.unique(x)               # no size=
+    kept = jnp.compress(mask, x)       # no fixed-shape form exists
+    picked = x[x > 0]                  # inline boolean-mask subscript
+    near = x < 0.5
+    named = x[near]                    # named boolean-mask subscript
+    return idx, hits, uniq, kept, picked, named

@@ -1,0 +1,13 @@
+"""fixed-shape clean: the repo's mask-don't-compact idioms."""
+
+import jax.numpy as jnp
+
+
+def compact(x, mask, budget: int):
+    n = x.shape[0]
+    idx = jnp.nonzero(mask, size=budget, fill_value=n)[0]  # fixed shape
+    overflow = jnp.maximum(jnp.sum(mask) - budget, 0)      # count, don't grow
+    sel = jnp.where(mask, x, 0.0)                          # 3-arg select
+    uniq = jnp.unique(x, size=budget, fill_value=-1)       # fixed shape
+    capped = x.at[x > 1.0].set(1.0)    # .at masked update PRESERVES shape
+    return idx, overflow, sel, uniq, capped

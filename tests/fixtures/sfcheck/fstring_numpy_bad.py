@@ -1,0 +1,8 @@
+"""fstring-numpy true positives: unwrapped float-formatted egress values."""
+
+
+def emit(eps, lat_ms, stats):
+    line = f"eps={eps:.1f} p95={lat_ms:.2f}"          # unwrapped f-string
+    legacy = "thr={:.3f}".format(stats)               # unwrapped .format
+    named = "sel={s:.4f}".format(s=stats)             # keyword .format
+    return line, legacy, named

@@ -1,0 +1,8 @@
+"""fstring-numpy clean: wrapped values, spec-free interpolations."""
+
+
+def emit(eps, lat_ms, count, stats):
+    line = f"eps={float(eps):.1f} p95={float(lat_ms):.2f} n={count}"
+    legacy = "thr={:.3f}".format(float(stats))
+    literal = f"half={0.5:.1f} pct={int(eps):d}"
+    return line, legacy, literal

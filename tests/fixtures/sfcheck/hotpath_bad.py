@@ -1,0 +1,16 @@
+"""hotpath true positives: import-time jnp dispatch + in-kernel wall clock."""
+
+import time
+
+import jax.numpy as jnp
+from jax.numpy import full
+from time import perf_counter as pc
+
+PAD = jnp.zeros((8,))          # module-level jax.numpy call
+FILL = full((2,), 0.0)         # direct-name jax.numpy call
+
+
+def kernel(x, pad=jnp.ones(4)):  # default executes at module scope
+    t0 = time.time()             # wall clock inside an ops/ function
+    t1 = pc()                    # aliased wall clock
+    return x + pad, t0, t1

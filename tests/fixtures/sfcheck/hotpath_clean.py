@@ -1,0 +1,13 @@
+"""hotpath clean: function-scoped jnp, module-scope wall clock only."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+PAD = np.zeros(8)        # host constant in plain numpy — fine
+T_IMPORT = time.time()   # import-time timestamp runs once on the host
+
+
+def kernel(x):
+    return jnp.sum(x) + jnp.asarray(PAD, x.dtype)[0]

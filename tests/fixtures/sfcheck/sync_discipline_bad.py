@@ -1,0 +1,12 @@
+"""sync-discipline true positives: function and method spellings."""
+
+import jax
+from jax import block_until_ready as bur
+
+
+def timed_step(fn, x):
+    out = fn(x)
+    jax.block_until_ready(out)     # no-op over the axon tunnel
+    out.block_until_ready()        # method form, same no-op
+    bur(out)                       # aliased import
+    return out

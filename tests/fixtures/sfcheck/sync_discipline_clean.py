@@ -1,0 +1,10 @@
+"""sync-discipline clean: true sync via a real device→host fetch."""
+
+import jax
+import numpy as np
+
+
+def timed_step(fn, x):
+    out = fn(x)
+    fetched = jax.device_get(out)   # true sync: actually fetches
+    return np.asarray(fetched)

@@ -1,0 +1,13 @@
+"""trace-hygiene true positives: every leak class once."""
+
+import jax
+import numpy as np
+
+
+def kernel(x, scale):
+    s = float(scale)          # concretizes a (possibly traced) parameter
+    host = np.asarray(x)      # materializes the parameter on the host
+    first = x[0].item()       # per-call device→host fetch
+    fetched = jax.device_get(x)   # fetch belongs to the operator layer
+    print("debug", s)         # host I/O in a traced path
+    return host, first, fetched

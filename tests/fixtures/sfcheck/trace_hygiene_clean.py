@@ -1,0 +1,12 @@
+"""trace-hygiene clean: traced math on parameters, host work on locals."""
+
+import jax.numpy as jnp
+import numpy as np
+
+_EDGES = np.linspace(0.0, 1.0, 9)  # module-scope host constant — fine
+
+
+def kernel(x, scale):
+    s = jnp.asarray(scale, x.dtype)    # stays traced
+    limit = float(np.pi)               # host constant, not a parameter
+    return jnp.clip(x * s, 0.0, limit)

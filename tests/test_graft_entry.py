@@ -16,7 +16,9 @@ def test_entry_compiles_and_runs():
 
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
-    jax.block_until_ready(out)
+    # True sync is a device fetch — block_until_ready is a no-op over the
+    # axon tunnel (sfcheck sync-discipline).
+    out = jax.device_get(out)
     assert int(out.num_valid) == 50
     d = np.asarray(out.dist[: int(out.num_valid)])
     assert (np.diff(d) >= 0).all()  # ascending

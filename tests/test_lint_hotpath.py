@@ -1,6 +1,12 @@
-"""Tier-1 hot-path lint (tools/lint_hotpath.py): the repo's ``ops/``
-kernels must stay free of import-time jax.numpy dispatches and in-kernel
-wall-clock reads, and the lint itself must catch both leak classes.
+"""Tier-1 hot-path lint: the repo's ``ops/`` kernels must stay free of
+import-time jax.numpy dispatches and in-kernel wall-clock reads, and the
+lint itself must catch both leak classes.
+
+``tools/lint_hotpath.py`` is now a deprecation SHIM over the sfcheck
+framework's ``hotpath`` pass (tools/sfcheck). Every behavioral test here
+deliberately runs through the shim — same CLI, same exit codes, same
+``(path, lineno, message)`` tuples — so the back-compat surface is what
+CI pins.
 """
 
 import os
@@ -131,3 +137,37 @@ def test_cli_exit_codes(tmp_path):
                          capture_output=True, text=True)
     assert bad.returncode == 1
     assert "dirty.py:2" in bad.stdout
+
+
+# -- shim-specific: the old surface must be the sfcheck hotpath pass ---------
+
+def test_shim_delegates_to_sfcheck():
+    # The shim's implementation IS the registered sfcheck pass — not a
+    # drifting copy of the rules.
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.sfcheck import core
+    from tools.sfcheck.passes import get_pass
+
+    assert lint_hotpath._PASS.name == "hotpath"
+    assert type(lint_hotpath._PASS) is type(get_pass("hotpath"))
+
+    src = "import jax.numpy as jnp\nX = jnp.zeros(3)\n"
+    via_shim = lint_hotpath.lint_source("m.py", src)
+    via_sfcheck = core.check_source("m.py", src, [get_pass("hotpath")],
+                                    force=True)
+    assert via_shim == [(f.path, f.lineno, f.message) for f in via_sfcheck]
+
+
+def test_sfcheck_pragma_suppresses_via_shim():
+    # New-style pragmas work through the old entry point too.
+    assert _lint("""
+        import jax.numpy as jnp
+        PAD = jnp.zeros(8)  # sfcheck: ok=hotpath -- test fixture
+    """) == []
+    # …but a pragma naming a different pass does not.
+    (v,) = _lint("""
+        import jax.numpy as jnp
+        PAD = jnp.zeros(8)  # sfcheck: ok=fixed-shape -- wrong pass
+    """)
+    assert "module-level jax.numpy" in v[2]

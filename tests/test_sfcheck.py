@@ -1,0 +1,252 @@
+"""Tier-1 sfcheck (tools/sfcheck): the multi-pass analyzer keeps the whole
+tree clean, every pass provably detects its target class (fixture corpus
+under tests/fixtures/sfcheck/), pragma suppression and the --json CLI
+contract hold, and the violations fixed in this tree stay fixed
+(block_until_ready egress, numpy-scalar f-strings).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.sfcheck import core  # noqa: E402
+from tools.sfcheck.passes import ALL_PASSES, PASS_NAMES, get_pass  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "sfcheck")
+
+# Subprocesses must never dial the axon tunnel at interpreter boot.
+SUBPROC_ENV = {**os.environ, "PALLAS_AXON_POOL_IPS": ""}
+
+
+def _check(src, pass_name, name="mod.py"):
+    return core.check_source(name, textwrap.dedent(src),
+                             [get_pass(pass_name)], force=True)
+
+
+def _fixture(name, pass_names):
+    path = os.path.join(FIXTURES, name)
+    return core.check_file(path, [get_pass(n) for n in pass_names],
+                           force=True)
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.sfcheck", *args],
+        capture_output=True, text=True, cwd=REPO, env=SUBPROC_ENV,
+    )
+
+
+# -- the analyzer itself -----------------------------------------------------
+
+def test_all_five_passes_registered():
+    assert set(PASS_NAMES) == {
+        "hotpath", "trace-hygiene", "fixed-shape", "sync-discipline",
+        "fstring-numpy",
+    }
+    for p in ALL_PASSES:
+        assert p.description and p.invariant
+
+
+def test_repo_tree_is_clean():
+    report = core.run_paths(core.default_targets())
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+    # The scan actually covered the tree, not an empty walk.
+    assert report.files > 100
+
+
+def test_cli_json_breakdown_over_real_tree():
+    # The ISSUE's CI contract: full analyzer over the package, bench.py
+    # and tools/ reports a per-pass breakdown of all zeros.
+    res = _cli("--json", "spatialflink_tpu", "bench.py", "tools")
+    assert res.returncode == 0, res.stdout + res.stderr
+    data = json.loads(res.stdout)
+    assert data["findings"] == []
+    assert set(data["counts"]) == set(PASS_NAMES)
+    assert all(v == 0 for v in data["counts"].values())
+    assert data["files"] > 70
+
+
+# -- fixture corpus: one true-positive + one clean file per pass -------------
+
+@pytest.mark.parametrize("pass_name,expect_bad", [
+    ("hotpath", 5),
+    ("trace-hygiene", 5),
+    ("fixed-shape", 6),
+    ("sync-discipline", 3),
+    ("fstring-numpy", 4),
+])
+def test_fixture_corpus(pass_name, expect_bad):
+    stem = pass_name.replace("-", "_")
+    bad = _fixture(f"{stem}_bad.py", [pass_name])
+    assert len(bad) == expect_bad, "\n".join(f.format() for f in bad)
+    assert all(f.pass_name == pass_name for f in bad)
+    assert _fixture(f"{stem}_clean.py", [pass_name]) == []
+
+
+def test_pragma_fixture_suppresses_every_class():
+    assert _fixture("pragmas_ok.py", list(PASS_NAMES)) == []
+
+
+# -- pragma semantics --------------------------------------------------------
+
+def test_bare_pragma_suppresses_all_passes():
+    src = """
+        import jax
+        def f(x):
+            jax.block_until_ready(x)  # sfcheck: ok
+    """
+    assert _check(src, "sync-discipline") == []
+
+
+def test_named_pragma_suppresses_only_that_pass():
+    src = """
+        import jax
+        def f(x):
+            jax.block_until_ready(x)  # sfcheck: ok=sync-discipline -- why
+    """
+    assert _check(src, "sync-discipline") == []
+    # The same pragma naming a DIFFERENT pass does not suppress.
+    wrong = src.replace("ok=sync-discipline", "ok=hotpath")
+    assert len(_check(wrong, "sync-discipline")) == 1
+
+
+def test_pragma_spans_multiline_call():
+    src = """
+        import jax.numpy as jnp
+        def f(mask):
+            return jnp.nonzero(
+                mask,
+            )  # sfcheck: ok=fixed-shape -- fixture: pragma on the close paren
+    """
+    assert _check(src, "fixed-shape") == []
+
+
+def test_syntax_error_is_reported_not_swallowed():
+    findings = core.check_source("broken.py", "def f(:\n", ALL_PASSES,
+                                 force=True)
+    assert len(findings) == 1 and findings[0].pass_name == "syntax"
+
+
+# -- CLI contract ------------------------------------------------------------
+
+def test_cli_exit_codes_and_human_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\ndef f(x):\n    jax.block_until_ready(x)\n")
+    res = _cli("--pass", "sync-discipline", str(bad))
+    assert res.returncode == 1
+    assert "bad.py:3" in res.stdout and "[sync-discipline]" in res.stdout
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    res = _cli("--pass", "sync-discipline", str(clean))
+    assert res.returncode == 0 and res.stdout == ""
+
+
+def test_cli_json_on_fixture():
+    res = _cli("--pass", "fixed-shape", "--json",
+               os.path.join(FIXTURES, "fixed_shape_bad.py"))
+    assert res.returncode == 1
+    data = json.loads(res.stdout)
+    assert data["counts"] == {"fixed-shape": 6}
+    assert {f["pass"] for f in data["findings"]} == {"fixed-shape"}
+    assert all(f["line"] > 0 and f["message"] for f in data["findings"])
+
+
+def test_cli_unknown_pass_is_usage_error():
+    res = _cli("--pass", "no-such-pass")
+    assert res.returncode == 2
+    assert "unknown pass" in res.stderr
+
+
+def test_cli_list_passes():
+    res = _cli("--list-passes")
+    assert res.returncode == 0
+    for name in PASS_NAMES:
+        assert name in res.stdout
+
+
+# -- targeted regressions for the violations fixed in this tree --------------
+
+def test_no_block_until_ready_outside_telemetry():
+    # __graft_entry__.py and tests/test_graft_entry.py used the no-op
+    # block_until_ready as a "sync"; they now device_get. The ban covers
+    # the driver surface, bench, and the whole test tree.
+    sync = get_pass("sync-discipline")
+    report = core.run_paths(
+        [os.path.join(REPO, p) for p in
+         ("__graft_entry__.py", "bench.py", "bench_suite.py", "tests")],
+        [sync], force_files=True,
+    )
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+
+
+def test_egress_fstrings_are_numpy_safe():
+    # The twice-shipped bug: numpy ≥2 scalars reaching egress f-strings
+    # print as np.float32(…). The egress layers now wrap in float().
+    fstr = get_pass("fstring-numpy")
+    report = core.run_paths(
+        [os.path.join(REPO, "bench.py"),
+         os.path.join(REPO, "spatialflink_tpu", "sncb"),
+         os.path.join(REPO, "spatialflink_tpu", "mn"),
+         os.path.join(REPO, "spatialflink_tpu", "telemetry.py")],
+        [fstr], force_files=True,
+    )
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+
+
+def test_trajectory_wkt_formats_numpy_scalars_clean():
+    from spatialflink_tpu.sncb.common import GpsEvent
+    from spatialflink_tpu.sncb.ops import trajectory_wkt
+
+    events = [
+        GpsEvent(device_id="t1", ts=i,
+                 lon=np.float64(4.5 + i), lat=np.float64(50.85))
+        for i in range(2)
+    ]
+    wkt = trajectory_wkt(events)
+    assert "np." not in wkt
+    assert wkt == "LINESTRING (4.5 50.85, 5.5 50.85)"
+    single = trajectory_wkt(events[:1])
+    assert single == "POINT (4.5 50.85)"
+
+
+def test_metrics_sink_row_numpy_safe(tmp_path):
+    from spatialflink_tpu.sncb.metrics import MetricsSink
+
+    sink = MetricsSink("q", path=str(tmp_path / "m.csv"), interval_s=0.0)
+    # Event timestamp as a numpy scalar — the latency column must still
+    # render as a plain decimal.
+    sink.record(event_ts_ms=np.int64(0), n=3)
+    sink.close()
+    assert sink.rows, "no interval flushed"
+    for row in sink.rows:
+        assert "np." not in row, row
+
+
+def test_reporter_line_numpy_safe(tmp_path):
+    from spatialflink_tpu.mn.metrics import MetricNames, MetricRegistry
+    from spatialflink_tpu.mn.reporter import NESFileReporter
+
+    reg = MetricRegistry()
+    reg.inc(MetricNames.SOURCE_IN, 10)
+    reg.inc(MetricNames.SINK_OUT, 5)
+    rep = NESFileReporter(reg, "q1", out_dir=str(tmp_path))
+    line = rep.report(now=rep._last_time + 2.0)
+    assert line.startswith("METRICS ts=")
+    assert "np." not in line
+    assert "eps_in_avg=5.00" in line

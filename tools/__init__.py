@@ -1,0 +1,2 @@
+# Makes tools/ importable as a package so `python -m tools.sfcheck` and
+# `from tools.sfcheck import ...` work from the repo root.

@@ -1,199 +1,59 @@
 #!/usr/bin/env python
-"""AST lint for device-kernel hot paths.
+"""DEPRECATION SHIM — the hot-path lint now lives in the sfcheck
+framework as its ``hotpath`` pass (tools/sfcheck/passes/hotpath.py).
 
-Two leak classes have repeatedly cost real debugging time in this repo
-(CLAUDE.md "Environment rules"):
+This module keeps the original CLI and API working unchanged:
 
-1. **Eager ``jax.numpy`` at module scope** in ``ops/``: a module-level
-   ``jnp.foo(...)`` executes at import time — an un-jitted XLA dispatch
-   (~1-2 s compile on this host plus a tunnel round trip on the chip)
-   that re-runs in every process before any kernel is even called.
-   Kernels must stay pure functions; constants belong in plain numpy,
-   device staging belongs to the operators.
-2. **Wall-clock reads inside ``ops/`` functions**: ``time.time()`` and
-   friends inside kernel code do not trace — under ``jax.jit`` the
-   trace-time value is baked into the program and the "timing" measures
-   nothing (the no-op ``block_until_ready`` over the axon tunnel already
-   produced one bogus 106M pts/s number this way). Timing belongs to the
-   host layers (telemetry.py spans, mn/ reporters).
+- ``python tools/lint_hotpath.py [paths…]`` — same defaults, same
+  ``file:line: message`` output, same exit codes (1 on violations);
+- ``lint_source`` / ``lint_file`` / ``lint_paths`` / ``default_target``
+  return the original ``(path, lineno, message)`` tuples;
+- ``# hotpath: ok`` pragmas and the ``ALLOW_FILES`` allowlist are
+  honored (both now implemented by sfcheck).
 
-Run as a CLI (``python tools/lint_hotpath.py [paths…]``; default: the
-repo's ``spatialflink_tpu/ops``) — exit 1 and one ``file:line: message``
-per violation — or through the tier-1 test (tests/test_lint_hotpath.py)
-so leaks fail fast in CI. Suppress a knowingly-host-side line with a
-``# hotpath: ok`` comment; fully host-side modules are allowlisted in
-``ALLOW_FILES`` (ops/counters.py — the documented host-side tally
-registry, never traced).
+Prefer ``python -m tools.sfcheck --pass hotpath`` (or the full analyzer,
+``python -m tools.sfcheck``) for new callers.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
-# Host-side modules inside ops/ that never enter a trace.
-ALLOW_FILES = {"counters.py"}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    # Direct script invocation: make the tools.sfcheck package importable.
+    sys.path.insert(0, _REPO)
 
+from tools.sfcheck import core as _core  # noqa: E402
+from tools.sfcheck.passes.hotpath import HotpathPass  # noqa: E402
+
+_PASS = HotpathPass()
+
+# Back-compat module constants (the implementation now lives on the pass).
+ALLOW_FILES = set(_PASS.allow_basenames)
 PRAGMA = "hotpath: ok"
-
-WALL_CLOCK_FNS = {
-    "time", "time_ns",
-    "perf_counter", "perf_counter_ns",
-    "monotonic", "monotonic_ns",
-    "process_time", "process_time_ns",
-}
 
 Violation = Tuple[str, int, str]  # (path, lineno, message)
 
 
-def _dotted(node: ast.AST):
-    """``a.b.c`` attribute chain → "a.b.c", else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-class _HotpathLinter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str):
-        self.path = path
-        self.lines = source.splitlines()
-        self.violations: List[Violation] = []
-        self._fn_depth = 0
-        # Names bound to the jax.numpy module / to objects imported from it.
-        self._jnp_modules = set()
-        self._jnp_names = set()
-        # Names bound to the time module / wall-clock functions from it.
-        self._time_modules = set()
-        self._time_names = set()
-
-    # -- import tracking ------------------------------------------------------
-
-    def visit_Import(self, node: ast.Import):
-        for alias in node.names:
-            bound = alias.asname or alias.name.split(".")[0]
-            if alias.name == "jax.numpy" and alias.asname:
-                self._jnp_modules.add(alias.asname)
-            elif alias.name == "time":
-                self._time_modules.add(bound)
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom):
-        for alias in node.names:
-            bound = alias.asname or alias.name
-            if node.module == "jax" and alias.name == "numpy":
-                self._jnp_modules.add(bound)
-            elif node.module == "jax.numpy":
-                self._jnp_names.add(bound)
-            elif node.module == "time" and alias.name in WALL_CLOCK_FNS:
-                self._time_names.add(bound)
-        self.generic_visit(node)
-
-    # -- scope tracking -------------------------------------------------------
-    # Decorators and argument defaults execute at DEFINITION time — module
-    # scope for top-level functions — so they are visited at the current
-    # depth; only the body is one level deeper.
-
-    def _visit_function(self, node):
-        for dec in node.decorator_list:
-            self.visit(dec)
-        defaults = list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]
-        for d in defaults:
-            self.visit(d)
-        self._fn_depth += 1
-        for stmt in node.body:
-            self.visit(stmt)
-        self._fn_depth -= 1
-
-    visit_FunctionDef = _visit_function
-    visit_AsyncFunctionDef = _visit_function
-
-    def visit_Lambda(self, node: ast.Lambda):
-        # Lambda defaults execute at definition time, same as def defaults.
-        defaults = list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]
-        for d in defaults:
-            self.visit(d)
-        self._fn_depth += 1
-        self.visit(node.body)
-        self._fn_depth -= 1
-
-    # -- the checks -----------------------------------------------------------
-
-    def _pragma(self, node: ast.AST) -> bool:
-        # A multi-line call is suppressible from ANY of its lines — a
-        # formatter wrapping `x = jnp.full(...)  # hotpath: ok` must not
-        # strand the pragma on a line the check no longer looks at.
-        last = getattr(node, "end_lineno", None) or node.lineno
-        for lineno in range(node.lineno, min(last, len(self.lines)) + 1):
-            if PRAGMA in self.lines[lineno - 1]:
-                return True
-        return False
-
-    def _is_jnp_call(self, func: ast.AST) -> bool:
-        dotted = _dotted(func)
-        if dotted is None:
-            return False
-        root = dotted.split(".")[0]
-        if dotted.startswith("jax.numpy."):
-            return True
-        if root in self._jnp_modules and "." in dotted:
-            return True
-        return dotted in self._jnp_names
-
-    def _is_wall_clock_call(self, func: ast.AST) -> bool:
-        dotted = _dotted(func)
-        if dotted is None:
-            return False
-        parts = dotted.split(".")
-        if (len(parts) == 2 and parts[0] in self._time_modules
-                and parts[1] in WALL_CLOCK_FNS):
-            return True
-        return dotted in self._time_names
-
-    def visit_Call(self, node: ast.Call):
-        if not self._pragma(node):
-            if self._fn_depth == 0 and self._is_jnp_call(node.func):
-                self.violations.append((
-                    self.path, node.lineno,
-                    f"module-level jax.numpy call "
-                    f"`{_dotted(node.func)}(…)` runs eagerly at import "
-                    "(un-jitted XLA dispatch; use numpy for host "
-                    "constants, jit for device code)",
-                ))
-            if self._fn_depth > 0 and self._is_wall_clock_call(node.func):
-                self.violations.append((
-                    self.path, node.lineno,
-                    f"wall-clock call `{_dotted(node.func)}(…)` inside an "
-                    "ops/ function (bakes the trace-time value under jit; "
-                    "time on the host side — telemetry.py spans)",
-                ))
-        self.generic_visit(node)
+def _tuples(findings) -> List[Violation]:
+    return [(f.path, f.lineno, f.message) for f in findings]
 
 
 def lint_source(path: str, source: str) -> List[Violation]:
-    linter = _HotpathLinter(path, source)
-    linter.visit(ast.parse(source, filename=path))
-    return linter.violations
+    return _tuples(_core.check_source(path, source, [_PASS], force=True))
 
 
 def lint_file(path: str) -> List[Violation]:
-    if os.path.basename(path) in ALLOW_FILES:
-        return []
-    with open(path) as f:
-        return lint_source(path, f.read())
+    return _tuples(_core.check_file(path, [_PASS], force=True))
 
 
 def lint_paths(paths) -> List[Violation]:
+    # Original contract: EVERY .py under a given directory is linted —
+    # no scope filtering and none of sfcheck's directory exclusions
+    # (the old walker had neither).
     out: List[Violation] = []
     for p in paths:
         if os.path.isdir(p):
@@ -207,8 +67,7 @@ def lint_paths(paths) -> List[Violation]:
 
 
 def default_target() -> str:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(repo, "spatialflink_tpu", "ops")
+    return os.path.join(_REPO, "spatialflink_tpu", "ops")
 
 
 def main(argv=None) -> int:

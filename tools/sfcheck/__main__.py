@@ -1,0 +1,5 @@
+import sys
+
+from tools.sfcheck.cli import main
+
+sys.exit(main())

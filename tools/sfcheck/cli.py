@@ -1,0 +1,82 @@
+"""sfcheck CLI: ``python -m tools.sfcheck [--pass NAME] [--json] [paths…]``.
+
+No paths → scan the repo's default target set (core.DEFAULT_TARGETS).
+Explicit FILE paths given together with ``--pass`` are force-checked
+regardless of each pass's directory scope (how fixtures and ad-hoc files
+get linted); directories are always scope-filtered.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Human mode prints one
+``path:line: [pass] message`` per finding and nothing when clean (same
+contract as the old lint_hotpath CLI); ``--json`` prints a single object
+with the findings plus a per-pass count breakdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.sfcheck import core
+from tools.sfcheck.passes import ALL_PASSES, PASS_NAMES, get_pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sfcheck",
+        description="multi-pass static analyzer for the kernel/host "
+                    "architecture invariants",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories (default: the repo tree)")
+    ap.add_argument("--pass", dest="pass_names", action="append",
+                    metavar="NAME",
+                    help=f"run only this pass (repeatable; one of: "
+                         f"{', '.join(PASS_NAMES)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output with per-pass counts")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list passes and the invariant each enforces")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.name}: {p.description}")
+            print(f"    invariant: {p.invariant}")
+        return 0
+
+    if args.pass_names:
+        try:
+            passes = [get_pass(n) for n in args.pass_names]
+        except KeyError as e:
+            print(f"sfcheck: {e.args[0]}", file=sys.stderr)
+            return 2
+    else:
+        passes = list(ALL_PASSES)
+
+    targets = args.paths or core.default_targets()
+    report = core.run_paths(
+        targets, passes, force_files=bool(args.pass_names and args.paths)
+    )
+
+    if args.as_json:
+        print(json.dumps({
+            "files": report.files,
+            "counts": report.counts(),
+            "findings": [
+                {"path": f.path, "line": f.lineno, "pass": f.pass_name,
+                 "message": f.message}
+                for f in report.findings
+            ],
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        if report.findings:
+            print(f"sfcheck: {len(report.findings)} finding(s) across "
+                  f"{report.files} file(s)")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,222 @@
+"""sfcheck core — shared walker, pragma suppression, file loading, report.
+
+The framework parses each file ONCE and runs every selected pass over the
+shared AST. Passes are small visitor classes (tools/sfcheck/passes/) that
+return ``(node, message)`` tuples; this module owns everything common:
+
+- **Scoping**: each pass declares ``applies_to(relpath)`` (repo-relative
+  path, or just the basename for files outside the repo). Directory scans
+  always respect scope; explicitly-listed FILES can be force-checked
+  (``force_files=True`` — the CLI does this when ``--pass`` is given, so
+  fixtures and ad-hoc files can be linted regardless of location).
+- **Allowlists**: per-pass ``allow_basenames`` skip fully host-side
+  modules (e.g. ops/counters.py) even under force.
+- **Pragma suppression**: ``# sfcheck: ok`` silences every pass on that
+  line; ``# sfcheck: ok=<pass>[,<pass>…]`` silences only the named
+  pass(es). Anything after the pass list is the human justification —
+  convention: ``# sfcheck: ok=trace-hygiene -- host-side by design``.
+  A finding attached to a multi-line node is suppressed by a pragma on
+  ANY line the node spans (formatter-wrapped calls keep their pragma).
+  Passes may additionally honor a ``legacy_pragma`` regex (hotpath keeps
+  ``# hotpath: ok`` working).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+PRAGMA_RE = re.compile(r"#\s*sfcheck:\s*ok(?:=(?P<passes>[A-Za-z0-9_,\-]+))?")
+
+# Never scanned in directory walks: build trash plus the deliberate-
+# violation corpus (tests/fixtures/sfcheck — loaded explicitly by tests).
+EXCLUDE_DIR_NAMES = {".git", "__pycache__", "artifacts", "native", ".claude"}
+EXCLUDE_REL_PREFIXES = ("tests/fixtures/sfcheck",)
+
+# Scanned by default when the CLI gets no paths: every Python layer the
+# invariants govern (ops/operators/streams/… plus the driver surface,
+# the tools themselves, and the tests — sync-discipline bans
+# block_until_ready there too).
+DEFAULT_TARGETS = (
+    "spatialflink_tpu",
+    "tools",
+    "tests",
+    "bench.py",
+    "bench_suite.py",
+    "__graft_entry__.py",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    lineno: int
+    end_lineno: int
+    pass_name: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.pass_name}] {self.message}"
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    files: int
+    pass_names: List[str]
+
+    def counts(self) -> dict:
+        out = {name: 0 for name in self.pass_names}
+        for f in self.findings:
+            out[f.pass_name] = out.get(f.pass_name, 0) + 1
+        return out
+
+
+class FileContext:
+    """One parsed file shared by every pass."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._bindings = None
+
+    @property
+    def bindings(self):
+        """Import bindings, scanned once and shared by every pass."""
+        if self._bindings is None:
+            from tools.sfcheck.passes._shared import Bindings
+            self._bindings = Bindings.scan(self.tree)
+        return self._bindings
+
+
+class Pass:
+    """Base class for analysis passes (registered in passes/__init__.py)."""
+
+    name: str = ""
+    description: str = ""
+    #: one-line statement of the architecture invariant being enforced
+    invariant: str = ""
+    #: basenames skipped even when force-checked (host-side modules)
+    allow_basenames: frozenset = frozenset()
+    #: extra pragma regex honored besides ``# sfcheck: ok`` (back-compat)
+    legacy_pragma: Optional[re.Pattern] = None
+
+    def applies_to(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def run(self, ctx: FileContext) -> List[Tuple[ast.AST, str]]:
+        raise NotImplementedError
+
+
+def relpath_of(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap == REPO_ROOT or ap.startswith(REPO_ROOT + os.sep):
+        return os.path.relpath(ap, REPO_ROOT).replace(os.sep, "/")
+    return os.path.basename(ap)
+
+
+def _suppressed(p: Pass, ctx: FileContext, node: ast.AST) -> bool:
+    lineno = getattr(node, "lineno", 1)
+    last = getattr(node, "end_lineno", None) or lineno
+    for ln in range(lineno, min(last, len(ctx.lines)) + 1):
+        line = ctx.lines[ln - 1]
+        m = PRAGMA_RE.search(line)
+        if m is not None:
+            names = m.group("passes")
+            if names is None:
+                return True
+            if p.name in {n.strip() for n in names.split(",")}:
+                return True
+        if p.legacy_pragma is not None and p.legacy_pragma.search(line):
+            return True
+    return False
+
+
+def check_source(
+    path: str,
+    source: str,
+    passes: Sequence[Pass],
+    relpath: Optional[str] = None,
+    force: bool = False,
+) -> List[Finding]:
+    relpath = relpath_of(path) if relpath is None else relpath
+    try:
+        ctx = FileContext(path, relpath, source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.lineno or 1, "syntax",
+                        f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+    base = os.path.basename(relpath)
+    for p in passes:
+        if base in p.allow_basenames:
+            continue
+        if not force and not p.applies_to(relpath):
+            continue
+        for node, message in p.run(ctx):
+            if _suppressed(p, ctx, node):
+                continue
+            lineno = getattr(node, "lineno", 1)
+            end = getattr(node, "end_lineno", None) or lineno
+            findings.append(Finding(path, lineno, end, p.name, message))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.pass_name))
+    return findings
+
+
+def check_file(path: str, passes: Sequence[Pass],
+               force: bool = False) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return check_source(path, f.read(), passes, force=force)
+
+
+def iter_python_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in EXCLUDE_DIR_NAMES
+            and not relpath_of(os.path.join(dirpath, d)).startswith(
+                EXCLUDE_REL_PREFIXES)
+        )
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def run_paths(
+    paths: Sequence[str],
+    passes: Optional[Sequence[Pass]] = None,
+    force_files: bool = False,
+) -> Report:
+    """Analyze files/directories. Directories are walked (scope-filtered);
+    explicit file paths are force-checked when ``force_files`` is set."""
+    if passes is None:
+        from tools.sfcheck.passes import ALL_PASSES
+        passes = ALL_PASSES
+    findings: List[Finding] = []
+    files = 0
+    for p in paths:
+        if os.path.isdir(p):
+            for fp in iter_python_files(p):
+                files += 1
+                findings.extend(check_file(fp, passes, force=False))
+        else:
+            files += 1
+            findings.extend(check_file(p, passes, force=force_files))
+    return Report(findings, files, [ps.name for ps in passes])
+
+
+def default_targets() -> List[str]:
+    return [
+        os.path.join(REPO_ROOT, t)
+        for t in DEFAULT_TARGETS
+        if os.path.exists(os.path.join(REPO_ROOT, t))
+    ]

@@ -1,0 +1,28 @@
+"""Pass registry. Adding a pass = write the module, list it here."""
+
+from __future__ import annotations
+
+from tools.sfcheck.passes.fixed_shape import FixedShapePass
+from tools.sfcheck.passes.fstring_numpy import FstringNumpyPass
+from tools.sfcheck.passes.hotpath import HotpathPass
+from tools.sfcheck.passes.sync_discipline import SyncDisciplinePass
+from tools.sfcheck.passes.trace_hygiene import TraceHygienePass
+
+ALL_PASSES = (
+    HotpathPass(),
+    TraceHygienePass(),
+    FixedShapePass(),
+    SyncDisciplinePass(),
+    FstringNumpyPass(),
+)
+
+PASS_NAMES = tuple(p.name for p in ALL_PASSES)
+
+
+def get_pass(name: str):
+    for p in ALL_PASSES:
+        if p.name == name:
+            return p
+    raise KeyError(
+        f"unknown pass {name!r} (known: {', '.join(PASS_NAMES)})"
+    )

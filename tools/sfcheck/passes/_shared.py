@@ -1,0 +1,171 @@
+"""Shared AST helpers for sfcheck passes: import-binding resolution and a
+definition-time-aware scope visitor.
+
+``Bindings`` answers "what does this call resolve to?" for the handful of
+libraries the invariants talk about (jax, jax.numpy, numpy, time) under
+every import spelling used in this repo (``import jax.numpy as jnp``,
+``from jax import numpy as jn``, ``from jax.numpy import full``, aliases).
+
+``ScopedVisitor`` replicates Python's definition-time evaluation rules:
+decorators and argument defaults of a ``def``/``lambda`` execute in the
+ENCLOSING scope, only the body is one function level deeper. Annotations
+are not executed code paths here and are skipped. It also tracks the
+parameter names of every enclosing function so passes can ask whether a
+bare name is (possibly) a traced kernel argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+WALL_CLOCK_FNS = {
+    "time", "time_ns",
+    "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns",
+    "process_time", "process_time_ns",
+}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain → "a.b.c", else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Bindings:
+    """Names bound to the modules/functions the invariants care about."""
+
+    def __init__(self):
+        self.jnp_modules = set()   # names bound to the jax.numpy module
+        self.jnp_funcs = {}        # local name -> jax.numpy attribute
+        self.np_modules = set()    # names bound to the numpy module
+        self.np_funcs = {}         # local name -> numpy attribute
+        self.jax_modules = set()   # names bound to the jax module
+        self.jax_funcs = {}        # local name -> jax attribute
+        self.time_modules = set()
+        self.time_funcs = {}       # local name -> time-module function
+
+    @classmethod
+    def scan(cls, tree: ast.AST) -> "Bindings":
+        b = cls()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "jax.numpy" and alias.asname:
+                        b.jnp_modules.add(alias.asname)
+                    elif alias.name == "jax":
+                        b.jax_modules.add(bound)
+                    elif alias.name == "numpy":
+                        b.np_modules.add(bound)
+                    elif alias.name == "time":
+                        b.time_modules.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if node.module == "jax" and alias.name == "numpy":
+                        b.jnp_modules.add(bound)
+                    elif node.module == "jax":
+                        b.jax_funcs[bound] = alias.name
+                    elif node.module == "jax.numpy":
+                        b.jnp_funcs[bound] = alias.name
+                    elif node.module == "numpy":
+                        b.np_funcs[bound] = alias.name
+                    elif (node.module == "time"
+                          and alias.name in WALL_CLOCK_FNS):
+                        b.time_funcs[bound] = alias.name
+        return b
+
+    def _module_call(self, func, modules, funcs, prefix=None):
+        d = dotted(func)
+        if d is None:
+            return None
+        if prefix is not None and d.startswith(prefix + "."):
+            return d[len(prefix) + 1:]
+        root, _, rest = d.partition(".")
+        if root in modules and rest:
+            return rest
+        if d in funcs:
+            return funcs[d]
+        return None
+
+    def jnp_call(self, func) -> Optional[str]:
+        """jax.numpy attribute name if the call resolves there, else None."""
+        got = self._module_call(func, self.jnp_modules, self.jnp_funcs,
+                                prefix="jax.numpy")
+        if got is not None:
+            return got
+        # jax-module spellings: jax.numpy.foo via a jax alias (import jax
+        # as J; J.numpy.foo).
+        via_jax = self._module_call(func, self.jax_modules, {})
+        if via_jax is not None and via_jax.startswith("numpy."):
+            return via_jax[len("numpy."):]
+        return None
+
+    def np_call(self, func) -> Optional[str]:
+        return self._module_call(func, self.np_modules, self.np_funcs)
+
+    def jax_call(self, func) -> Optional[str]:
+        return self._module_call(func, self.jax_modules, self.jax_funcs,
+                                 prefix="jax")
+
+    def wall_clock_call(self, func) -> Optional[str]:
+        d = dotted(func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if (len(parts) == 2 and parts[0] in self.time_modules
+                and parts[1] in WALL_CLOCK_FNS):
+            return parts[1]
+        return self.time_funcs.get(d)
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor with definition-time scope semantics and param tracking."""
+
+    def __init__(self):
+        self.fn_depth = 0
+        self._param_stack = []
+        self.out = []  # (node, message) tuples collected by subclasses
+
+    def is_param(self, name: str) -> bool:
+        return any(name in s for s in self._param_stack)
+
+    @staticmethod
+    def _arg_names(args: ast.arguments) -> frozenset:
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return frozenset(names)
+
+    def _visit_function(self, node):
+        # Decorators and defaults execute at DEFINITION time — the
+        # enclosing scope — so they are visited at the current depth;
+        # only the body is one level deeper.
+        for dec in getattr(node, "decorator_list", []):
+            self.visit(dec)
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            self.visit(d)
+        self.fn_depth += 1
+        self._param_stack.append(self._arg_names(node.args))
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            self.visit(stmt)
+        self._param_stack.pop()
+        self.fn_depth -= 1
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+    visit_Lambda = _visit_function

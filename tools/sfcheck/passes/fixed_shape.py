@@ -1,0 +1,128 @@
+"""fixed-shape pass — mask-don't-compact inside ops/.
+
+Invariant (CLAUDE.md "Architecture invariants"): kernels are fixed-shape;
+padding must never change results, and every shape must be static under
+jit/vmap/shard_map. Data-dependent-shape ops either fail to trace or
+force a recompile per distinct count:
+
+- ``jnp.nonzero`` / ``jnp.flatnonzero`` / ``jnp.argwhere`` /
+  ``jnp.unique`` without a static ``size=``;
+- single-argument ``jnp.where(mask)`` (the nonzero spelling);
+- ``jnp.compress`` / ``jnp.extract`` (no fixed-shape form exists);
+- boolean-mask subscripts, inline (``x[y > 0]``) or through a name the
+  file assigns a syntactically-obvious mask (``mask = y > 0; x[mask]``).
+  ``x.at[mask].set(…)`` is exempt — a shape-PRESERVING masked update,
+  not a compaction.
+
+The sanctioned pattern is the repo's compaction idiom:
+``jnp.nonzero(mask, size=budget, fill_value=sentinel)`` with an overflow
+count (see ops/join.py, ops/range.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.sfcheck.core import Pass
+from tools.sfcheck.passes._shared import Bindings, dotted
+
+_SIZEABLE = {"nonzero", "flatnonzero", "argwhere", "unique"}
+_NO_FIXED_FORM = {"compress", "extract"}
+
+
+def _is_boolean_mask(node) -> bool:
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.BoolOp):
+        return all(_is_boolean_mask(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _is_boolean_mask(node.operand)
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitAnd, ast.BitOr)):
+        return (_is_boolean_mask(node.left)
+                or _is_boolean_mask(node.right))
+    return False
+
+
+def _mask_names(tree) -> set:
+    """Names assigned a syntactically-obvious boolean mask anywhere in the
+    file (``mask = d < r``, ``ok = valid & (d < r)``) — coarse, file-wide
+    dataflow so ``x[mask]`` is caught, not just inline ``x[d < r]``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_boolean_mask(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, bindings: Bindings, mask_names: set):
+        self.b = bindings
+        self.mask_names = mask_names
+        self.out = []
+
+    def visit_Call(self, node):
+        name = self.b.jnp_call(node.func)
+        if name is not None:
+            has_size = any(kw.arg == "size" for kw in node.keywords)
+            if name in _SIZEABLE and not has_size:
+                self.out.append((
+                    node,
+                    f"`{dotted(node.func)}(…)` without `size=` has a "
+                    "data-dependent output shape — mask-don't-compact: "
+                    "pass size=/fill_value= with an overflow count "
+                    "(ops/join.py idiom)",
+                ))
+            elif (name == "where" and len(node.args) == 1
+                    and not any(kw.arg in ("x", "y") for kw in node.keywords)):
+                self.out.append((
+                    node,
+                    "single-argument `jnp.where(mask)` is the nonzero "
+                    "spelling — data-dependent output shape; use the "
+                    "three-argument select or nonzero with size=",
+                ))
+            elif name in _NO_FIXED_FORM:
+                self.out.append((
+                    node,
+                    f"`{dotted(node.func)}(…)` has no fixed-shape form "
+                    "— data-dependent output shape; mask and reduce "
+                    "instead of compacting",
+                ))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # x.at[mask].set(...) is the sanctioned shape-PRESERVING masked
+        # update, not a compaction — never flag the .at indexer.
+        is_at = (isinstance(node.value, ast.Attribute)
+                 and node.value.attr == "at")
+        masked = _is_boolean_mask(node.slice) or (
+            isinstance(node.slice, ast.Name)
+            and node.slice.id in self.mask_names
+        )
+        if masked and not is_at:
+            self.out.append((
+                node,
+                "boolean-mask subscript compacts to a data-dependent "
+                "shape — mask-don't-compact: select with jnp.where / "
+                "masked reductions instead",
+            ))
+        self.generic_visit(node)
+
+
+class FixedShapePass(Pass):
+    name = "fixed-shape"
+    description = ("no data-dependent-shape ops in ops/ (nonzero/where/"
+                   "unique without size=, compress, boolean masks)")
+    invariant = ("kernels are fixed-shape and mask-don't-compact; "
+                 "padding never changes results")
+    allow_basenames = frozenset({"counters.py"})
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("spatialflink_tpu/ops/")
+
+    def run(self, ctx):
+        v = _Visitor(ctx.bindings, _mask_names(ctx.tree))
+        v.visit(ctx.tree)
+        return v.out

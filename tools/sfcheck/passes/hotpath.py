@@ -1,0 +1,62 @@
+"""hotpath pass — the original tools/lint_hotpath.py rules, migrated.
+
+Invariant (CLAUDE.md "Environment rules"): kernels in ``ops/`` are pure
+functions. Two leak classes repeatedly cost real debugging time:
+
+1. **Eager jax.numpy at module scope**: a module-level ``jnp.foo(...)``
+   is an un-jitted XLA dispatch (~1-2 s compile here plus a tunnel round
+   trip on the chip) re-run in every process at import. Constants belong
+   in plain numpy; device staging belongs to the operators.
+2. **Wall-clock reads inside ops/ functions**: under ``jax.jit`` the
+   trace-time value is baked into the program and the "timing" measures
+   nothing (this produced one bogus 106M pts/s number). Timing belongs
+   to the host layers (telemetry.py spans, mn/ reporters).
+"""
+
+from __future__ import annotations
+
+import re
+
+from tools.sfcheck.core import Pass
+from tools.sfcheck.passes._shared import Bindings, ScopedVisitor, dotted
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, bindings: Bindings):
+        super().__init__()
+        self.b = bindings
+
+    def visit_Call(self, node):
+        if self.fn_depth == 0 and self.b.jnp_call(node.func) is not None:
+            self.out.append((
+                node,
+                f"module-level jax.numpy call `{dotted(node.func)}(…)` "
+                "runs eagerly at import (un-jitted XLA dispatch; use "
+                "numpy for host constants, jit for device code)",
+            ))
+        if self.fn_depth > 0 and self.b.wall_clock_call(node.func) is not None:
+            self.out.append((
+                node,
+                f"wall-clock call `{dotted(node.func)}(…)` inside an "
+                "ops/ function (bakes the trace-time value under jit; "
+                "time on the host side — telemetry.py spans)",
+            ))
+        self.generic_visit(node)
+
+
+class HotpathPass(Pass):
+    name = "hotpath"
+    description = ("no import-time jax.numpy dispatch; no wall-clock "
+                   "reads inside ops/ functions")
+    invariant = ("ops/ kernels are pure: device work only under jit, "
+                 "timing only on the host")
+    allow_basenames = frozenset({"counters.py"})
+    legacy_pragma = re.compile(r"#\s*hotpath:\s*ok\b")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("spatialflink_tpu/ops/")
+
+    def run(self, ctx):
+        v = _Visitor(ctx.bindings)
+        v.visit(ctx.tree)
+        return v.out

@@ -1,0 +1,57 @@
+"""sync-discipline pass — ban ``jax.block_until_ready`` outside telemetry.py.
+
+Invariant (CLAUDE.md "Environment rules"): ``jax.block_until_ready`` is a
+NO-OP over the axon tunnel — it returns before transfers/compute finish.
+The only true synchronization is a real device→host fetch
+(``jax.device_get`` / ``np.asarray`` / ``telemetry.fetch``). A "sync"
+that doesn't fetch measures nothing and pushes its cost into the NEXT
+measurement (the bogus 106M pts/s bug). The ban covers everything —
+bench.py, the driver entry, and the tests — except
+``spatialflink_tpu/telemetry.py``, the one module allowed to talk about
+sync primitives directly.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.sfcheck.core import Pass
+from tools.sfcheck.passes._shared import Bindings
+
+_MSG = (
+    "`block_until_ready` is a NO-OP over the axon tunnel (returns before "
+    "transfers finish) — use a real device→host fetch for true sync: "
+    "jax.device_get / np.asarray / telemetry.fetch"
+)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, bindings: Bindings):
+        self.b = bindings
+        self.out = []
+
+    def visit_Call(self, node):
+        if self.b.jax_call(node.func) == "block_until_ready":
+            self.out.append((node, _MSG))
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            # Method form: arr.block_until_ready()
+            self.out.append((node, _MSG))
+        self.generic_visit(node)
+
+
+class SyncDisciplinePass(Pass):
+    name = "sync-discipline"
+    description = ("no jax.block_until_ready anywhere outside "
+                   "spatialflink_tpu/telemetry.py")
+    invariant = ("true sync is a device→host fetch; block_until_ready "
+                 "is a no-op over the axon tunnel")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath not in ("spatialflink_tpu/telemetry.py",
+                               "telemetry.py")
+
+    def run(self, ctx):
+        v = _Visitor(ctx.bindings)
+        v.visit(ctx.tree)
+        return v.out
