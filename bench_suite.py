@@ -861,7 +861,7 @@ def bench_tjoin_panes(jax, jnp, grid, quick):
         from spatialflink_tpu.ops.tjoin_panes import pane_cell_ranks
 
         pane_of = np.repeat(np.arange(total_slides), slide_pts)
-        rank = pane_cell_ranks(pane_of, cell)
+        rank = pane_cell_ranks(pane_of, cell, valid=ing)
         host = (
             cxy[:, 0].astype(f32), cxy[:, 1].astype(f32),
             xi.astype(np.int32), yi.astype(np.int32), cell,
@@ -876,29 +876,53 @@ def bench_tjoin_panes(jax, jnp, grid, quick):
             pane_of[m].astype(np.int32), host[0][m].astype(np.float64),
             host[1][m].astype(np.float64), cell[m], oid[m],
         )
-        return dev_fields, nat
+        return dev_fields, nat, (pane_of[m].astype(np.int64), cell[m])
 
-    lp, lnat = mk_panes(0.0)
-    rp, rnat = mk_panes(0.0)
+    lp, lnat, locc = mk_panes(0.0)
+    rp, rnat, rocc = mk_panes(0.0)
     ts_all = jnp.arange(total_slides, dtype=jnp.int32)
     scan = jitted(
         tjoin_pane_scan,
         "grid_n", "cap_w", "layers", "ppw", "num_ids", "pair_sel",
+        "cap_c",
     )
+    # Live-slot compaction: the host picks the bucketed probe capacity
+    # from the exact per-cell window occupancy (ops/compaction.py); the
+    # resident column measures the engine run_soa_panes(backend='auto')
+    # actually ships on this platform — compacted off-TPU, full-ring on
+    # TPU (the row-gather/one-hot form).
+    from spatialflink_tpu.ops.compaction import (
+        compact_probe_preferred,
+        max_window_cell_count,
+        pick_capacity,
+    )
+
+    cap_c = 0
+    if compact_probe_preferred():
+        occ = max(max_window_cell_count(*locc, ppw),
+                  max_window_cell_count(*rocc, ppw))
+        cap_c = pick_capacity(occ, cap_w)
     statics = dict(
         grid_n=grid.n, cap_w=cap_w, layers=grid.candidate_layers(float(radius)),
-        ppw=ppw, num_ids=n_obj, pair_sel=16,
+        ppw=ppw, num_ids=n_obj, pair_sel=16, cap_c=cap_c,
     )
 
     def part(fields, lo, hi):
         return tuple(f[lo:hi] for f in fields)
 
+    # The steady scan continues the warm carry, so the panes expiring
+    # during it (slides 0..S) come from the WARM batch — sliced
+    # explicitly (tjoin_pane_scan's default zero-fill shift is only
+    # valid when a scan's own slides are the whole ring history).
+    lxp = (lp[4][:S], lp[7][:S])
+    rxp = (rp[4][:S], rp[7][:S])
     carry0 = tjoin_pane_init(grid.num_cells, cap_w, ppw, n_obj, jnp.float32)
     warm, _ = scan(carry0, ts_all[:ppw], part(lp, 0, ppw), part(rp, 0, ppw),
                    radius, **statics)
     # compile the timed shape too (S ≠ ppw ⇒ distinct executable)
     wtest, wm = scan(warm, ts_all[ppw:], part(lp, ppw, total_slides),
-                     part(rp, ppw, total_slides), radius, **statics)
+                     part(rp, ppw, total_slides), radius,
+                     lps_expire=lxp, rps_expire=rxp, **statics)
     jax.device_get((wtest.cap_overflow, wtest.sel_overflow, wm[-1]))
 
     times = []
@@ -907,20 +931,24 @@ def bench_tjoin_panes(jax, jnp, grid, quick):
         t0 = time.perf_counter()
         fin, wmins = scan(
             warm, ts_all[ppw:], part(lp, ppw, total_slides),
-            part(rp, ppw, total_slides), radius, **statics,
+            part(rp, ppw, total_slides), radius,
+            lps_expire=lxp, rps_expire=rxp, **statics,
         )
         got = jax.device_get(
-            (fin.cap_overflow, fin.sel_overflow, wmins[-1])
+            (fin.cap_overflow, fin.sel_overflow, fin.cmp_overflow,
+             wmins[-1])
         )
         times.append(time.perf_counter() - t0)
-    cap_over, sel_over, last = got
+    cap_over, sel_over, cmp_over, last = got
     pairs_last = int(np.isfinite(last).sum())
     assert int(cap_over) == 0, f"window ring overflow {int(cap_over)}"
     assert int(sel_over) == 0, f"pair_sel overflow {int(sel_over)}"
+    assert int(cmp_over) == 0, f"live-slot bucket overflow {int(cmp_over)}"
     dt = float(np.median(times))
     n_pts = 2 * slide_pts * S
     resident = (n_pts / dt, n_pts / max(times), n_pts / min(times))
-    extra = {"ppw": ppw, "traj_pairs_last": pairs_last, "engine": "device"}
+    extra = {"ppw": ppw, "traj_pairs_last": pairs_last, "engine": "device",
+             "cap_c": cap_c}
     spread = (min(times), max(times))
 
     from spatialflink_tpu import native as _native

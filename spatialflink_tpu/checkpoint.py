@@ -147,6 +147,10 @@ def operator_state(op) -> Dict[str, Any]:
                 (np.asarray(s), np.asarray(r))
                 for s, r in wire_pane["digests"]
             ],
+            # per-pane event counts — gap-window suppression state
+            "counts": [int(c) for c in wire_pane.get(
+                "counts", [1] * len(wire_pane["digests"])
+            )],
         }
     jcarry = getattr(op, "_join_pane_carry", None)
     if jcarry is not None:  # join query_panes pane events + pair blocks
@@ -206,6 +210,10 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
             "digests": [
                 (s, r) for s, r in state["knn_wire_pane_carry"]["digests"]
             ],
+            "counts": [int(c) for c in state["knn_wire_pane_carry"].get(
+                "counts",
+                [1] * len(state["knn_wire_pane_carry"]["digests"]),
+            )],
         }
         # Consumed by the NEXT run_wire_panes call only — the
         # index-based carry must never leak into an ordinary fresh run.

@@ -303,6 +303,12 @@ class _PointStreamKNNQuery(SpatialOperator):
                     continue
                 with telemetry.span("pane.digest", pane=ps, events=len(evs)):
                     batch = self.point_batch(evs)
+                    # pane-capacity bucket occupancy → telemetry (the
+                    # same per-bucket log the wire path and the tJoin
+                    # compaction planner feed — ops/compaction.py)
+                    telemetry.record_compaction(
+                        "knn_pane_digest", batch.capacity, len(evs)
+                    )
                     nseg = next_bucket(
                         max(self.interner.num_segments, 1), minimum=64
                     )
@@ -622,13 +628,19 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         from any SoA chunk stream, e.g. the native CSV parser's arrays
         or a batched Kafka consumer). Pane i covers
         [start_ms + i·slide, start_ms + (i+1)·slide); every window
-        OVERLAPPING a received pane fires — including the leading
-        partial windows (negative-offset starts, matching
+        OVERLAPPING a received NON-EMPTY pane fires — including the
+        leading partial windows (negative-offset starts, matching
         run_soa_panes's earliest_window_of semantics) and, with
-        ``flush_at_end``, the trailing partials — yielding ``run_soa``'s
-        (start, end, oids, dists, num_valid) contract. Variable pane
-        sizes share one compiled step via bucket padding + an
-        ``n_valid`` mask (padding can never match — parity-tested).
+        ``flush_at_end``, the trailing partials. Windows whose every
+        pane held zero events (gap windows — the assembler on the SoA
+        path never builds them) are suppressed, so the window SET
+        equals run_soa_panes's exactly (tests/test_wire_knn.py pins set
+        equality), yielding ``run_soa``'s (start, end, oids, dists,
+        num_valid) contract. Variable pane sizes share one compiled
+        step via ladder-bucketed padding (ops/compaction.py:
+        wire_pane_bucket — the digest scans O(pane-rounded-up) lanes,
+        each pick recorded per bucket in telemetry) + an ``n_valid``
+        mask (padding can never match — parity-tested).
 
         ``strategy``: 'auto' adopts the fused Pallas extraction on TPU
         only after a first-pane self-check against the XLA step (set
@@ -638,6 +650,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
         on ``self.last_wire_digest_kind``.
         """
         from spatialflink_tpu.operators.query_config import QueryType
+        from spatialflink_tpu.ops.compaction import wire_pane_bucket
         from spatialflink_tpu.ops.knn import knn_merge_digest_list
         from spatialflink_tpu.ops.wire_knn import select_wire_digest_step
 
@@ -689,17 +702,32 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             digests = [
                 (jnp.asarray(s), jnp.asarray(r)) for s, r in saved["digests"]
             ]
+            # Pre-counts snapshots lack the event-count ring: assume the
+            # carried panes were non-empty (fire conservatively — the
+            # old every-window-fires behavior for exactly those panes).
+            counts = [int(c) for c in saved.get(
+                "counts", [1] * len(digests)
+            )]
         else:
             pane0 = 0
             # Seed the ring with ppw-1 empty digests so the LEADING
             # partial windows fire (run_soa_panes parity: its assembler
             # starts at earliest_window_of the first event).
             digests = [empty] * (ppw - 1)
+            counts = [0] * (ppw - 1)
         self._wire_pane_carry = {
             "next_pane": pane0, "digests": list(digests),
+            "counts": list(counts),
         }
 
         def fire(pane_i):
+            # Gap-window suppression: a window none of whose panes held
+            # an event does not exist on the SoA path (the assembler
+            # only builds windows containing events) — skip it here
+            # too. Event count, NOT digest liveness, decides: a window
+            # of events all out of radius still fires (nv = 0).
+            if not any(counts):
+                return None
             res = merge(
                 tuple(s for s, _ in digests),
                 tuple(r for _, r in digests), no_bases, k=k,
@@ -720,7 +748,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 )
             n = wire_p.shape[1]
             check_oid_range(wire_p[2].view(np.int16), num_segments)
-            nb = next_bucket(max(n, 1), minimum=128)
+            nb = wire_pane_bucket(n)
             if nb != n:
                 wire_p = np.concatenate(
                     [wire_p, np.zeros((3, nb - n), np.uint16)], axis=1
@@ -737,10 +765,15 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             d = jstep(wire_d, jnp.int32(n), q, scale, origin, r32)
             digests.append((d.seg_min, d.rep))
             del digests[:-ppw]
+            counts.append(n)
+            del counts[:-ppw]
             self._wire_pane_carry = {
                 "next_pane": i + 1, "digests": list(digests),
+                "counts": list(counts),
             }
-            yield fire(i)
+            out = fire(i)
+            if out is not None:
+                yield out
         # Flush iff ≥1 REAL pane exists in the logical stream: consumed
         # this call (i advanced past pane0-1) or before the checkpoint
         # (pane0 > 0). A restore taken before any pane must NOT flush —
@@ -750,7 +783,11 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             for j in range(1, ppw):
                 digests.append(empty)
                 del digests[:-ppw]
-                yield fire(i + j)
+                counts.append(0)
+                del counts[:-ppw]
+                out = fire(i + j)
+                if out is not None:
+                    yield out
 
 
 class PointPolygonKNNQuery(_PointStreamKNNQuery):

@@ -490,6 +490,7 @@ class TJoinQuery(SpatialOperator):
         dtype=np.float64,
         mesh=None,
         backend: str = "auto",
+        cap_c: Optional[int] = None,
     ):
         """Extreme-overlap sliding tJoin via the device pane-carry engine
         (ops/tjoin_panes.py): window state lives ON DEVICE in ring-buffer
@@ -522,6 +523,19 @@ class TJoinQuery(SpatialOperator):
         force a path (forced-native raises if the library is missing —
         never silently measures the other engine). Native min-distances
         match the x64 device engine to 1e-12 (FMA contraction freedom).
+
+        ``cap_c``: the device scan's live-slot probe capacity
+        (ops/tjoin_panes.py compacted probe). Default None lets the
+        host control plane pick the bucket: exact per-cell window
+        occupancy (ops/compaction.py:max_window_cell_count) → smallest
+        capacity-ladder rung, recorded in telemetry — the scan then
+        probes O(live-rounded-up) slots per neighbor cell instead of
+        O(cap_w), compiling at most ladder-many (≤6) programs across
+        any occupancy mix. 0 forces the full-ring probe (the
+        TPU-preferred form and the compaction parity oracle); an
+        explicit positive value seeds the ladder but the cmp_overflow
+        retry still climbs it if the pick was too small — exactness
+        always wins over a forced bucket.
         """
         from spatialflink_tpu.operators.base import check_oid_range, jitted
         from spatialflink_tpu.ops.tjoin_panes import (
@@ -640,9 +654,11 @@ class TJoinQuery(SpatialOperator):
                 from spatialflink_tpu.ops.tjoin_panes import pane_cell_ranks
 
                 frank[pane_s, lane] = pane_cell_ranks(
-                    pane_s, cell[order]
+                    pane_s, cell[order], valid=ing[order]
                 ).astype(np.int32)
-            return (fx, fy, fxi, fyi, fcell, frank, fo, fv), counts
+            ing_s = ing[order]
+            occ_in = (pane_s[ing_s], cell[order][ing_s])
+            return (fx, fy, fxi, fyi, fcell, frank, fo, fv), counts, occ_in
 
         if backend not in ("auto", "device", "native"):
             raise ValueError(f"unknown tjoin panes backend {backend!r}")
@@ -671,9 +687,36 @@ class TJoinQuery(SpatialOperator):
                 use_native = native_ok and not _device_backend_preferred()
 
         with_ranks = not use_native
-        lfields, lcounts = pane_fields(lt, lx, ly, lo)
-        rfields, rcounts = pane_fields(rt, rx, ry, ro)
+        lfields, lcounts, locc_in = pane_fields(lt, lx, ly, lo)
+        rfields, rcounts, rocc_in = pane_fields(rt, rx, ry, ro)
         layers = g.candidate_layers(radius)
+
+        occ = None
+        if not use_native:
+            from spatialflink_tpu.ops.compaction import (
+                compact_probe_preferred,
+                max_window_cell_count,
+                pick_capacity,
+            )
+
+            if cap_c is None:
+                if compact_probe_preferred():
+                    # Host control plane: exact live-occupancy bound →
+                    # ladder rung. Reading the live counts here is the
+                    # point — the device program only ever sees the
+                    # static bucket.
+                    with telemetry.span("compaction.plan",
+                                        engine="tjoin_pane_scan"):
+                        occ = max(
+                            max_window_cell_count(*locc_in, ppw),
+                            max_window_cell_count(*rocc_in, ppw),
+                        )
+                        cap_c = pick_capacity(occ, cap_w)
+                    telemetry.record_compaction(
+                        "tjoin_pane_scan", cap_c, occ
+                    )
+                else:
+                    cap_c = 0  # full-ring row-gather probe (TPU form)
 
         if use_native:
             def flat(fields):
@@ -695,7 +738,7 @@ class TJoinQuery(SpatialOperator):
         scan = jitted(
             tjoin_pane_scan,
             "grid_n", "cap_w", "layers", "ppw", "num_ids", "pair_sel",
-            "mesh",
+            "cap_c", "mesh",
         )
         while wmins is None:  # device engine + overflow retry
             carry = tjoin_pane_init(
@@ -713,19 +756,35 @@ class TJoinQuery(SpatialOperator):
                 tuple(jnp.asarray(a) for a in rfields),
                 radius,
                 grid_n=g.n, cap_w=cap_w, layers=layers, ppw=ppw,
-                num_ids=num_segments, pair_sel=pair_sel, mesh=mesh,
+                num_ids=num_segments, pair_sel=pair_sel, cap_c=cap_c,
+                mesh=mesh,
             )
             cap_over = int(final.cap_overflow)
             sel_over = int(final.sel_overflow)
-            if cap_over == 0 and sel_over == 0:
+            cmp_over = int(final.cmp_overflow)
+            if cap_over == 0 and sel_over == 0 and cmp_over == 0:
                 break
             # Bounded-stream retry: grow whichever budget overflowed and
             # re-scan (same idiom as the pruned joins' _pruned_block_pairs).
             wmins = None  # this scan's output is inexact — re-scan
             if cap_over:
                 cap_w *= 2
+                if occ is not None:  # ladder re-pick under the new cap
+                    cap_c = pick_capacity(occ, cap_w)
             if sel_over:
                 pair_sel *= 2
+            if cmp_over and cap_c:
+                # A probed cell held more live points than the bucket
+                # (only reachable with a forced/stale cap_c — the
+                # host-planned pick is exact): climb the ladder. The
+                # true occupancy was never measured, only that it
+                # exceeded the old rung — record that LOWER BOUND, not
+                # a fabricated live count (code review).
+                live_floor = cap_c + 1
+                cap_c = min(max(cap_c * 2, cap_c + 1), cap_w)
+                telemetry.record_compaction(
+                    "tjoin_pane_scan", cap_c, live_floor
+                )
 
         wmins = np.asarray(wmins)  # (S, K²)
         # Rolling per-side window event counts decide which windows fire.

@@ -26,11 +26,33 @@ slide:
   pane t. All of it is one ``lax.scan`` step — one dispatch per BATCH
   of slides, not per slide (the tunnel-dispatch lesson, CLAUDE.md).
 
+- **Live-slot compaction** (``cap_c > 0``, the default off-TPU): the
+  ring with lazy expiry is a per-cell FIFO — points insert in pane
+  order and expire in pane order — so the LIVE slots of a cell row are
+  always the contiguous ``[cursor - live, cursor)`` range (mod capW).
+  The carry maintains per-cell live counts (two tiny scatter-adds per
+  slide: subtract the expiring pane, add the new one), and the probe
+  gathers only ``cap_c`` lanes from each neighbor cell's head instead
+  of the full ``capW`` ring row, masking by POSITION (lane < live)
+  instead of gathering and comparing pane tags. ``cap_c`` is a static
+  bucket from the host-picked capacity ladder (ops/compaction.py — the
+  host reads the live counts, the device program stays fixed-shape per
+  bucket, ≤6 programs per engine), and first-``pair_sel`` selection is
+  the sort-free prefix-sum binary search (ops/select.py:
+  first_k_prefix_indices) — together they removed the ``lax.top_k``
+  full sort and the dead-slot gathers that made the XLA:CPU scan ~50×
+  slower than the native engine (VERDICT r5 advice #4). ``cap_c = 0``
+  keeps the original full-ring row-gather probe (the TPU-preferred
+  form, and the parity oracle for the compacted path).
+
 Exactness contract (same family as the other join kernels): results
 equal ``run_soa`` iff ``cap_overflow == 0`` (a live window slot was
-never overwritten — grow ``capW``) and ``sel_overflow == 0`` (no probe
+never overwritten — grow ``capW``), ``sel_overflow == 0`` (no probe
 point matched more than ``pair_sel`` window points — grow
-``pair_sel``). Digest memory is ``ppw · K² · 4`` bytes (K = interned
+``pair_sel``) and ``cmp_overflow == 0`` (no PROBED cell held more than
+``cap_c`` live points — climb the capacity ladder; never fires when the
+host planned ``cap_c`` from ops/compaction.py:max_window_cell_count).
+Digest memory is ``ppw · K² · 4`` bytes (K = interned
 trajectory ids per side): extreme overlap trades memory for the 1000×
 work cut, sized for the domain's dozens-to-hundreds of vehicles.
 """
@@ -44,16 +66,32 @@ import jax.numpy as jnp
 
 import numpy as np
 
-from spatialflink_tpu.ops.select import first_k_onehot, onehot_select_preferred
+from spatialflink_tpu.ops.select import (
+    first_k_onehot,
+    first_k_prefix_indices,
+    onehot_select_preferred,
+)
 
 
-def pane_cell_ranks(pane: "np.ndarray", cell: "np.ndarray") -> "np.ndarray":
+def pane_cell_ranks(pane: "np.ndarray", cell: "np.ndarray",
+                    valid: "np.ndarray" = None) -> "np.ndarray":
     """Within-(pane, cell) slot ranks, vectorized — the host half of
     ``_insert``'s ring-slot contract (a pane's same-cell points need
     distinct slots). ONE home, shared by the operator wrapper and the
     benchmark staging (drift here would silently change collision
-    behavior between the product path and the measured path)."""
+    behavior between the product path and the measured path).
+
+    ``valid``: rank INVALID (out-of-grid) events in their own group, not
+    the cell their placeholder id aliases. ``_insert`` drops invalid
+    points and advances the cursor only by the valid count, so a valid
+    point whose rank counted a preceding invalid same-cell event would
+    land BEYOND the cursor — outside the ``[cursor - live, cursor)``
+    range the compacted probe treats as the live slots (a silent missed
+    pair; the full-ring probe's tag scan was immune, which is why this
+    stayed latent until the positional probe — code review)."""
     n = len(pane)
+    if valid is not None:
+        cell = np.where(valid, cell, -1)
     order = np.lexsort((cell, pane))
     ps, cs = pane[order], cell[order]
     newrun = np.ones(n, bool)
@@ -72,15 +110,18 @@ class TJoinPaneCarry(NamedTuple):
     lwoid: jnp.ndarray  # int32
     lwtag: jnp.ndarray  # int32 pane index, very negative = empty
     lwcur: jnp.ndarray  # (cells,) int32 ring cursor
+    lwlive: jnp.ndarray  # (cells,) int32 unexpired points in the ring
     rwx: jnp.ndarray
     rwy: jnp.ndarray
     rwoid: jnp.ndarray
     rwtag: jnp.ndarray
     rwcur: jnp.ndarray
+    rwlive: jnp.ndarray
     digests: jnp.ndarray  # (ppw, K*K) min-pane-indexed pair min dists
     block_digests: jnp.ndarray  # (ppw/bs, K*K) per-block mins of `digests`
     cap_overflow: jnp.ndarray  # () int32
     sel_overflow: jnp.ndarray  # () int32
+    cmp_overflow: jnp.ndarray  # () int32 — probed cell live > cap_c
 
 
 def block_size(ppw: int) -> int:
@@ -114,11 +155,21 @@ def tjoin_pane_init(
     inf = jnp.asarray(jnp.inf, dtype)
     bs = block_size(ppw)
     return TJoinPaneCarry(
-        plane_f, plane_f, plane_i, tags, cur,
-        plane_f, plane_f, plane_i, tags, cur,
+        plane_f, plane_f, plane_i, tags, cur, cur,
+        plane_f, plane_f, plane_i, tags, cur, cur,
         jnp.full((ppw, num_ids * num_ids), inf, dtype),
         jnp.full((ppw // bs, num_ids * num_ids), inf, dtype),
         jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+        jnp.zeros((), jnp.int32),
+    )
+
+
+def _cell_counts(live, pcell, pvalid, num_cells: int, sign: int):
+    """live ± per-cell count of one pane's valid points (two tiny
+    scatter-adds per slide keep the FIFO live-count invariant:
+    live[c] == points of cell c inside the current window)."""
+    return live.at[jnp.where(pvalid, pcell, num_cells)].add(
+        jnp.int32(sign), mode="drop"
     )
 
 
@@ -191,6 +242,77 @@ def _probe(wx, wy, woid, wtag, t, px, py, pxi, pyi, poid, pvalid, radius,
     return flat.reshape(-1), sd.reshape(-1), sel_over
 
 
+def _probe_compact(wx, wy, woid, wtag, wcur, wlive, px, py, pxi, pyi, poid,
+                   pvalid, radius, swap_pair, grid_n: int, cap_w: int,
+                   cap_c: int, layers: int, ppw: int, num_ids: int,
+                   pair_sel: int):
+    """Compacted probe: O(cap_c) live lanes per neighbor cell, not
+    O(cap_w) ring slots. The live slots of a ring row are the
+    contiguous FIFO range ``[cursor - live, cursor)``, so the dense
+    live-slot view is pure index arithmetic — no repack scatter (an
+    XLA:CPU scatter costs ~100× a gather per element), no tag gathers
+    for aliveness (position < live IS the alive test; tags are gathered
+    only at the ≤ pair_sel SELECTED lanes for the digest ring key), and
+    first-k selection by prefix-sum binary search instead of the
+    ``lax.top_k`` sort. Identical selected sets and overflow counts as
+    ``_probe`` (the occupancy-sweep parity tests), plus ``cmp_over``:
+    live points beyond ``cap_c`` in a PROBED cell were invisible — the
+    caller must climb the capacity ladder and re-scan."""
+    span = 2 * layers + 1
+    offs = jnp.arange(-layers, layers + 1, dtype=jnp.int32)
+    nx = pxi[:, None, None] + offs[None, :, None]  # (PC, span, 1)
+    ny = pyi[:, None, None] + offs[None, None, :]  # (PC, 1, span)
+    in_grid = (
+        (nx >= 0) & (nx < grid_n) & (ny >= 0) & (ny < grid_n)
+    ).reshape(-1, span * span)
+    rows = jnp.clip(nx * grid_n + ny, 0, grid_n * grid_n - 1).reshape(
+        -1, span * span
+    )  # (PC, span²)
+    probed = pvalid[:, None] & in_grid
+    ghead = (wcur[rows] - wlive[rows]) % cap_w  # (PC, span²)
+    glive = jnp.where(probed, wlive[rows], 0)
+    cmp_over = jnp.sum(jnp.maximum(glive - cap_c, 0)).astype(jnp.int32)
+
+    lane = jnp.arange(cap_c, dtype=jnp.int32)
+    slot = (ghead[:, :, None] + lane[None, None, :]) % cap_w
+    gidx = rows[:, :, None] * cap_w + slot  # (PC, span², cap_c)
+    gx = wx[gidx]
+    gy = wy[gidx]
+    d = jnp.sqrt(
+        (gx - px[:, None, None]) ** 2 + (gy - py[:, None, None]) ** 2
+    )
+    mask = (
+        probed[:, :, None]
+        & (lane[None, None, :] < glive[:, :, None])
+        & (d <= radius)
+    ).reshape(len(px), -1)  # (PC, C)
+    dflat = d.reshape(len(px), -1)
+    iflat = gidx.reshape(len(px), -1)
+
+    ci, count, sel_over = first_k_prefix_indices(mask, pair_sel)
+    sd = jnp.take_along_axis(dflat, ci, axis=1)
+    gsel = jnp.take_along_axis(iflat, ci, axis=1)  # global slot ids
+    # tag/oid only for the SELECTED slots — two (PC, pair_sel) element
+    # gathers replace two (PC, span², capW) plane gathers.
+    st = wtag[gsel]
+    so = woid[gsel]
+    svalid = (
+        jnp.arange(pair_sel, dtype=jnp.int32)[None, :]
+        < jnp.minimum(count, pair_sel)[:, None]
+    )
+
+    # Digest key: identical arithmetic to _probe — bit-identical flats.
+    ring = jnp.where(st >= 0, st % ppw, (st % ppw + ppw) % ppw)
+    a = poid[:, None]
+    b = so
+    lid = jnp.where(swap_pair, b, a)
+    rid = jnp.where(swap_pair, a, b)
+    flat = ring * (num_ids * num_ids) + lid * num_ids + rid
+    sentinel = ppw * num_ids * num_ids  # drop lane
+    flat = jnp.where(svalid, flat, sentinel)
+    return flat.reshape(-1), sd.reshape(-1), sel_over, cmp_over
+
+
 def _insert(wx, wy, woid, wtag, wcur, t, px, py, pcell, prank, poid, pvalid,
             cap_w: int, ppw: int):
     """Scatter one pane into a side's ring planes; returns the updated
@@ -229,26 +351,38 @@ def tjoin_pane_step(
     ppw: int,
     num_ids: int,
     pair_sel: int,
+    cap_c: int = 0,
     axis_name=None,
 ):
     """One slide: probe/insert both sides, emit the window digest.
 
-    ``xs`` = (t, left pane, right pane) where each pane is
-    (x, y, xi, yi, cell, rank, oid, valid) fixed-capacity arrays.
-    Returns (carry', per-pair window min dists (K²,)). Designed as a
-    ``lax.scan`` body so a whole batch of slides is ONE dispatch.
+    ``xs`` = (t, left pane, right pane, left expiring, right expiring)
+    where each pane is (x, y, xi, yi, cell, rank, oid, valid)
+    fixed-capacity arrays and each expiring pane is the (cell, valid)
+    pair of the pane that left the window this slide (pane ``t - ppw``
+    — what keeps the per-cell live counts exact). Returns (carry',
+    per-pair window min dists (K²,)). Designed as a ``lax.scan`` body
+    so a whole batch of slides is ONE dispatch.
+
+    ``cap_c`` (static): > 0 routes both probes through the compacted
+    positional probe (``_probe_compact`` — gathers ``cap_c`` live lanes
+    per neighbor cell); 0 keeps the full-ring row-gather probe. Same
+    results whenever the overflow counters are zero.
 
     ``axis_name`` (inside shard_map): PROBE-parallel mesh execution —
     each shard receives its contiguous chunk of the new panes' points,
     probes it against the REPLICATED window planes (the probe's
-    span²·capW gathers are the step's dominant cost and divide by the
+    gathers are the step's dominant cost and divide by the
     shard count), then all-gathers the (flat idx, dist) contributions
     so every shard applies the identical digest scatter and pane insert
     (tiled all_gather restores the original point order; scatter-min is
     order-free) — the carry stays replicated and bit-identical to the
-    single-device step (tests/test_parallel_operators.py).
+    single-device step (tests/test_parallel_operators.py). The
+    expiring panes arrive replicated, so the live counts (and with
+    them the compacted probe's head/alive math) are identical on every
+    shard — compaction commutes with the sharding.
     """
-    t, lp, rp = xs
+    t, lp, rp, lxp, rxp = xs
     if axis_name is not None:
         gather = lambda a: jax.lax.all_gather(a, axis_name, tiled=True)
         lp_full = tuple(gather(f) for f in lp)
@@ -256,6 +390,11 @@ def tjoin_pane_step(
     else:
         gather = lambda a: a
         lp_full, rp_full = lp, rp
+    num_cells = grid_n * grid_n
+    # Expire pane t-ppw on both sides BEFORE any probe: the window is
+    # (t-ppw, t], so its points are dead for every probe of this slide.
+    llive = _cell_counts(carry.lwlive, lxp[0], lxp[1], num_cells, -1)
+    rlive = _cell_counts(carry.rwlive, rxp[0], rxp[1], num_cells, -1)
     P = num_ids * num_ids
     bs = block_size(ppw)
     inf = jnp.asarray(jnp.inf, carry.digests.dtype)
@@ -286,16 +425,27 @@ def tjoin_pane_step(
         return (flat // P) // bs * P + flat % P
 
     # Direction A: new LEFT pane × RIGHT window (panes < t).
-    fa, da, sa = _probe(
-        carry.rwx, carry.rwy, carry.rwoid, carry.rwtag, t,
-        lp[0], lp[1], lp[2], lp[3], lp[6], lp[7], radius,
-        swap_pair=jnp.asarray(False),
-        grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
-        num_ids=num_ids, pair_sel=pair_sel,
-    )
+    if cap_c > 0:
+        fa, da, sa, ca = _probe_compact(
+            carry.rwx, carry.rwy, carry.rwoid, carry.rwtag, carry.rwcur,
+            rlive, lp[0], lp[1], lp[2], lp[3], lp[6], lp[7], radius,
+            swap_pair=jnp.asarray(False),
+            grid_n=grid_n, cap_w=cap_w, cap_c=cap_c, layers=layers,
+            ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+        )
+    else:
+        fa, da, sa = _probe(
+            carry.rwx, carry.rwy, carry.rwoid, carry.rwtag, t,
+            lp[0], lp[1], lp[2], lp[3], lp[6], lp[7], radius,
+            swap_pair=jnp.asarray(False),
+            grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
+            num_ids=num_ids, pair_sel=pair_sel,
+        )
+        ca = jnp.zeros((), jnp.int32)
     if axis_name is not None:
         fa, da = gather(fa), gather(da)
         sa = jax.lax.psum(sa, axis_name)
+        ca = jax.lax.psum(ca, axis_name)
     Df = D.reshape(-1)
     Df = Df.at[fa].min(da, mode="drop")
     Bf = Bf.at[block_flat(fa)].min(da, mode="drop")
@@ -305,19 +455,31 @@ def tjoin_pane_step(
         lp_full[0], lp_full[1], lp_full[4], lp_full[5], lp_full[6],
         lp_full[7], cap_w=cap_w, ppw=ppw,
     )
+    llive = _cell_counts(llive, lp_full[4], lp_full[7], num_cells, 1)
 
     # Direction B: new RIGHT pane × LEFT window (panes ≤ t — includes the
     # pane just inserted, so new×new pairs are counted exactly once).
-    fb, db, sb = _probe(
-        lwx, lwy, lwoid, lwtag, t,
-        rp[0], rp[1], rp[2], rp[3], rp[6], rp[7], radius,
-        swap_pair=jnp.asarray(True),
-        grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
-        num_ids=num_ids, pair_sel=pair_sel,
-    )
+    if cap_c > 0:
+        fb, db, sb, cb = _probe_compact(
+            lwx, lwy, lwoid, lwtag, lwcur, llive,
+            rp[0], rp[1], rp[2], rp[3], rp[6], rp[7], radius,
+            swap_pair=jnp.asarray(True),
+            grid_n=grid_n, cap_w=cap_w, cap_c=cap_c, layers=layers,
+            ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+        )
+    else:
+        fb, db, sb = _probe(
+            lwx, lwy, lwoid, lwtag, t,
+            rp[0], rp[1], rp[2], rp[3], rp[6], rp[7], radius,
+            swap_pair=jnp.asarray(True),
+            grid_n=grid_n, cap_w=cap_w, layers=layers, ppw=ppw,
+            num_ids=num_ids, pair_sel=pair_sel,
+        )
+        cb = jnp.zeros((), jnp.int32)
     if axis_name is not None:
         fb, db = gather(fb), gather(db)
         sb = jax.lax.psum(sb, axis_name)
+        cb = jax.lax.psum(cb, axis_name)
     Df = Df.at[fb].min(db, mode="drop")
     Bf = Bf.at[block_flat(fb)].min(db, mode="drop")
     D = Df.reshape(ppw, P)
@@ -328,18 +490,38 @@ def tjoin_pane_step(
         rp_full[0], rp_full[1], rp_full[4], rp_full[5], rp_full[6],
         rp_full[7], cap_w=cap_w, ppw=ppw,
     )
+    rlive = _cell_counts(rlive, rp_full[4], rp_full[7], num_cells, 1)
 
     new_carry = TJoinPaneCarry(
-        lwx, lwy, lwoid, lwtag, lwcur,
-        rwx, rwy, rwoid, rwtag, rwcur,
+        lwx, lwy, lwoid, lwtag, lwcur, llive,
+        rwx, rwy, rwoid, rwtag, rwcur, rlive,
         D, Bd,
         (carry.cap_overflow + ov_l + ov_r).astype(jnp.int32),
         (carry.sel_overflow + sa + sb).astype(jnp.int32),
+        (carry.cmp_overflow + ca + cb).astype(jnp.int32),
     )
     # Window ending at pane t: min over every live earlier-pane digest,
     # via the block level (bit-exact — min of mins).
     wmin = jnp.min(Bd, axis=0)
     return new_carry, wmin
+
+
+def expired_pane_fields(cells_arr, valid_arr, ppw: int):
+    """(cell, valid) of the pane EXPIRING at each slide of a batch whose
+    carry started EMPTY: pane s - ppw, i.e. the same arrays shifted by
+    ``ppw`` slides with nothing expiring during warmup. Callers that
+    chain scans from a non-empty carry (bench_suite's warm + steady
+    split) must instead slice the expiring panes from the earlier batch
+    and pass them explicitly — this zero-fill is only correct when the
+    scan's own slides are the whole ring history."""
+    S = cells_arr.shape[0]
+    pad = min(ppw, S)
+    zc = jnp.zeros((pad,) + cells_arr.shape[1:], cells_arr.dtype)
+    zv = jnp.zeros((pad,) + valid_arr.shape[1:], valid_arr.dtype)
+    if S > ppw:
+        return (jnp.concatenate([zc, cells_arr[:S - ppw]], axis=0),
+                jnp.concatenate([zv, valid_arr[:S - ppw]], axis=0))
+    return zc, zv
 
 
 def tjoin_pane_scan(
@@ -352,6 +534,9 @@ def tjoin_pane_scan(
     ppw: int,
     num_ids: int,
     pair_sel: int,
+    cap_c: int = 0,
+    lps_expire=None,
+    rps_expire=None,
     mesh=None,
 ):
     """Scan ``tjoin_pane_step`` over a batch of slides in ONE program.
@@ -360,19 +545,36 @@ def tjoin_pane_scan(
     (x, y, xi, yi, cell, rank, oid, valid). Returns (carry',
     (S, K²) per-window pair min dists).
 
+    ``cap_c`` (static): the bucketed live-slot probe capacity
+    (ops/compaction.py ladder; 0 = full-ring probe). One compiled
+    program per bucket — the host picks the rung, the device program
+    stays fixed-shape.
+
+    ``lps_expire``/``rps_expire``: (cell, valid) pairs of the pane
+    expiring at each slide, (S, PC) each — required when this scan
+    continues a carry whose ring already holds panes from an earlier
+    scan. Default None derives them from this batch's own panes
+    (``expired_pane_fields`` — correct iff the carry started empty).
+
     ``mesh``: probe-parallel execution over the mesh's ``data`` axis —
     pane POINTS shard (PC must divide by the axis), window/digest state
-    replicates, per-slide contributions all-gather (see
-    tjoin_pane_step's axis_name). Bit-identical to single-device.
+    and the expiring panes replicate, per-slide contributions
+    all-gather (see tjoin_pane_step's axis_name). Bit-identical to
+    single-device, compacted or not.
     """
+    if lps_expire is None:
+        lps_expire = expired_pane_fields(lps[4], lps[7], ppw)
+    if rps_expire is None:
+        rps_expire = expired_pane_fields(rps[4], rps[7], ppw)
     if mesh is None:
         def body(c, x):
             return tjoin_pane_step(
                 c, x, radius, grid_n=grid_n, cap_w=cap_w, layers=layers,
-                ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+                ppw=ppw, num_ids=num_ids, pair_sel=pair_sel, cap_c=cap_c,
             )
 
-        return jax.lax.scan(body, carry, (ts, lps, rps))
+        return jax.lax.scan(body, carry, (ts, lps, rps, lps_expire,
+                                          rps_expire))
 
     # Shim handles both the symbol's home and check_rep→check_vma.
     from spatialflink_tpu.utils.shardmap_compat import shard_map
@@ -386,23 +588,25 @@ def tjoin_pane_scan(
             f"({ndev})"
         )
 
-    def local(c, ts_, lps_, rps_):
+    def local(c, ts_, lps_, rps_, lxp_, rxp_):
         def body(cc, x):
             return tjoin_pane_step(
                 cc, x, radius, grid_n=grid_n, cap_w=cap_w, layers=layers,
-                ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+                ppw=ppw, num_ids=num_ids, pair_sel=pair_sel, cap_c=cap_c,
                 axis_name="data",
             )
 
-        return jax.lax.scan(body, c, (ts_, lps_, rps_))
+        return jax.lax.scan(body, c, (ts_, lps_, rps_, lxp_, rxp_))
 
     carry_spec = TJoinPaneCarry(*(P() for _ in carry))
     pane_spec = tuple(P(None, "data") for _ in lps)
+    expire_spec = (P(), P())  # replicated — live counts stay identical
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(carry_spec, P(), pane_spec, pane_spec),
+        in_specs=(carry_spec, P(), pane_spec, pane_spec, expire_spec,
+                  expire_spec),
         out_specs=(carry_spec, P()),
         check_vma=False,
     )
-    return fn(carry, ts, lps, rps)
+    return fn(carry, ts, lps, rps, lps_expire, rps_expire)

@@ -69,7 +69,13 @@ def wire_digest_xla(wire_s, n_valid, query_xy, scale, origin, radius,
     ``wire_s``: (3, N) uint16; ``n_valid``: logical count (positions
     past it are bucket padding — excluded via the valid mask, so a
     variable-size pane stream reuses one compiled shape). All other
-    args traced; ``num_segments``/``cand`` static.
+    args traced; ``num_segments``/``cand`` static. N is the caller's
+    pane-capacity bucket (run_wire_panes pads through the shared
+    ladder, ops/compaction.py:wire_pane_bucket — each pick lands in
+    telemetry's per-bucket occupancy log), so the whole dequant →
+    distance → candidate pipeline scans O(pane-rounded-up) lanes and
+    the compact step's ``cand >= N`` compile-time branch already
+    short-circuits small buckets straight to the scatter digest.
     """
     xf, yf, oid = wire_plane_coords(wire_s, scale, origin)
     dx = xf - query_xy[0]

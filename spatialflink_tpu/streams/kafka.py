@@ -139,6 +139,14 @@ class WireKafkaSource:
     back as ``start_offsets``, and the stream continues mid-window
     (tests/test_kafka_wire.py::test_kill_and_resume_replays_no_gap_no_dup).
 
+    Log holes (compacted topics / retention): a fetched batch that
+    STARTS past the requested position snaps the position to the
+    batch's base offset, and within a batch the position advances along
+    the offsets the broker actually delivered — deleted offsets are
+    never waited for. The out-of-sequence parking below therefore
+    guards only against the ts-sort reordering records of ONE fetched
+    batch (the only case where an "earlier" record is still coming).
+
     Cross-partition timestamp ordering: within a fetch round, records
     from all partitions yield in event-time order (stable sort; the
     single-partition common case bypasses the buffer). Mid-round offset
@@ -215,8 +223,32 @@ class WireKafkaSource:
             # and the sort is stable, so a partition's producer order
             # survives for equal/monotone timestamps.
             round_msgs: list = []
+            succ: dict = {}  # partition → offset → next fetch position
             for p in parts:
                 msgs, _hw = client.fetch(topic, p, offsets[p])
+                if msgs and msgs[0][0] > offsets[p]:
+                    # The batch STARTS past our position: a log hole
+                    # (compaction / retention deleted the offsets we
+                    # asked for), not a reorder — the broker always
+                    # serves the first available record at/after the
+                    # requested offset. Snap the position to the fetch
+                    # response's base offset so the contiguity rule
+                    # below applies only WITHIN this fetched batch;
+                    # without the snap, every record of a compacted
+                    # topic past the first hole parks in `ahead`
+                    # forever and each round re-fetches (and re-yields)
+                    # the same records — a stall-plus-duplicate storm.
+                    offsets[p] = msgs[0][0]
+                if not single and msgs:
+                    # Within-batch successor chain: the broker delivers
+                    # a batch offset-ascending, so "contiguous" means
+                    # the NEXT OFFSET PRESENT IN THE BATCH — holes the
+                    # broker itself skipped (compacted-away records)
+                    # are not missing data to wait for.
+                    offs_p = [m[0] for m in msgs]
+                    succ[p] = dict(
+                        zip(offs_p, offs_p[1:] + [offs_p[-1] + 1])
+                    )
                 for off, ts_ms, _key, value in msgs:
                     progressed = True
                     if single:
@@ -243,11 +275,11 @@ class WireKafkaSource:
             ahead: dict = {}
             for _ts, p, off, value in round_msgs:
                 if off == offsets[p]:
-                    offsets[p] = off + 1
+                    offsets[p] = succ[p][off]
                     parked = ahead.get(p)
                     while parked and offsets[p] in parked:
                         parked.remove(offsets[p])
-                        offsets[p] += 1
+                        offsets[p] = succ[p][offsets[p]]
                 elif off > offsets[p]:
                     ahead.setdefault(p, set()).add(off)
                 if value is None:

@@ -68,9 +68,12 @@ def abstract_signature(args: tuple, kwargs: Optional[dict] = None) -> Tuple:
     """Hashable proxy of jax.jit's cache key for a call.
 
     Positional arguments go through ``_arg_signature`` (avals for arrays,
-    recursive for containers); keyword arguments contribute
-    (name, repr(value)) because every kwarg in this codebase is a static
-    argument, where the VALUE keys the compile cache.
+    recursive for containers); keyword arguments holding arrays or
+    containers of arrays do too (e.g. the pane scan's ``lps_expire``
+    array tuples — repr would MATERIALIZE the arrays, a device fetch
+    per call), while every other kwarg contributes (name, repr(value))
+    because scalar/string kwargs in this codebase are static arguments,
+    where the VALUE keys the compile cache.
     """
     parts = [_arg_signature(a) for a in args]
     for k in sorted(kwargs or ()):
@@ -79,6 +82,8 @@ def abstract_signature(args: tuple, kwargs: Optional[dict] = None) -> Tuple:
         dtype = getattr(v, "dtype", None)
         if shape is not None and dtype is not None:
             parts.append((k, (tuple(shape), str(dtype))))
+        elif isinstance(v, (tuple, list)):
+            parts.append((k, _arg_signature(v)))
         else:
             parts.append((k, repr(v)))
     return tuple(parts)
@@ -146,6 +151,9 @@ class Telemetry:
         self.max_watermark_lag_ms = 0
         self.late_drops = 0
         self.window_latency = FixedBucketLatency()
+        # engine → {capacity bucket → {"picks", "max_live"}} — the
+        # compaction control plane's pick log (ops/compaction.py).
+        self._compaction: Dict[str, Dict[int, Dict[str, int]]] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -322,6 +330,38 @@ class Telemetry:
         with self._lock:
             return len(self._shapes_seen.get(kernel, ()))
 
+    # -- compaction bucket accounting -----------------------------------------
+
+    def record_compaction(self, engine: str, capacity: int, live: int):
+        """One host-side bucket pick by the live-slot compaction control
+        plane (ops/compaction.py): ``engine`` compiled/ran at static
+        capacity ``capacity`` for an observed live occupancy of
+        ``live``. Per-(engine, bucket) pick counts + max observed live
+        land in ``snapshot()`` — occupancy drift shows up as bucket
+        churn here, and as at most ladder-many distinct signatures in
+        the recompile detector (the bucket is a static of the scan)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            d = self._compaction.setdefault(engine, {}).setdefault(
+                int(capacity), {"picks": 0, "max_live": 0}
+            )
+            d["picks"] += 1
+            d["max_live"] = max(d["max_live"], int(live))
+        self._emit({
+            "name": f"compaction:{engine}", "cat": "telemetry", "ph": "i",
+            "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
+            "tid": threading.get_ident(), "s": "t",
+            "args": {"capacity": int(capacity), "live": int(live)},
+        })
+
+    def compaction_buckets(self, engine: str) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            return {
+                k: dict(v)
+                for k, v in self._compaction.get(engine, {}).items()
+            }
+
     # -- watermark / lateness gauges ------------------------------------------
 
     def record_watermark_lag(self, lag_ms: int):
@@ -352,6 +392,10 @@ class Telemetry:
                        lambda: len(self.compile_events))
         registry.gauge("h2d_bytes_total", lambda: self.h2d_bytes)
         registry.gauge("d2h_bytes_total", lambda: self.d2h_bytes)
+        registry.gauge(
+            "compaction_buckets_total",
+            lambda: sum(len(v) for v in self._compaction.values()),
+        )
 
     def summary(self) -> Dict[str, Any]:
         """The bench.py JSON block: strictly JSON-safe (numpy scalars →
@@ -380,6 +424,10 @@ class Telemetry:
                 events=len(self.events),
                 dropped_events=self.dropped_events,
                 kernels={k: len(v) for k, v in self._shapes_seen.items()},
+                compaction={
+                    eng: {str(cap): dict(st) for cap, st in caps.items()}
+                    for eng, caps in self._compaction.items()
+                },
             )
         return json_safe(out)
 

@@ -156,6 +156,93 @@ class TestLastGoodStore:
         assert len(seed["git_sha"]) == 40
 
 
+class TestDeadlineAndKill:
+    """VERDICT r5 weak #1: the dial schedule outlived the driver's kill
+    budget and the round record was ``parsed: null``. The supervisor is
+    now bounded by SFT_BENCH_DEADLINE (default 600 s) checked before
+    each dial AND each backoff, and a SIGTERM handler prints the same
+    stale-last-good record — a JSON line lands under EVERY outcome
+    short of SIGKILL."""
+
+    def test_deadline_preempts_long_backoff_schedule(self, tmp_path):
+        # A backoff that would sleep ~3 hours: the deadline check must
+        # trip BEFORE the sleep and print the stale record immediately
+        # (the test's own 120 s timeout is the enforcement).
+        p, lines, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_BENCH_BACKOFFS": "9999",
+             "SFT_BENCH_DEADLINE": "3"},
+            last_good=FIXTURE_GOOD,
+        )
+        assert p.returncode == 3
+        assert len(lines) == 1, f"driver contract: ONE line, got {lines}"
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        # the child's own honest error record is still the one relayed
+        assert "unreachable" in rec["error"]
+        assert rec["last_good"]["stale"] is True
+        assert rec["last_good"]["value"] == FIXTURE_GOOD["record"]["value"]
+
+    def test_deadline_zero_emits_without_dialing(self, tmp_path):
+        p, lines, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_BENCH_DEADLINE": "0"},
+            last_good=FIXTURE_GOOD,
+        )
+        assert p.returncode == 3
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        assert "deadline" in rec["error"]
+        assert rec["last_good"]["stale"] is True
+
+    def test_truncated_child_json_degrades_to_error_record(self, tmp_path):
+        """bench.py's final-failure path must survive a child killed
+        mid-print (half-written JSON line) — ADVICE r5."""
+        p, lines, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "truncated",
+             "SFT_BENCH_BACKOFFS": "0"},
+            last_good=FIXTURE_GOOD,
+        )
+        assert p.returncode == 3
+        assert len(lines) == 1
+        rec = json.loads(lines[0])  # parses — the truncation never leaks
+        assert rec["value"] == 0
+        assert "failed rc=3" in rec["error"]
+        assert rec["last_good"]["stale"] is True
+
+    def test_sigterm_prints_stale_record(self, tmp_path):
+        import signal
+        import time
+
+        lg = tmp_path / "lg.json"
+        lg.write_text(json.dumps(FIXTURE_GOOD))
+        env = {
+            **os.environ,
+            "SFT_BENCH_BACKOFFS": "0",
+            "SFT_BENCH_LAST_GOOD": str(lg),
+            "PALLAS_AXON_POOL_IPS": "",
+            "SFT_BENCH_HANG": "60",  # child stuck "dialing"
+            "SFT_BENCH_DEADLINE": "600",
+        }
+        env.pop("SFT_BENCH_CHILD", None)
+        p = subprocess.Popen(
+            [sys.executable, BENCH], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        time.sleep(2.0)  # supervisor is now waiting on the hung child
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 3
+        lines = [ln for ln in out.strip().splitlines() if ln]
+        assert len(lines) == 1, f"driver contract: ONE line, got {lines}"
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        assert "SIGTERM" in rec["error"]
+        assert rec["last_good"]["stale"] is True
+        assert rec["last_good"]["value"] == FIXTURE_GOOD["record"]["value"]
+
+
 class TestTelemetryBlock:
     def test_fake_record_with_telemetry_relays_verbatim(self, tmp_path):
         """The supervisor must relay the telemetry block untouched."""

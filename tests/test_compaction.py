@@ -1,0 +1,320 @@
+"""Live-slot compaction (ops/compaction.py + the compacted positional
+probe in ops/tjoin_panes.py): bucket-ladder control plane, exact host
+occupancy planning, occupancy-sweep bit-parity of the compacted scan vs
+the full-ring scan and run_soa, the cmp_overflow ladder-climb retry,
+and the ≤K-stable-signatures recompile contract."""
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.operators import QueryConfiguration, QueryType
+from spatialflink_tpu.operators.trajectory import TJoinQuery
+from spatialflink_tpu.ops.compaction import (
+    capacity_ladder,
+    compact_probe_preferred,
+    max_window_cell_count,
+    pick_capacity,
+    wire_pane_bucket,
+)
+from spatialflink_tpu.telemetry import telemetry
+
+GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# host control plane
+
+
+def test_capacity_ladder_is_small():
+    assert capacity_ladder(64) == (8, 16, 32, 64)
+    assert capacity_ladder(256) == (8, 16, 32, 64, 128, 256)
+    assert len(capacity_ladder(256)) <= 6  # the ≤K compile bound
+    # non-power-of-two ring caps keep the full ring as the top rung
+    assert capacity_ladder(48) == (8, 16, 32, 48)
+    assert capacity_ladder(4) == (4,)
+
+
+def test_pick_capacity_buckets():
+    assert pick_capacity(0, 64) == 8
+    assert pick_capacity(1, 64) == 8
+    assert pick_capacity(8, 64) == 8
+    assert pick_capacity(9, 64) == 16
+    assert pick_capacity(64, 64) == 64
+    assert pick_capacity(1000, 64) == 64  # clamps to the ring cap
+
+
+def test_max_window_cell_count_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    for ppw in (1, 3, 7):
+        pane = rng.integers(0, 40, 400).astype(np.int64)
+        cell = rng.integers(0, 9, 400).astype(np.int64)
+        got = max_window_cell_count(pane, cell, ppw)
+        brute = 0
+        for c in range(9):
+            ps = pane[cell == c]
+            for t in range(41):
+                brute = max(
+                    brute, int(((ps > t - ppw) & (ps <= t)).sum())
+                )
+        assert got == brute, (ppw, got, brute)
+    assert max_window_cell_count(np.empty(0, np.int64),
+                                 np.empty(0, np.int64), 5) == 0
+
+
+def test_wire_pane_bucket_records_occupancy():
+    telemetry.enable()
+    try:
+        assert wire_pane_bucket(0) == 128
+        assert wire_pane_bucket(100) == 128
+        assert wire_pane_bucket(129) == 256
+        assert wire_pane_bucket(200) == 256
+        buckets = telemetry.compaction_buckets("wire_pane_digest")
+        assert buckets[128]["picks"] == 2
+        assert buckets[128]["max_live"] == 100
+        assert buckets[256]["picks"] == 2
+        assert buckets[256]["max_live"] == 200
+        snap = telemetry.snapshot()
+        assert snap["compaction"]["wire_pane_digest"]["256"]["picks"] == 2
+    finally:
+        telemetry.disable()
+
+
+@pytest.mark.parametrize("C", [1, 2, 7, 8, 16, 57, 64, 100])
+def test_first_k_prefix_indices_matches_topk(C):
+    """The sort-free selection must pick the identical set as top_k over
+    the int8 mask for ANY row width — including powers of two, where an
+    off-by-one in the binary-search depth (⌈log₂(C+1)⌉ halvings of the
+    [0, C] interval) once returned wrong lanes (code review)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.select import first_k_prefix_indices
+
+    rng = np.random.default_rng(C)
+    for k in (1, 3, 16):
+        mask = jnp.asarray(rng.random((13, C)) < 0.3)
+        ci, count, over = jax.jit(
+            first_k_prefix_indices, static_argnums=1
+        )(mask, k)
+        m = np.asarray(mask)
+        exp_count = m.sum(axis=1)
+        np.testing.assert_array_equal(np.asarray(count), exp_count)
+        assert int(over) == int(np.maximum(exp_count - k, 0).sum())
+        for i in range(m.shape[0]):
+            exp = np.flatnonzero(m[i])[:k]
+            np.testing.assert_array_equal(
+                np.asarray(ci)[i, :len(exp)], exp,
+                err_msg=f"C={C} k={k} row={i}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# occupancy-sweep parity: compacted scan ≡ full-ring scan ≡ run_soa
+
+
+def _single_cell_chunks(occ_per_pane, n_panes, slide_ms, n_obj, rng,
+                        x=5.05):
+    """``occ_per_pane`` same-cell events in each of ``n_panes`` panes —
+    window occupancy is exactly occ_per_pane · min(ppw, panes seen)."""
+    ts, xs, ys, oid = [], [], [], []
+    for p in range(n_panes):
+        for j in range(occ_per_pane):
+            ts.append(p * slide_ms + (j % slide_ms))
+            xs.append(x + 0.001 * j)
+            ys.append(5.05 + 0.001 * ((j * 7) % occ_per_pane))
+            oid.append(int(rng.integers(0, n_obj)))
+    order = np.argsort(np.asarray(ts, np.int64), kind="stable")
+    return [{
+        "ts": np.asarray(ts, np.int64)[order],
+        "x": np.asarray(xs, float)[order],
+        "y": np.asarray(ys, float)[order],
+        "oid": np.asarray(oid, np.int32)[order],
+    }]
+
+
+def _key(results):
+    out = {}
+    for start, end, lo, ro, dd, count, over in results:
+        assert over == 0
+        out[start] = sorted(
+            (int(a), int(b), float(d)) for a, b, d in zip(lo, ro, dd)
+        )
+    return out
+
+
+def _run_panes(left, right, radius, n_obj, **kw):
+    return _key(TJoinQuery(
+        QueryConfiguration(QueryType.WindowBased, window_size=1,
+                           slide_step=0.25), GRID,
+    ).run_soa_panes(
+        iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+        radius, num_segments=n_obj, backend="device", **kw,
+    ))
+
+
+@pytest.mark.parametrize("occ_per_pane", [2, 3])
+def test_compacted_vs_full_ring_quick(occ_per_pane):
+    """Quick-tier pin: compacted probe (auto bucket) bit-matches the
+    full-ring probe (cap_c=0) on a bucket-interior occupancy."""
+    rng = np.random.default_rng(11)
+    left = _single_cell_chunks(occ_per_pane, 12, 250, 8, rng)
+    right = _single_cell_chunks(occ_per_pane, 12, 250, 8, rng, x=5.06)
+    compacted = _run_panes(left, right, 0.5, 8, cap_w=16)
+    full = _run_panes(left, right, 0.5, 8, cap_w=16, cap_c=0)
+    assert compacted == full
+    assert any(compacted.values()), "degenerate: no pairs anywhere"
+
+
+@pytest.mark.slow
+def test_occupancy_sweep_bit_parity():
+    """The padding-never-changes-results pin: window occupancies at
+    empty / one-live / bucket-boundary ± 1 / full ring, each run three
+    ways — compacted (host-planned bucket), full-ring (cap_c=0), and
+    the run_soa oracle — with identical pair sets AND bit-identical
+    min distances."""
+    rng = np.random.default_rng(7)
+    ppw, slide = 4, 250
+    cap_w = 16  # ladder (8, 16); window occupancy = 4·occ_per_pane
+    # occ_per_pane 1 → occupancy 4 (one-ish live, bucket 8); 2 → 8
+    # (boundary); 3 → 12 (boundary+: bucket 16); 4 → 16 (full ring).
+    for occ_per_pane in (1, 2, 3, 4):
+        left = _single_cell_chunks(occ_per_pane, 3 * ppw, slide, 8, rng)
+        right = _single_cell_chunks(occ_per_pane, 3 * ppw, slide, 8, rng,
+                                    x=5.06)
+        occ = max_window_cell_count(
+            left[0]["ts"] // slide,
+            GRID.assign_cells_np(
+                np.stack([left[0]["x"], left[0]["y"]], axis=1)
+            ).astype(np.int64), ppw,
+        )
+        assert occ == occ_per_pane * ppw  # the sweep hits its target
+        compacted = _run_panes(left, right, 0.5, 8, cap_w=cap_w)
+        full = _run_panes(left, right, 0.5, 8, cap_w=cap_w, cap_c=0)
+        soa = _key(TJoinQuery(
+            QueryConfiguration(QueryType.WindowBased, window_size=1,
+                               slide_step=0.25), GRID,
+        ).run_soa(
+            iter([dict(c) for c in left]), iter([dict(c) for c in right]),
+            0.5, num_segments=8,
+        ))
+        # compacted vs full ring: BIT-identical (same candidate sets,
+        # same scatter-min arithmetic)
+        assert compacted == full, f"occ_per_pane={occ_per_pane}"
+
+        def rounded(res):
+            return {s: sorted((a, b, round(d, 9)) for a, b, d in p)
+                    for s, p in res.items()}
+
+        # vs the full-window oracle: same pairs, distances to 1e-9
+        # (differently-fused programs — the suite-wide contract)
+        r_soa, r_cmp = rounded(soa), rounded(compacted)
+        for start, pairs in r_soa.items():
+            assert r_cmp[start] == pairs, f"window {start}"
+    # one-sided "empty window" case: left-only stream still fires
+    left = _single_cell_chunks(2, 8, slide, 8, rng)
+    right = [{
+        "ts": np.asarray([10_000], np.int64), "x": np.asarray([5.0]),
+        "y": np.asarray([5.0]), "oid": np.asarray([0], np.int32),
+    }]
+    compacted = _run_panes(left, right, 0.5, 8, cap_w=cap_w)
+    full = _run_panes(left, right, 0.5, 8, cap_w=cap_w, cap_c=0)
+    assert compacted == full
+    assert all(len(p) == 0 for s, p in compacted.items() if s < 2_000)
+
+
+def test_out_of_grid_events_keep_fifo_ranks_contiguous():
+    """Out-of-grid events must not consume ring ranks in the cell their
+    placeholder id aliases (cell 0): ``_insert`` drops them and advances
+    the cursor only by the valid count, so an inflated rank would park a
+    VALID point beyond the cursor — outside the ``[cursor-live, cursor)``
+    live range the compacted probe scans (a silent missed/garbage pair
+    with cmp_overflow still 0; the full-ring tag scan was immune).
+    Code-review repro, pinned: mixed in/out-of-grid stream, compacted ≡
+    full-ring ≡ expected pair."""
+    ts = np.asarray([100, 150, 300], np.int64)
+    left = [{
+        "ts": ts,
+        # out-of-grid (-5,-5) precedes the valid cell-0 point (0.2, 0.2)
+        "x": np.asarray([-5.0, 0.2, 0.2]),
+        "y": np.asarray([-5.0, 0.2, 0.2]),
+        "oid": np.asarray([3, 1, 1], np.int32),
+    }]
+    right = [{
+        "ts": ts,
+        "x": np.asarray([0.25, 0.25, 0.25]),
+        "y": np.asarray([0.2, 0.2, 0.2]),
+        "oid": np.asarray([2, 2, 2], np.int32),
+    }]
+    compacted = _run_panes(left, right, 0.5, 8, cap_w=16)
+    full = _run_panes(left, right, 0.5, 8, cap_w=16, cap_c=0)
+    assert compacted == full
+    for pairs in compacted.values():
+        assert all(a == 1 and b == 2 for a, b, _ in pairs), pairs
+    assert any(compacted.values())
+
+
+def test_forced_tiny_cap_c_climbs_ladder_to_exactness():
+    """A forced cap_c far below the live occupancy must trip
+    cmp_overflow and climb the ladder until the result is exact —
+    the forced bucket never wins over correctness."""
+    rng = np.random.default_rng(13)
+    left = _single_cell_chunks(3, 12, 250, 8, rng)
+    right = _single_cell_chunks(3, 12, 250, 8, rng, x=5.06)
+    honest = _run_panes(left, right, 0.5, 8, cap_w=16)
+    forced = _run_panes(left, right, 0.5, 8, cap_w=16, cap_c=2)
+    assert forced == honest
+    assert any(honest.values())
+
+
+@pytest.mark.slow
+def test_bucket_ladder_stable_signatures():
+    """Recompile contract: sweeping occupancy across every rung
+    compiles at most ladder-many scan programs (K ≤ 6), and re-running
+    an already-seen occupancy adds NO new signature (no churn after
+    warmup). Streams share S and pane capacity so the bucket is the
+    only varying static."""
+    if not compact_probe_preferred():  # pragma: no cover - TPU runs
+        pytest.skip("full-ring probe preferred on this backend")
+    rng = np.random.default_rng(5)
+    cap_w = 32  # ladder (8, 16, 32)
+    n_panes, per_pane = 12, 24
+
+    def spread_chunks(n_cells, shift=0.0):
+        # per_pane events per pane, spread over n_cells distinct cells:
+        # same pane counts (same padded pane capacity), different
+        # concentration (different live occupancy → different bucket).
+        ts, xs, ys, oid = [], [], [], []
+        for p in range(n_panes):
+            for j in range(per_pane):
+                c = j % n_cells
+                ts.append(p * 250 + j)
+                xs.append(0.55 + 0.5 * (c % 18) + shift)
+                ys.append(0.55 + 0.5 * (c // 18))
+                oid.append(int(rng.integers(0, 8)))
+        return [{
+            "ts": np.asarray(ts, np.int64),
+            "x": np.asarray(xs, float), "y": np.asarray(ys, float),
+            "oid": np.asarray(oid, np.int32),
+        }]
+
+    telemetry.enable()
+    try:
+        # occupancies: 24 cells → ≤ 4 live/cell (bucket 8); 8 cells →
+        # 12 live (16); 3 cells → 32 live (32: full ring).
+        for n_cells in (24, 8, 3, 24):  # 24 repeated: stability probe
+            left = spread_chunks(n_cells)
+            right = spread_chunks(n_cells, shift=0.01)
+            # pair_sel sized for the densest rung so the sel-overflow
+            # retry can't add its own (pair_sel-keyed) signatures
+            _run_panes(left, right, 0.3, 8, cap_w=cap_w, pair_sel=64)
+        sigs = telemetry.distinct_shapes("tjoin_pane_scan")
+        assert 1 <= sigs <= len(capacity_ladder(cap_w)), sigs
+        buckets = telemetry.compaction_buckets("tjoin_pane_scan")
+        assert set(buckets) <= set(capacity_ladder(cap_w))
+        assert sum(b["picks"] for b in buckets.values()) == 4
+        # the repeated occupancy reused its bucket: picks prove the
+        # ladder is stable, signatures prove no recompile churn
+        assert buckets[8]["picks"] == 2
+    finally:
+        telemetry.disable()

@@ -375,6 +375,9 @@ class FakeBroker:
         # topic → {partition → list[(ts, key, value)]}
         self.logs: dict = {}
         self.fetch_codec = None  # None | gzip | snappy | lz4 | lz4-legacy
+        # (topic, partition) → offsets DELETED by log compaction: they
+        # stay in the offset sequence but never appear in a fetch.
+        self.holes: dict = {}
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("127.0.0.1", 0))
@@ -489,8 +492,11 @@ class FakeBroker:
                     off = r.int64()
                     r.int32()  # max_bytes
                     log = self.log(topic, pid)
+                    holes = self.holes.get((topic, pid), ())
                     msgs = []
                     for i, (ts, key, value) in enumerate(log[off:], start=off):
+                        if i in holes:  # compacted away — never served
+                            continue
                         m = kw.encode_message_v1(value, key, ts)
                         msgs.append(struct.pack(">qi", i, len(m)) + m)
                     mset = b"".join(msgs)
@@ -653,6 +659,42 @@ def test_multi_partition_nonmonotone_ts_no_duplicates(monkeypatch):
         src.close()
         assert sorted(got) == ["p0a", "p0b", "p1a", "p1b"], got
         assert len(set(got)) == 4, f"duplicate delivery: {got}"
+    finally:
+        b.close()
+
+
+def test_compacted_topic_offset_gap_no_stall_no_dupes(monkeypatch):
+    """Log holes (compacted-away offsets) in a multi-partition topic
+    must neither stall the position nor re-deliver the post-hole
+    records every round (ADVICE r5): a fetched batch starting past the
+    requested position snaps it to the batch's base offset, and within
+    the batch the position follows the offsets the broker actually
+    delivered — the out-of-sequence parking applies only to the
+    ts-sort's reordering of one batch, never to deleted offsets."""
+    _no_libs(monkeypatch)
+    from spatialflink_tpu.streams.kafka import WireKafkaSource
+
+    b = FakeBroker(num_partitions=2)
+    try:
+        client = kw.KafkaWireClient(f"127.0.0.1:{b.port}")
+        client.produce("t", 0, [(f"p0-{i}".encode(), None, 10 * i)
+                                for i in range(6)])
+        client.produce("t", 1, [(f"p1-{i}".encode(), None, 10 * i + 5)
+                                for i in range(3)])
+        client.close()
+        # Compaction deleted p0 offsets 0 and 2-3: exercises BOTH the
+        # batch-base snap (hole at the requested position) and the
+        # within-batch successor chain (hole inside the batch).
+        b.holes[("t", 0)] = {0, 2, 3}
+        src = WireKafkaSource("t", f"127.0.0.1:{b.port}", parser=str)
+        got = list(itertools.islice(iter(src), 6))
+        src.close()
+        assert sorted(got) == ["p0-1", "p0-4", "p0-5",
+                               "p1-0", "p1-1", "p1-2"], got
+        assert len(set(got)) == 6, f"duplicate delivery: {got}"
+        # The regression trigger: pre-fix, partition 0's position stalls
+        # at the hole (0) and every later round re-fetches + re-yields.
+        assert src.offsets == {0: 6, 1: 3}, src.offsets
     finally:
         b.close()
 

@@ -396,13 +396,13 @@ def test_tjoin_pane_engine_mesh_bit_matches_single(rng, mesh):
 
     left, right = mk(0.0), mk(0.2)
 
-    def run(m):
+    def run(m, **kw):
         return [
             (s, e, list(map(int, lo)), list(map(int, ro)),
              [float(d) for d in dd], c, ov)
             for s, e, lo, ro, dd, c, ov in TJoinQuery(conf, GRID).run_soa_panes(
                 iter([dict(left)]), iter([dict(right)]), 0.4,
-                num_segments=n_obj, mesh=m, backend="device",
+                num_segments=n_obj, mesh=m, backend="device", **kw,
             )  # backend forced: auto would route the mesh-less run to
         ]  # the NATIVE engine (1e-12, not bit, vs the device scan)
 
@@ -410,3 +410,10 @@ def test_tjoin_pane_engine_mesh_bit_matches_single(rng, mesh):
     meshed = run(mesh)
     assert single == meshed  # exact — incl. every float distance bit
     assert sum(len(r[2]) for r in single) > 0, "degenerate: no pairs"
+    # Compaction commutes with sharding: the live-slot compacted scan
+    # (auto bucket — the default above on CPU) under the mesh must also
+    # bit-match the FULL-RING probe single-device — replicated live
+    # counts + positional heads shard-invariantly reproduce the legacy
+    # candidate sets.
+    full_ring_single = run(None, cap_c=0)
+    assert full_ring_single == meshed

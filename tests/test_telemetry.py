@@ -194,6 +194,17 @@ def test_abstract_signature_statics_and_dtypes():
     assert abstract_signature((), {"k": 5}) != abstract_signature(
         (), {"k": 6}
     )
+    # kwarg CONTAINERS of arrays contribute avals, not repr — repr
+    # would materialize the arrays (a device fetch per call; the pane
+    # scan's lps_expire tuples hit this)
+    t1 = (np.zeros((8, 4), np.int32), np.zeros((8, 4), bool))
+    t2 = (np.ones((8, 4), np.int32), np.ones((8, 4), bool))
+    assert abstract_signature((), {"e": t1}) == abstract_signature(
+        (), {"e": t2}
+    )  # same avals, different values → one compile
+    assert abstract_signature((), {"e": t1}) != abstract_signature(
+        (), {"e": (np.zeros((4, 4), np.int32), np.zeros((4, 4), bool))}
+    )
 
 
 def test_instrument_jit_passes_attributes_through():
