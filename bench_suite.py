@@ -92,6 +92,15 @@ def _result(name, n_points, seconds, extra=None, spread=None, resident=None):
 REPS = 5  # timed repetitions per config (median + min/max recorded)
 
 
+def _instr(jfn, name):
+    """Wrap a hand-built jit with the telemetry runtime table/recompile
+    detector (deferred import: jax/spatialflink must not load before
+    main() settles the --cpu-baseline backend env)."""
+    from spatialflink_tpu.telemetry import instrument_jit
+
+    return instrument_jit(jfn, name=name)
+
+
 def _resident_rate(jax, body, carry0, xs, n_pts_per_pass, reps=REPS):
     """Device-resident rate of a per-window program: ``xs`` (already on
     device, leading axis = windows) is scanned by ``body`` inside ONE
@@ -102,7 +111,10 @@ def _resident_rate(jax, body, carry0, xs, n_pts_per_pass, reps=REPS):
     sync is one device_get of the per-window summary outputs (real
     fetch — block_until_ready is a no-op on the tunnel). Returns
     (median_pps, min_pps, max_pps, last_outs)."""
-    jpass = jax.jit(lambda c, x: jax.lax.scan(body, c, x))
+    jpass = _instr(
+        jax.jit(lambda c, x: jax.lax.scan(body, c, x)),
+        "resident_scan",
+    )
     c, out = jpass(carry0, xs)
     jax.device_get(out)  # compile + settle
     t0 = time.perf_counter()
@@ -186,7 +198,7 @@ def bench_range_window(jax, jnp, grid, quick):
         )
         return jnp.sum(keep)
 
-    jstep = jax.jit(step)
+    jstep = _instr(jax.jit(step), "range_window_step")
 
     def win_xy(i):
         return jax.device_put(xy[i * win_pts:(i + 1) * win_pts], dev)
@@ -264,8 +276,11 @@ def bench_knn_k(jax, jnp, grid, k, quick):
     def pane_step(wire_p, query_xy):
         return digest(wire_p, wire_p.shape[1], query_xy, scale, origin, r32)
 
-    jpane = jax.jit(pane_step)
-    jmerge = jax.jit(knn_merge_digest_list, static_argnames="k")
+    jpane = _instr(jax.jit(pane_step), "knn_pane_digest")
+    jmerge = _instr(
+        jax.jit(knn_merge_digest_list, static_argnames="k"),
+        "knn_window_merge",
+    )
     no_bases = np.zeros(ppw, np.int32)  # rep indices unread by this bench
 
     # Warm-up: compile both programs. NB: on the axon tunnel,
@@ -372,7 +387,7 @@ def bench_polygon_range(jax, jnp, grid, quick):
         )
         return jnp.sum(keep), over
 
-    jstep = jax.jit(step)
+    jstep = _instr(jax.jit(step), "polygon_range_step")
 
     def win_xy(i):
         return jax.device_put(xy[i * win_pts:(i + 1) * win_pts], dev)
@@ -430,7 +445,7 @@ def bench_join(jax, jnp, grid, quick):
             cap_left=48, cap_right=48, max_pairs=262_144,
         )
 
-    jstep = jax.jit(step)
+    jstep = _instr(jax.jit(step), "join_window_step")
 
     def win_arrays(i):
         sl = slice(i * win_pts, (i + 1) * win_pts)
@@ -503,7 +518,7 @@ def bench_knn_multi_query(jax, jnp, grid, quick):
             np.float32(0.05), k=k, num_segments=16_384, query_block=32,
         )
 
-    jstep = jax.jit(step)
+    jstep = _instr(jax.jit(step), "knn_multi_query_step")
 
     def win_arrays(i):
         sl = slice(i * win_pts, (i + 1) * win_pts)
@@ -592,8 +607,8 @@ def bench_point_polygon_join(jax, jnp, grid, quick):
         )
         return jnp.sum(mask.astype(jnp.int32))
 
-    jpruned = jax.jit(pruned)
-    jdense = jax.jit(dense)
+    jpruned = _instr(jax.jit(pruned), "pp_join_pruned")
+    jdense = _instr(jax.jit(dense), "pp_join_dense")
 
     def win_xy(i):
         sl = xy[i * win_pts:(i + 1) * win_pts]
@@ -752,7 +767,7 @@ def bench_tjoin_sliding(jax, jnp, grid, quick):
             jnp.concatenate(l_slides), jnp.concatenate(r_slides)
         )
 
-    jstep = jax.jit(window_step)
+    jstep = _instr(jax.jit(window_step), "tjoin_window_step")
 
     def slide_pair(i):
         sl = slice(i * slide_pts, (i + 1) * slide_pts)
@@ -1093,7 +1108,8 @@ def bench_headline_knn_1m(jax, jnp, grid):
     ))
     oid16 = rng.integers(0, NUM_SEGMENTS, total).astype(np.int16)
     wire = np.concatenate([xyq, oid16.view(np.uint16)[:, None]], axis=1)
-    jstep = jax.jit(build_headline_step(jnp, wf))
+    jstep = _instr(jax.jit(build_headline_step(jnp, wf)),
+                   "headline_step")
     q = jnp.asarray(np.array([116.40, 40.19], np.float32))
     big = np.float32(np.finfo(np.float32).max)
     sp0 = jnp.full((NUM_SEGMENTS,), big, jnp.float32)
@@ -1147,7 +1163,7 @@ def bench_tknn(jax, jnp, grid, quick):
             k=20, num_segments=16_384,
         )
 
-    jstep = jax.jit(step)
+    jstep = _instr(jax.jit(step), "tknn_step")
 
     def win_arrays(i):
         sl = slice(i * win_pts, (i + 1) * win_pts)
@@ -1252,7 +1268,34 @@ def main():
         ]
         if not all_benches:
             raise SystemExit(f"--configs matched nothing: {args.configs}")
-    results = [fn() for _name, fn in all_benches]
+    ledger_dir = os.environ.get("SFT_LEDGER_DIR")
+    results = []
+    for name, fn in all_benches:
+        if ledger_dir:
+            # One run ledger per config (tools/sfprof): telemetry is
+            # (re-)enabled around each config so every ledger carries
+            # exactly that config's spans/kernel table/byte tallies,
+            # plus the config's own result record as the bench block.
+            from spatialflink_tpu.telemetry import telemetry
+
+            telemetry.enable()
+            res = fn()
+            try:
+                telemetry.write_ledger(
+                    os.path.join(ledger_dir, f"{name}.json"), bench=res
+                )
+            except Exception as e:
+                # A ledger failure (disk full, NaN in a result dict) must
+                # not abort a multi-hour suite run and lose every other
+                # config's result — same degrade-to-stderr as bench.py.
+                import sys
+
+                sys.stderr.write(f"ledger for {name} not written: {e!r}\n")
+            finally:
+                telemetry.disable()
+        else:
+            res = fn()
+        results.append(res)
     if args.cpu_baseline:
         results.append(bench_headline_knn_1m(jax, jnp, grid))
         payload = {

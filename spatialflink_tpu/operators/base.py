@@ -342,7 +342,11 @@ def jitted(fn: Callable, *static: str):
     distinct abstract-shape signature entering a kernel is one XLA compile
     (~1-2 s + a tunnel round trip here), so bucket-size churn surfaces as
     recorded compile events / a RecompileWarning instead of silent
-    slowness. Free when telemetry is disabled (one attribute check)."""
+    slowness. The same wrapper feeds the per-(kernel, signature) runtime
+    table behind the run ledger (calls, dispatch wall-ns, first-call
+    compile-inclusive latency, lazily captured XLA cost analysis —
+    tools/sfprof reports it). Free when telemetry is disabled (one
+    attribute check)."""
     jfn = jax.jit(fn, static_argnames=static) if static else jax.jit(fn)
     return instrument_jit(jfn, name=getattr(fn, "__name__", str(fn)))
 

@@ -29,18 +29,35 @@ microsecond ts/dur) as JSON-lines; ``load_trace`` wraps a trace file into
 the standard ``{"traceEvents": [...]}`` document. Spans named
 ``window.*`` additionally feed a ``FixedBucketLatency`` histogram, so
 p50/p95 window latency lands in NES reporter lines and bench.py's JSON.
+
+On top of the raw signals sits the **run ledger** (``write_ledger``): a
+per-(kernel, signature) runtime table fed by ``instrument_jit`` (call
+count, cumulative dispatch wall-ns, first-call compile-inclusive
+latency) plus lazy host-side XLA cost capture (``capture_costs`` —
+AOT ``lower().compile().cost_analysis()/memory_analysis()`` from
+recorded avals, never live arrays, zero device round trips), exported
+as ONE schema-versioned JSON document that ``tools/sfprof`` reports,
+diffs, and gates on.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
 from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
+
+
+#: Run-ledger schema version (bump on any breaking change to the document
+#: layout). Twin constant: tools/sfprof/ledger.py:LEDGER_VERSION — the
+#: validator deliberately doesn't import this package, so bump BOTH
+#: (tests/test_sfprof.py cross-pins them).
+LEDGER_VERSION = 1
 
 
 class RecompileWarning(UserWarning):
@@ -50,17 +67,22 @@ class RecompileWarning(UserWarning):
 
 def _arg_signature(a):
     """One argument's contribution to the abstract signature. Arrays →
-    (shape, dtype) — the aval; tuples/lists recurse (jit flattens pytrees,
-    so a container of arrays recompiles whenever ANY leaf's shape changes
-    — e.g. the knn pane digests repadded to a grown nseg); other leaves
-    contribute only their type (jit treats distinct Python scalars of one
-    type as one aval)."""
+    (shape, dtype) — the aval; tuples/lists/dicts recurse (jit flattens
+    pytrees, so a container of arrays recompiles whenever ANY leaf's
+    shape changes — e.g. the knn pane digests repadded to a grown nseg;
+    repr of a container would MATERIALIZE its arrays, a device fetch per
+    call); other leaves contribute only their type (jit treats distinct
+    Python scalars of one type as one aval)."""
     shape = getattr(a, "shape", None)
     dtype = getattr(a, "dtype", None)
     if shape is not None and dtype is not None:
         return (tuple(shape), str(dtype))
     if isinstance(a, (tuple, list)):
         return (type(a).__name__, tuple(_arg_signature(x) for x in a))
+    if isinstance(a, dict):
+        return ("dict", tuple(
+            (str(k), _arg_signature(v)) for k, v in sorted(a.items())
+        ))
     return type(a).__name__
 
 
@@ -82,7 +104,7 @@ def abstract_signature(args: tuple, kwargs: Optional[dict] = None) -> Tuple:
         dtype = getattr(v, "dtype", None)
         if shape is not None and dtype is not None:
             parts.append((k, (tuple(shape), str(dtype))))
-        elif isinstance(v, (tuple, list)):
+        elif isinstance(v, (tuple, list, dict)):
             parts.append((k, _arg_signature(v)))
         else:
             parts.append((k, repr(v)))
@@ -154,6 +176,12 @@ class Telemetry:
         # engine → {capacity bucket → {"picks", "max_live"}} — the
         # compaction control plane's pick log (ops/compaction.py).
         self._compaction: Dict[str, Dict[int, Dict[str, int]]] = {}
+        # (kernel, signature) → {"calls", "dispatch_ns", "first_call_ns",
+        # "cost", "lower"} — the per-kernel runtime table behind
+        # kernel_table()/capture_costs() (fed by instrument_jit).
+        self._kernel_stats: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+        # tids already named via a ph:"M" thread_name metadata event.
+        self._named_tids: set = set()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -171,6 +199,16 @@ class Telemetry:
                 d = os.path.dirname(os.path.abspath(trace_path))
                 os.makedirs(d, exist_ok=True)
                 self._trace_file = open(trace_path, "w")
+                # Chrome-trace metadata: name the process once per pid so
+                # Perfetto shows the program, not a bare number. Threads
+                # are named lazily — one ph:"M" per NEW tid at its first
+                # event (_emit) — because operator threads don't exist yet
+                # at enable() time.
+                self._write_trace({
+                    "name": "process_name", "ph": "M", "pid": os.getpid(),
+                    "args": {"name": "spatialflink_tpu:"
+                             + os.path.basename(sys.argv[0] or "python")},
+                })
             self.enabled = True
 
     def disable(self):
@@ -181,6 +219,16 @@ class Telemetry:
                 self._trace_file = None
 
     FLUSH_EVERY = 256
+
+    def flush_trace(self):
+        """Drain the buffered trace writer NOW. Call before a timed
+        region: emits inside it then start from a fresh FLUSH_EVERY
+        budget, so the periodic disk flush can't land mid-measurement
+        (bench.py's latency probe)."""
+        with self._lock:
+            if self._trace_file is not None:
+                self._trace_file.flush()
+                self._since_flush = 0
 
     def _write_trace(self, event: dict):
         """Buffered trace write (caller holds the lock). No per-event
@@ -229,6 +277,19 @@ class Telemetry:
             else:
                 self.dropped_events += 1
             if self._trace_file is not None:
+                tid = event.get("tid")
+                if tid is not None and tid not in self._named_tids:
+                    # First event from this thread: emit its thread_name
+                    # metadata so the trace row reads e.g. "MainThread"
+                    # / the operator thread's name instead of a raw
+                    # ident. _emit runs on the emitting thread, so
+                    # current_thread() IS the thread being named.
+                    self._named_tids.add(tid)
+                    self._write_trace({
+                        "name": "thread_name", "ph": "M",
+                        "pid": event.get("pid", os.getpid()), "tid": tid,
+                        "args": {"name": threading.current_thread().name},
+                    })
                 self._write_trace(event)
 
     # -- device-boundary accounting -------------------------------------------
@@ -249,11 +310,21 @@ class Telemetry:
                 })
 
     def account_d2h(self, nbytes: int):
+        """Bytes fetched device→host (counted at the true-sync fetch).
+        Mirrors ``account_h2d`` exactly — including the Chrome-trace
+        ``ph:"C"`` counter event, so d2h traffic renders as a Perfetto
+        counter track too (the h2d/d2h asymmetry hid egress bytes)."""
         if not self.enabled:
             return
         with self._lock:
             self.d2h_bytes += int(nbytes)
             self.d2h_transfers += 1
+            if self._trace_file is not None:
+                self._write_trace({
+                    "name": "d2h_bytes", "ph": "C",
+                    "ts": time.perf_counter_ns() // 1000,
+                    "pid": os.getpid(), "args": {"bytes": self.d2h_bytes},
+                })
 
     def fetch(self, x):
         """True-sync device→host fetch with timing + byte accounting.
@@ -286,19 +357,21 @@ class Telemetry:
 
     # -- recompile detection --------------------------------------------------
 
-    def record_jit_call(self, kernel: str, signature: Tuple):
+    def record_jit_call(self, kernel: str, signature: Tuple) -> bool:
         """Record a call into a jitted kernel. A signature not seen before
         for this kernel is one XLA compile (jit's cache key is the abstract
         shapes + statics this signature proxies). Crossing
         ``recompile_warn_threshold`` distinct signatures warns once —
-        catching bucket-size churn and accidentally dynamic shapes."""
+        catching bucket-size churn and accidentally dynamic shapes.
+        Returns True iff the signature is NEW (so the caller can do
+        first-call-only work, e.g. stash avals for cost capture)."""
         if not self.enabled:
-            return
+            return False
         warn_n = None
         with self._lock:
             seen = self._shapes_seen.setdefault(kernel, set())
             if signature in seen:
-                return
+                return False
             seen.add(signature)
             self.compile_events.append((kernel, signature))
             if (len(seen) >= self.recompile_warn_threshold
@@ -321,6 +394,7 @@ class Telemetry:
                 RecompileWarning,
                 stacklevel=3,
             )
+        return True
 
     @property
     def compile_count(self) -> int:
@@ -329,6 +403,132 @@ class Telemetry:
     def distinct_shapes(self, kernel: str) -> int:
         with self._lock:
             return len(self._shapes_seen.get(kernel, ()))
+
+    # -- per-kernel runtime table + cost capture -------------------------------
+
+    def record_kernel_time(self, kernel: str, signature: Tuple,
+                           dur_ns: int, lower_ctx=None):
+        """One dispatch into an instrumented kernel: accumulate call count
+        and dispatch wall-ns per (kernel, signature); the first call's
+        duration is kept separately (it includes the XLA compile).
+        ``lower_ctx`` — a ``(fn, abstract_args, abstract_kwargs)`` triple
+        built from ShapeDtypeStructs, never live arrays — is stashed so
+        ``capture_costs`` can lower/compile host-side LATER, strictly off
+        the hot path."""
+        if not self.enabled:
+            return
+        with self._lock:
+            key = (kernel, signature)
+            st = self._kernel_stats.get(key)
+            if st is None:
+                st = self._kernel_stats[key] = {
+                    "calls": 0,
+                    "dispatch_ns": 0,
+                    "first_call_ns": int(dur_ns),
+                    "cost": None,
+                    "lower": lower_ctx,
+                }
+            elif lower_ctx is not None and st["lower"] is None \
+                    and st["cost"] is None:
+                st["lower"] = lower_ctx
+            st["calls"] += 1
+            st["dispatch_ns"] += int(dur_ns)
+
+    def capture_costs(self):
+        """Lazy host-side XLA cost/memory analysis, once per (kernel,
+        signature). AOT ``fn.lower(*avals).compile()`` never executes the
+        program and moves no data, so this adds ZERO device round trips
+        (pinned under ``jax.transfer_guard`` in tests) — it only costs
+        host compile time, which is why it runs here (write_ledger /
+        explicit call) and never on the hot path. Idempotent; a kernel
+        that won't lower records ``{"error": ...}`` instead of blocking
+        the ledger."""
+        with self._lock:
+            pending = [
+                st for st in self._kernel_stats.values()
+                if st["cost"] is None and st["lower"] is not None
+            ]
+        for st in pending:
+            fn, a_args, a_kwargs = st["lower"]
+            cost = _analyze_cost(fn, a_args, a_kwargs)
+            with self._lock:
+                st["cost"] = cost
+                st["lower"] = None
+
+    def kernel_table(self) -> list:
+        """JSON-safe per-(kernel, signature) rows: calls, cumulative
+        dispatch wall-ns, first-call (compile-inclusive) ns, the derived
+        ``steady_ns`` (cumulative MINUS the first call — a compile here
+        is ~1-2 s against sub-ms dispatches, so ranking by the raw
+        cumulative would just rank compiles), and the captured cost
+        block (None until ``capture_costs`` runs). Sorted by steady
+        dispatch time, heaviest first."""
+        with self._lock:
+            rows = [
+                {
+                    "kernel": kernel,
+                    "signature": repr(sig),
+                    "calls": st["calls"],
+                    "dispatch_ns": st["dispatch_ns"],
+                    "first_call_ns": st["first_call_ns"],
+                    "steady_ns": max(
+                        st["dispatch_ns"] - st["first_call_ns"], 0
+                    ),
+                    "cost": st["cost"],
+                }
+                for (kernel, sig), st in self._kernel_stats.items()
+            ]
+        rows.sort(key=lambda r: (-r["steady_ns"], -r["dispatch_ns"],
+                                 r["kernel"]))
+        return json_safe(rows)
+
+    # -- run ledger ------------------------------------------------------------
+
+    def write_ledger(self, path: str, bench: Optional[dict] = None,
+                     mesh=None, capture_costs: bool = True) -> str:
+        """One schema-versioned JSON run-ledger document: environment
+        (python/jax/backend/devices, optional mesh shape), the full
+        ``snapshot()``, the per-kernel runtime table (costs captured
+        lazily here unless ``capture_costs=False``), the buffered span
+        events (so ``tools/sfprof report`` can attribute phases without
+        a separate trace file), and the caller's bench record. Strict
+        JSON (``allow_nan=False``) — a NaN/Inf anywhere is a bug and
+        raises rather than shipping an unparseable artifact. Consumed by
+        ``python -m tools.sfprof`` (report / diff --gate / health)."""
+        import jax
+
+        if capture_costs:
+            self.capture_costs()
+        env = {
+            "python": sys.version.split()[0],
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "devices": [str(d) for d in jax.devices()[:8]],
+            "x64": bool(jax.config.jax_enable_x64),
+            "pid": os.getpid(),
+            "argv0": os.path.basename(sys.argv[0] or "python"),
+        }
+        if mesh is not None:
+            env["mesh"] = {str(k): int(v)
+                           for k, v in dict(mesh.shape).items()}
+        with self._lock:
+            events = list(self.events)
+        doc = {
+            "ledger_version": LEDGER_VERSION,
+            "created_unix": time.time(),
+            "env": env,
+            "snapshot": self.snapshot(),
+            "kernels": self.kernel_table(),
+            "events": events,
+            "bench": json_safe(bench) if bench is not None else None,
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, allow_nan=False)
+            f.write("\n")
+        return path
 
     # -- compaction bucket accounting -----------------------------------------
 
@@ -451,14 +651,114 @@ def fetch(x):
     return telemetry.fetch(x)
 
 
+def write_ledger(path: str, bench: Optional[dict] = None, mesh=None,
+                 capture_costs: bool = True) -> str:
+    return telemetry.write_ledger(path, bench=bench, mesh=mesh,
+                                  capture_costs=capture_costs)
+
+
+def _abstract_leaf(a):
+    """ShapeDtypeStruct mirror of one call argument for DEFERRED AOT
+    lowering: arrays become avals (no reference to the device buffer is
+    retained — keeping donated inputs alive would defeat
+    ``donate_argnums``), tuple/list/NamedTuple/dict containers recurse,
+    static scalars/strings keep their value (it keys the compile cache),
+    and any other leaf type raises — an object we can't prove
+    buffer-free must not be pinned in the stats table."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        import jax
+
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    if isinstance(a, (tuple, list)):
+        parts = [_abstract_leaf(x) for x in a]
+        if hasattr(a, "_fields"):  # NamedTuple carries (pane scans, …)
+            return type(a)(*parts)  # positional ctor, not an iterable
+        return type(a)(parts)
+    if isinstance(a, dict):
+        return {k: _abstract_leaf(v) for k, v in a.items()}
+    if a is None or isinstance(
+            a, (bool, int, float, complex, str, bytes, type)):
+        return a  # static scalar: the value keys the compile cache
+    # Anything else (custom pytree, exotic object) could hide a device
+    # buffer — refuse rather than pin it in _kernel_stats (the caller
+    # records cost as unavailable instead).
+    raise TypeError(
+        f"unsupported leaf for deferred lowering: {type(a).__name__}"
+    )
+
+
+def _lower_ctx(fn, args, kwargs):
+    """(fn, abstract args, abstract kwargs) for a later host-side
+    ``fn.lower(...)`` — or None when ``fn`` has no AOT surface (e.g. a
+    plain callable wrapped for signature tracking only)."""
+    if not hasattr(fn, "lower"):
+        return None
+    try:
+        return (
+            fn,
+            tuple(_abstract_leaf(a) for a in args),
+            {k: _abstract_leaf(v) for k, v in kwargs.items()},
+        )
+    except Exception:  # exotic arg types: skip cost capture, pin nothing
+        return None
+
+
+def _analyze_cost(fn, args, kwargs) -> Dict[str, Any]:
+    """Host-side XLA cost + memory analysis of one (kernel, signature).
+
+    AOT lower/compile from avals: nothing executes, nothing crosses the
+    device boundary. Failures come back as ``{"error": ...}`` so one
+    unlowerable program never blocks the ledger."""
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else {}
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed"),
+                         ("transcendentals", "transcendentals")):
+            if ca and src in ca:
+                out[dst] = float(ca[src])
+    except Exception:  # pragma: no cover - backend without cost analysis
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr, dst in (("temp_size_in_bytes", "temp_bytes"),
+                          ("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes"),
+                          ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[dst] = int(v)
+        if "temp_bytes" in out:
+            # Peak working set of one dispatch: arguments + outputs +
+            # XLA temp buffers (the quantity that overflows HBM).
+            out["peak_memory_bytes"] = (out["temp_bytes"]
+                                        + out.get("argument_bytes", 0)
+                                        + out.get("output_bytes", 0))
+    except Exception:  # pragma: no cover - backend without memory stats
+        pass
+    return out or {"error": "cost analysis unavailable on this backend"}
+
+
 def instrument_jit(fn, name: Optional[str] = None):
-    """Wrap a compiled callable with recompile-signature tracking.
+    """Wrap a compiled callable with recompile-signature tracking and the
+    per-(kernel, signature) runtime table.
 
     ``operators/base.py:jitted`` routes every operator kernel through this;
     bench.py wraps its hand-jitted steps the same way. Disabled-path cost:
     one attribute check per call (calls here are per WINDOW, never per
-    record). Attributes of the underlying jit object (``lower``, …) pass
-    through.
+    record). Enabled, each call adds two clock reads and a locked table
+    update; a NEW signature additionally stashes ShapeDtypeStruct avals
+    so ``telemetry.capture_costs()`` can lower/compile host-side later —
+    nothing device-facing happens on the call path. Attributes of the
+    underlying jit object (``lower``, …) pass through.
     """
     label = name or getattr(fn, "__name__", repr(fn))
 
@@ -466,11 +766,18 @@ def instrument_jit(fn, name: Optional[str] = None):
         __slots__ = ()
 
         def __call__(self, *args, **kwargs):
-            if telemetry.enabled:
-                telemetry.record_jit_call(
-                    label, abstract_signature(args, kwargs)
-                )
-            return fn(*args, **kwargs)
+            if not telemetry.enabled:
+                return fn(*args, **kwargs)
+            sig = abstract_signature(args, kwargs)
+            is_new = telemetry.record_jit_call(label, sig)
+            t0 = time.perf_counter_ns()
+            out = fn(*args, **kwargs)
+            dur_ns = time.perf_counter_ns() - t0
+            telemetry.record_kernel_time(
+                label, sig, dur_ns,
+                lower_ctx=_lower_ctx(fn, args, kwargs) if is_new else None,
+            )
+            return out
 
         def __getattr__(self, attr):
             return getattr(fn, attr)

@@ -271,14 +271,21 @@ class TestTelemetryBlock:
     def test_smoke_run_emits_telemetry_summary(self, tmp_path):
         """SFT_BENCH_SMOKE runs the REAL measured program at toy sizes on
         XLA:CPU: still exactly ONE JSON line, now with the telemetry
-        summary, and the Chrome-trace side channel loads as valid JSON."""
+        summary, and the Chrome-trace side channel loads as valid JSON.
+        SFT_LEDGER_PATH additionally captures the run ledger, which must
+        validate against the sfprof schema, attribute the probe's
+        window spans, carry CPU cost analysis, and survive the
+        ``sfprof diff --gate`` round trip (self-diff 0, injected
+        regression nonzero)."""
         trace = tmp_path / "bench_trace.jsonl"
+        ledger = tmp_path / "bench_ledger.json"
         env = {
             **os.environ,
             "SFT_BENCH_SMOKE": "1",
             "SFT_BENCH_BACKOFFS": "0",
             "SFT_BENCH_LAST_GOOD": str(tmp_path / "lg.json"),
             "SFT_TRACE_PATH": str(trace),
+            "SFT_LEDGER_PATH": str(ledger),
             "JAX_PLATFORMS": "cpu",
             "PALLAS_AXON_POOL_IPS": "",
         }
@@ -311,3 +318,60 @@ class TestTelemetryBlock:
         names = {e["name"] for e in doc["traceEvents"]}
         assert "window.headline" in names
         assert any(n.startswith("compile:") for n in names)
+        # Counter-event symmetry: BOTH transfer directions render as
+        # Perfetto counter tracks.
+        counters = {e["name"] for e in doc["traceEvents"]
+                    if e.get("ph") == "C"}
+        assert {"h2d_bytes", "d2h_bytes"} <= counters
+
+        # ---- run ledger: schema, attribution, costs, gate. ----
+        from tools.sfprof import ledger as ledger_mod
+        from tools.sfprof.attribution import attribute_windows
+        from tools.sfprof.cli import main as sfprof_main
+
+        led = ledger_mod.load(str(ledger))
+        assert ledger_mod.validate(led) == [], ledger_mod.validate(led)
+        # The bench block is the SAME record the driver line carried.
+        assert led["bench"]["value"] == rec["value"]
+        assert led["bench"]["smoke"] is True
+        # Per-kernel flops/bytes from XLA cost analysis on CPU.
+        costed = [r for r in led["kernels"]
+                  if r["cost"] and r["cost"].get("flops")]
+        assert costed, led["kernels"]
+        assert {"headline_step", "headline_step_donated"} <= {
+            r["kernel"] for r in led["kernels"]
+        }
+        # Every window.* span is ≥90% attributed to its phase children
+        # OR the residue is reported explicitly — either way no silently
+        # missing time: phases + unattributed == the window's dur,
+        # exactly. (At toy smoke sizes the windows are sub-ms, so span-
+        # machinery µs can push the residue past 10% — the explicit
+        # residue is the contract, the 90% is what real window sizes
+        # deliver.)
+        windows, ops = attribute_windows(led["events"])
+        assert windows, "ledger carried no window spans"
+        for w in windows:
+            assert (sum(w["phases"].values()) + w["unattributed_us"]
+                    == w["dur_us"])
+            assert (w["attributed_frac"] >= 0.9
+                    or w["unattributed_us"] > 0)
+        agg = ops["window.headline"]
+        assert {"compute", "fetch"} <= set(agg["phases"])
+        attributed = sum(agg["phases"].values())
+        assert attributed + agg["unattributed_us"] == agg["dur_us"]
+        # The probe's dispatch+fetch dominate even at toy sizes.
+        assert attributed / agg["dur_us"] >= 0.5
+
+        # report renders; self-diff gates clean; an injected EPS
+        # regression (beyond the ±50% tolerance band) gates nonzero.
+        assert sfprof_main(["report", str(ledger)]) == 0
+        assert sfprof_main(["diff", str(ledger), str(ledger),
+                            "--gate"]) == 0
+        bad = json.loads(json.dumps(led))
+        bad["bench"]["value"] = led["bench"]["value"] / 10.0
+        bad_path = tmp_path / "bench_ledger_regressed.json"
+        bad_path.write_text(json.dumps(bad))
+        assert sfprof_main(["diff", str(ledger), str(bad_path),
+                            "--gate"]) != 0
+        # The post-bench health check (CLAUDE.md) passes on a clean run.
+        assert sfprof_main(["health", str(ledger)]) == 0

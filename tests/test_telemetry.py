@@ -38,8 +38,13 @@ GRID = UniformGrid(20, 0.0, 10.0, 0.0, 10.0)
 
 @pytest.fixture(autouse=True)
 def _telemetry_off():
-    """Every test leaves the process-global singleton disabled."""
+    """Every test leaves the process-global singleton disabled, with the
+    event-buffer cap restored (enable() resets counters but deliberately
+    not the configured cap — a test shrinking it must not leak that into
+    later files)."""
+    cap = telemetry.max_events
     yield
+    telemetry.max_events = cap
     telemetry.disable()
 
 
@@ -94,11 +99,13 @@ def test_spans_nest_and_trace_is_chrome_loadable(tmp_path):
     doc = load_trace(str(path))
     json.dumps(doc)  # must be valid JSON end to end
     assert set(doc) == {"traceEvents"}
-    evs = {e["name"]: e for e in doc["traceEvents"]}
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["name"] for m in meta} == {"process_name", "thread_name"}
+    evs = {e["name"]: e for e in spans}
     assert set(evs) == {"window.test", "assemble", "compute"}
-    for e in doc["traceEvents"]:
+    for e in spans:
         # Chrome-trace complete events: microsecond ts/dur, pid/tid.
-        assert e["ph"] == "X"
         assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
         assert "pid" in e and "tid" in e
     win = evs["window.test"]
@@ -130,6 +137,105 @@ def test_event_buffer_caps_and_counts_drops():
             pass
     assert len(telemetry.events) == 4
     assert telemetry.dropped_events == 2
+
+
+def test_trace_file_roundtrip_and_drop_counter_pinned(tmp_path):
+    """The in-memory buffer caps at max_events (drops COUNTED, exported
+    in snapshot()); the trace FILE keeps every event — the cap bounds
+    memory, not the artifact. load_trace round-trips what _write_trace
+    wrote, in emit order."""
+    path = tmp_path / "cap.jsonl"
+    telemetry.enable(trace_path=str(path))
+    telemetry.max_events = 2
+    for i in range(5):
+        with telemetry.span(f"s{i}"):
+            pass
+    assert len(telemetry.events) == 2
+    assert telemetry.dropped_events == 3
+    assert telemetry.snapshot()["dropped_events"] == 3
+    telemetry.disable()
+
+    doc = load_trace(str(path))
+    json.dumps(doc)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == [f"s{i}" for i in range(5)]
+    # Buffered events and file events agree where both exist.
+    assert spans[:2] == telemetry.events
+
+
+def test_disable_mid_span_exit_is_silent(tmp_path):
+    """A span open across disable() must exit silently (the _emit_span
+    early return): no raise — the trace file is already closed — no
+    event, no latency observation."""
+    telemetry.enable(trace_path=str(tmp_path / "mid.jsonl"))
+    sp = telemetry.span("window.mid")
+    sp.__enter__()
+    telemetry.disable()
+    assert sp.__exit__(None, None, None) is False  # and no exception
+    assert all(e.get("name") != "window.mid" for e in telemetry.events)
+    assert telemetry.window_latency.count == 0
+
+
+def test_trace_metadata_names_process_and_threads(tmp_path):
+    """ph:"M" metadata: process_name once per pid (at enable), thread_name
+    once per NEW tid at its first event — so Perfetto rows carry thread
+    names instead of raw idents."""
+    import threading
+
+    path = tmp_path / "meta.jsonl"
+    telemetry.enable(trace_path=str(path))
+    with telemetry.span("window.a"):
+        pass
+    with telemetry.span("window.b"):
+        pass
+
+    def emit():
+        with telemetry.span("window.worker"):
+            pass
+
+    t = threading.Thread(target=emit, name="op-worker")
+    t.start()
+    t.join()
+    telemetry.disable()
+
+    evs = load_trace(str(path))["traceEvents"]
+    procs = [e for e in evs if e["ph"] == "M"
+             and e["name"] == "process_name"]
+    threads = [e for e in evs if e["ph"] == "M"
+               and e["name"] == "thread_name"]
+    assert len(procs) == 1  # once per pid
+    assert procs[0]["args"]["name"].startswith("spatialflink_tpu:")
+    assert len(threads) == 2  # once per tid, not per event
+    names = {e["tid"]: e["args"]["name"] for e in threads}
+    assert "op-worker" in names.values()
+    # Each thread_name precedes that tid's first span in file order.
+    for tid, _name in names.items():
+        first_meta = next(i for i, e in enumerate(evs)
+                          if e["ph"] == "M" and e.get("tid") == tid)
+        first_span = next(i for i, e in enumerate(evs)
+                          if e["ph"] == "X" and e.get("tid") == tid)
+        assert first_meta < first_span
+
+
+def test_account_d2h_emits_counter_event_like_h2d(tmp_path):
+    """The counter-event symmetry: account_d2h emits the same ph:"C"
+    running-total counter account_h2d does, so device→host traffic is
+    visible in Perfetto too (it used to update totals invisibly)."""
+    path = tmp_path / "counters.jsonl"
+    telemetry.enable(trace_path=str(path))
+    telemetry.account_h2d(64)
+    telemetry.account_d2h(128)
+    telemetry.account_d2h(128)
+    telemetry.disable()
+
+    counters = [e for e in load_trace(str(path))["traceEvents"]
+                if e["ph"] == "C"]
+    h2d = [e["args"]["bytes"] for e in counters
+           if e["name"] == "h2d_bytes"]
+    d2h = [e["args"]["bytes"] for e in counters
+           if e["name"] == "d2h_bytes"]
+    assert h2d == [64]
+    assert d2h == [128, 256]  # running totals, mirroring h2d
 
 
 # -- recompile detection ------------------------------------------------------

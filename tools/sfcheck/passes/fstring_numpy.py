@@ -122,9 +122,14 @@ class FstringNumpyPass(Pass):
                  "in float() first (CLAUDE.md)")
 
     def applies_to(self, relpath: str) -> bool:
+        # tools/sfprof is an egress layer too: report/diff/health print
+        # values parsed straight out of ledgers (and the ledger writer
+        # itself lives in telemetry.py) — the np.float32(…) repr class
+        # must not reach either surface.
         return (relpath in ("bench.py", "spatialflink_tpu/telemetry.py")
                 or relpath.startswith("spatialflink_tpu/sncb/")
-                or relpath.startswith("spatialflink_tpu/mn/"))
+                or relpath.startswith("spatialflink_tpu/mn/")
+                or relpath.startswith("tools/sfprof/"))
 
     def run(self, ctx):
         v = _Visitor()

@@ -1,0 +1,124 @@
+"""Span attribution: Chrome-trace events → per-operator phase breakdown.
+
+The PR 1 span convention is ``window.<operator>`` wrapping the per-window
+phases (``assemble`` → ``ship`` → ``compute`` → ``fetch``, plus extras
+like ``pane.digest`` / ``compaction.plan``) on the same thread. This
+module rebuilds that containment from the flat event stream:
+
+- a CHILD of a window span is any non-window complete event on the same
+  (pid, tid) whose [ts, ts+dur] lies inside the window's (±1 µs for the
+  independent ns→µs floors of ts and dur);
+- only TOP-LEVEL children count toward attribution — a span nested in
+  another child is already covered by its parent's dur (else compute's
+  inner spans would double-count);
+- whatever the children don't cover is the **unattributed residue**,
+  always reported explicitly — host work between phases must show up as
+  a number, never as silently missing time;
+- time BETWEEN consecutive window spans on one thread is a **host gap**
+  (assembly of the next window, serde, GC): invisible inside any span,
+  so it gets its own detector.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Tuple
+
+#: slack (µs) for ts/dur each being floored from ns independently.
+_FLOOR_SLACK_US = 1
+
+
+def complete_spans(events: List[dict]) -> List[dict]:
+    return [
+        e for e in events
+        if e.get("ph") == "X" and isinstance(e.get("ts"), (int, float))
+        and isinstance(e.get("dur"), (int, float))
+    ]
+
+
+def _by_thread(spans: List[dict]) -> Dict[Tuple, List[dict]]:
+    out: Dict[Tuple, List[dict]] = defaultdict(list)
+    for e in spans:
+        out[(e.get("pid"), e.get("tid"))].append(e)
+    for evs in out.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+    return out
+
+
+def attribute_windows(events: List[dict]) -> Tuple[List[dict], Dict[str, dict]]:
+    """(per-window rows, per-operator aggregate).
+
+    Each window row: ``operator`` (the span name), ``ts``, ``dur_us``,
+    ``phases`` {name: µs} over top-level children, ``unattributed_us``,
+    ``attributed_frac``. The aggregate sums those per operator name.
+    """
+    windows: List[dict] = []
+    for _tid, evs in _by_thread(complete_spans(events)).items():
+        wins = [e for e in evs
+                if str(e.get("name", "")).startswith("window.")]
+        others = [e for e in evs
+                  if not str(e.get("name", "")).startswith("window.")]
+        for w in wins:
+            w_end = w["ts"] + w["dur"]
+            inside = [
+                e for e in others
+                if e["ts"] >= w["ts"] - _FLOOR_SLACK_US
+                and e["ts"] + e["dur"] <= w_end + _FLOOR_SLACK_US
+            ]
+            # Top-level filter: spans are sorted by (ts, -dur), so a span
+            # starting before the current frontier is nested in the
+            # previous top-level child.
+            top: List[dict] = []
+            frontier = -1.0
+            for e in inside:
+                if e["ts"] >= frontier:
+                    top.append(e)
+                    frontier = e["ts"] + e["dur"]
+            phases: Dict[str, int] = defaultdict(int)
+            for e in top:
+                phases[str(e.get("name", "?"))] += int(e["dur"])
+            attributed = sum(phases.values())
+            dur = int(w["dur"])
+            windows.append({
+                "operator": str(w["name"]),
+                "ts": w["ts"],
+                "dur_us": dur,
+                "phases": dict(phases),
+                "unattributed_us": max(dur - attributed, 0),
+                "attributed_frac": (
+                    min(attributed / dur, 1.0) if dur > 0 else 1.0
+                ),
+            })
+    windows.sort(key=lambda r: r["ts"])
+
+    ops: Dict[str, dict] = {}
+    for win in windows:
+        agg = ops.setdefault(win["operator"], {
+            "windows": 0, "dur_us": 0, "unattributed_us": 0, "phases": {},
+        })
+        agg["windows"] += 1
+        agg["dur_us"] += win["dur_us"]
+        agg["unattributed_us"] += win["unattributed_us"]
+        for name, us in win["phases"].items():
+            agg["phases"][name] = agg["phases"].get(name, 0) + us
+    return windows, ops
+
+
+def host_gaps(events: List[dict], min_gap_us: int = 1) -> List[dict]:
+    """Gaps between consecutive ``window.*`` spans per thread, largest
+    first: host-side time no span covers."""
+    gaps: List[dict] = []
+    for _tid, evs in _by_thread(complete_spans(events)).items():
+        wins = [e for e in evs
+                if str(e.get("name", "")).startswith("window.")]
+        for prev, nxt in zip(wins, wins[1:]):
+            gap = int(nxt["ts"] - (prev["ts"] + prev["dur"]))
+            if gap >= min_gap_us:
+                gaps.append({
+                    "after": str(prev["name"]),
+                    "before": str(nxt["name"]),
+                    "ts": prev["ts"] + prev["dur"],
+                    "gap_us": gap,
+                })
+    gaps.sort(key=lambda g: -g["gap_us"])
+    return gaps

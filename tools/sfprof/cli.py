@@ -1,0 +1,431 @@
+"""sfprof CLI — ``report`` / ``diff [--gate]`` / ``health``.
+
+Run from the repo root: ``python -m tools.sfprof <cmd> ...``. All three
+subcommands consume run ledgers (``telemetry.write_ledger``); ``report``
+also accepts a raw Chrome trace (``SFT_TRACE_PATH`` JSON-lines or a
+``{"traceEvents"}`` document).
+
+Exit codes: 0 ok; 1 gated regression (``diff --gate``) or failed health
+verdict; 2 unreadable/invalid input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from tools.sfprof import attribution
+from tools.sfprof import ledger as ledger_mod
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "CPU_BASELINE.json")
+
+# -- shared helpers -----------------------------------------------------------
+
+
+def _flatten_numeric(value: Any, prefix: str, out: Dict[str, float]):
+    if isinstance(value, bool):
+        return
+    if isinstance(value, (int, float)):
+        out[prefix] = value
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _flatten_numeric(v, f"{prefix}.{k}" if prefix else str(k), out)
+
+
+def _metrics(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Comparable numeric metrics of one ledger, dotted-key flattened."""
+    out: Dict[str, float] = {}
+    snap = doc.get("snapshot") or {}
+    for key in ("compiles", "bytes_h2d", "bytes_d2h",
+                "window_latency_p50_ms", "window_latency_p95_ms",
+                "max_watermark_lag_ms", "late_dropped", "dropped_events"):
+        v = snap.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[f"snapshot.{key}"] = v
+    _flatten_numeric(doc.get("bench") or {}, "bench", out)
+    return out
+
+
+def _ms(us) -> float:
+    return float(us) / 1000.0
+
+
+# -- report -------------------------------------------------------------------
+
+
+def cmd_report(args) -> int:
+    try:
+        doc, events = ledger_mod.load_any(args.path)
+    except (OSError, ValueError) as e:
+        print(f"sfprof: cannot read {args.path}: {e}")
+        return 2
+    print(f"== sfprof report: {args.path}")
+    if doc is not None:
+        env = doc.get("env") or {}
+        print(
+            "ledger v{v}  backend={b}  jax={j}  devices={d}".format(
+                v=int(doc.get("ledger_version", 0)),
+                b=env.get("backend"), j=env.get("jax"),
+                d=int(env.get("device_count", 0)),
+            )
+        )
+
+    windows, ops = attribution.attribute_windows(events)
+    print("\n-- phase attribution per operator "
+          "(unattributed residue always reported) --")
+    if not ops:
+        print("no window.* spans in the event stream")
+    for name, agg in sorted(ops.items()):
+        total_us = agg["dur_us"]
+        frac = ((total_us - agg["unattributed_us"]) / total_us
+                if total_us else 1.0)
+        print(f"{name}: {int(agg['windows'])} windows, "
+              f"total {float(_ms(total_us)):.3f} ms, "
+              f"attributed {float(100.0 * frac):.1f}%")
+        rows = sorted(agg["phases"].items(), key=lambda kv: -kv[1])
+        rows.append(("unattributed", agg["unattributed_us"]))
+        for phase, us in rows:
+            pct = 100.0 * us / total_us if total_us else 0.0
+            print(f"    {phase:<18} {float(pct):6.1f}%  "
+                  f"{float(_ms(us)):10.3f} ms")
+
+    if doc is not None:
+        kernels = doc.get("kernels") or []
+        print(f"\n-- top {int(args.top)} kernels by steady dispatch time "
+              "(first call = compile, shown separately) --")
+        for row in kernels[:args.top]:
+            cost = row.get("cost") or {}
+            flops = cost.get("flops") or 0.0
+            bytes_acc = cost.get("bytes_accessed") or 0.0
+            steady = row.get(
+                "steady_ns",
+                max(row["dispatch_ns"] - row["first_call_ns"], 0),
+            )
+            print(f"{row['kernel']:<28} calls={int(row['calls']):<6} "
+                  f"steady={float(steady / 1e6):10.3f} ms  "
+                  f"first={float(row['first_call_ns'] / 1e6):10.3f} ms  "
+                  f"flops={float(flops):.3g} "
+                  f"bytes={float(bytes_acc):.3g}")
+            if cost.get("error"):
+                print(f"    cost unavailable: {cost['error']}")
+
+        snap = doc.get("snapshot") or {}
+        churn = sorted(((snap.get("kernels") or {}).items()),
+                       key=lambda kv: -kv[1])
+        print(f"\n-- top {int(args.top)} kernels by distinct compiled "
+              "signatures --")
+        for kernel, n in churn[:args.top]:
+            print(f"{kernel:<28} {int(n)} signatures")
+
+        by_flops = sorted(
+            (r for r in kernels
+             if (r.get("cost") or {}).get("flops") is not None),
+            key=lambda r: -r["cost"]["flops"],
+        )
+        print(f"\n-- top {int(args.top)} kernels by flops per dispatch --")
+        for row in by_flops[:args.top]:
+            print(f"{row['kernel']:<28} "
+                  f"flops={float(row['cost']['flops']):.3g}  "
+                  f"bytes="
+                  f"{float(row['cost'].get('bytes_accessed', 0.0)):.3g}  "
+                  f"peak_mem={int(row['cost'].get('peak_memory_bytes', 0))}")
+
+        n_win = len(windows)
+        if n_win:
+            # Honest label: byte totals cover the WHOLE run (warm-up,
+            # throughput loops, staging), while only the latency-probe
+            # windows carry spans — so this is run-total ÷ traced
+            # windows, an upper bound on true per-window traffic.
+            print("\n-- device-boundary bytes "
+                  "(run totals ÷ traced windows) --")
+            print(f"h2d {float(snap.get('bytes_h2d', 0) / n_win):.1f} "
+                  f"B/traced-win  "
+                  f"d2h {float(snap.get('bytes_d2h', 0) / n_win):.1f} "
+                  f"B/traced-win  over {int(n_win)} traced windows "
+                  f"(run totals: h2d {int(snap.get('bytes_h2d', 0))} B, "
+                  f"d2h {int(snap.get('bytes_d2h', 0))} B)")
+        if snap.get("dropped_events"):
+            print(f"\nWARNING: {int(snap['dropped_events'])} trace events "
+                  "dropped (buffer cap) — attribution above is partial")
+
+    gaps = attribution.host_gaps(events)
+    print(f"\n-- host gaps between window spans (top {int(args.top)}) --")
+    if not gaps:
+        print("none detected")
+    for g in gaps[:args.top]:
+        print(f"{float(_ms(g['gap_us'])):10.3f} ms  after {g['after']} "
+              f"→ before {g['before']}")
+    return 0
+
+
+# -- diff / gate --------------------------------------------------------------
+
+#: higher-is-better throughput metrics (substring match on the leaf key).
+_EPS_LEAVES = ("per_sec",)
+#: lower-is-better duration metrics.
+_LAT_LEAVES = ("latency", "lag_ms")
+#: counters where ANY increase over the baseline ledger is a regression.
+_ZERO_TOL_LEAVES = ("dropped", "overflow")
+
+
+def _kind(name: str) -> str:
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf == "value" or any(s in leaf for s in _EPS_LEAVES):
+        return "eps"
+    if any(s in leaf for s in _LAT_LEAVES):
+        return "latency"
+    if leaf == "compiles":
+        return "compiles"
+    if any(s in leaf for s in _ZERO_TOL_LEAVES):
+        return "zero_tol"
+    return "info"
+
+
+def compare(a_doc: Dict, b_doc: Dict, eps_tol: float, lat_tol: float,
+            baseline: Optional[Dict] = None) -> List[dict]:
+    """Per-metric rows {name, a, b, band, verdict} comparing ledger B
+    (candidate) against ledger A (reference).
+
+    Tolerance bands per metric class: EPS throughput regresses when B
+    falls more than ``eps_tol`` (fraction) below A — wide enough for the
+    documented ±50% tunnel variance; latency when B exceeds A by more
+    than ``lat_tol`` (fraction) plus a 1 ms absolute floor; ``compiles``
+    when B > 2·A + 8 (ladder growth is legitimate, churn is not);
+    dropped/overflow counters on ANY increase. Additionally, suite
+    configs named in CPU_BASELINE.json are guarded against the recorded
+    medians: a B that falls below median·(1−eps_tol) while A was inside
+    the band is a NEW regression (self-diff of an already-slow ledger
+    stays informational, so the gate is monotone)."""
+    rows: List[dict] = []
+    a_m, b_m = _metrics(a_doc), _metrics(b_doc)
+    for name in sorted(set(a_m) | set(b_m)):
+        a, b = a_m.get(name), b_m.get(name)
+        kind = _kind(name)
+        if b is None:
+            # A gateable metric the candidate LOST is a stronger failure
+            # than a bad value (broken telemetry / truncated bench block)
+            # — the gate must not pass on silence.
+            rows.append({"name": name, "a": a, "b": b,
+                         "band": "must exist in B",
+                         "verdict": ("regression" if kind != "info"
+                                     else "info")})
+            continue
+        if a is None:
+            rows.append({"name": name, "a": a, "b": b,
+                         "band": "new in B", "verdict": "info"})
+            continue
+        verdict, band = "info", ""
+        if kind == "eps":
+            band = f"B >= A*(1-{float(eps_tol):g})"
+            if a > 0:
+                verdict = "regression" if b < a * (1 - eps_tol) else "ok"
+        elif kind == "latency":
+            band = f"B <= A*(1+{float(lat_tol):g}) + 1ms"
+            verdict = ("regression"
+                       if b > a * (1 + lat_tol) + 1.0 else "ok")
+        elif kind == "compiles":
+            band = "B <= 2*A + 8"
+            verdict = "regression" if b > 2 * a + 8 else "ok"
+        elif kind == "zero_tol":
+            band = "B <= A"
+            verdict = "regression" if b > a else "ok"
+        rows.append({"name": name, "a": a, "b": b, "band": band,
+                     "verdict": verdict})
+
+    if baseline:
+        rows.extend(_baseline_rows(a_doc, b_doc, baseline, eps_tol))
+    return rows
+
+
+def _baseline_rows(a_doc: Dict, b_doc: Dict, baseline: Dict,
+                   eps_tol: float) -> List[dict]:
+    bench_a = a_doc.get("bench") or {}
+    bench_b = b_doc.get("bench") or {}
+    cfg = bench_b.get("config")
+    checks: List[Tuple[str, Any, Any, float]] = []
+    for block, field in (("configs", "points_per_sec"),
+                         ("configs_resident",
+                          "device_resident_points_per_sec")):
+        median = (baseline.get(block) or {}).get(cfg)
+        if cfg and median:
+            checks.append((
+                f"CPU_BASELINE[{cfg}].{field}",
+                bench_a.get(field), bench_b.get(field), float(median),
+            ))
+    rows = []
+    for name, a, b, median in checks:
+        if not isinstance(b, (int, float)):
+            continue
+        lo = median * (1 - eps_tol)
+        if b >= lo:
+            verdict = "ok"
+        elif isinstance(a, (int, float)) and a < lo:
+            verdict = "info"  # pre-existing: A was already below the band
+        else:
+            verdict = "regression"
+        rows.append({"name": name, "a": a, "b": b,
+                     "band": f"B >= median*(1-{float(eps_tol):g}) = "
+                             f"{float(lo):.1f}",
+                     "verdict": verdict})
+    return rows
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v):.6g}"
+
+
+def cmd_diff(args) -> int:
+    try:
+        a_doc = ledger_mod.load(args.a)
+        b_doc = ledger_mod.load(args.b)
+    except (OSError, ValueError) as e:
+        print(f"sfprof: cannot read ledger: {e}")
+        return 2
+    baseline = None
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass  # no baseline file: skip the median guard
+    rows = compare(a_doc, b_doc, args.eps_tol, args.lat_tol, baseline)
+    regressions = [r for r in rows if r["verdict"] == "regression"]
+    print(f"== sfprof diff: A={args.a}  B={args.b}")
+    for r in rows:
+        if r["verdict"] == "info" and not args.verbose:
+            continue
+        a, b = r["a"], r["b"]
+        delta = ""
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                and a:
+            delta = f"{float(100.0 * (b - a) / a):+8.1f}%"
+        print(f"{r['verdict']:<11} {r['name']:<46} "
+              f"A={_fmt_num(a):<12} B={_fmt_num(b):<12} {delta:<9} "
+              f"[{r['band']}]")
+    print(f"{len(rows)} metrics compared, "
+          f"{len(regressions)} regression(s)")
+    if regressions and args.gate:
+        return 1
+    return 0
+
+
+# -- health -------------------------------------------------------------------
+
+
+def _find_overflows(value: Any, prefix: str, out: List[Tuple[str, float]]):
+    if isinstance(value, dict):
+        for k, v in value.items():
+            path = f"{prefix}.{k}" if prefix else str(k)
+            if ("overflow" in str(k) and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                out.append((path, v))
+            else:
+                _find_overflows(v, path, out)
+
+
+def cmd_health(args) -> int:
+    try:
+        doc = ledger_mod.load(args.ledger)
+    except (OSError, ValueError) as e:
+        print(f"sfprof: cannot read {args.ledger}: {e}")
+        return 2
+    problems = ledger_mod.validate(doc)
+    if problems:
+        print(f"== sfprof health: {args.ledger}")
+        for p in problems:
+            print(f"FAIL schema: {p}")
+        return 1
+    snap = doc.get("snapshot") or {}
+    churn = max((snap.get("kernels") or {}).values(), default=0)
+    checks = [
+        ("recompile_churn_max_signatures", churn,
+         f"<= {int(args.recompile_threshold)}",
+         churn <= args.recompile_threshold),
+        ("dropped_trace_events", snap.get("dropped_events", 0), "== 0",
+         not snap.get("dropped_events")),
+        ("late_dropped", snap.get("late_dropped", 0), "== 0",
+         not snap.get("late_dropped")),
+        ("max_watermark_lag_ms", snap.get("max_watermark_lag_ms", 0),
+         f"<= {int(args.max_lag_ms)}",
+         (snap.get("max_watermark_lag_ms") or 0) <= args.max_lag_ms),
+    ]
+    overflows: List[Tuple[str, float]] = []
+    _find_overflows(doc.get("bench") or {}, "bench", overflows)
+    _find_overflows(snap.get("compaction") or {}, "snapshot.compaction",
+                    overflows)
+    for path, v in overflows:
+        checks.append((path, v, "== 0", not v))
+    print(f"== sfprof health: {args.ledger}")
+    failed = 0
+    for name, value, band, ok in checks:
+        failed += 0 if ok else 1
+        print(f"{'ok  ' if ok else 'FAIL'} {name:<34} "
+              f"{_fmt_num(value):<12} [{band}]")
+    print(f"{len(checks)} checks, {int(failed)} failed")
+    return 1 if failed else 0
+
+
+# -- entry --------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sfprof",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rep = sub.add_parser(
+        "report", help="phase attribution, top kernels, bytes/window, "
+                       "host gaps from a ledger or Chrome trace")
+    rep.add_argument("path")
+    rep.add_argument("--top", type=int, default=10)
+    rep.set_defaults(fn=cmd_report)
+
+    dif = sub.add_parser(
+        "diff", help="per-metric deltas A→B with tolerance bands; "
+                     "--gate exits 1 on regression")
+    dif.add_argument("a")
+    dif.add_argument("b")
+    dif.add_argument("--gate", action="store_true")
+    dif.add_argument("--eps-tol", type=float, default=0.5,
+                     help="allowed fractional EPS drop (default 0.5 — "
+                          "the documented ±50%% tunnel variance)")
+    dif.add_argument("--lat-tol", type=float, default=1.0,
+                     help="allowed fractional latency growth "
+                          "(default 1.0 = 2x)")
+    dif.add_argument("--baseline", default=DEFAULT_BASELINE,
+                     help="CPU_BASELINE.json medians guarding suite "
+                          "configs (default: repo copy)")
+    dif.add_argument("--verbose", action="store_true",
+                     help="also print informational rows")
+    dif.set_defaults(fn=cmd_diff)
+
+    hea = sub.add_parser(
+        "health", help="threshold verdicts: recompile churn, overflows, "
+                       "late drops, watermark lag, dropped events")
+    hea.add_argument("ledger")
+    hea.add_argument("--recompile-threshold", type=int, default=8)
+    hea.add_argument("--max-lag-ms", type=int, default=10_000)
+    hea.set_defaults(fn=cmd_health)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `sfprof report | head` closing the pipe early is not an error;
+        # detach stdout so the interpreter's exit flush stays quiet.
+        import sys
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0

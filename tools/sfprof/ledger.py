@@ -1,0 +1,135 @@
+"""Run-ledger loading + schema validation.
+
+A ledger is ONE JSON document written by ``telemetry.write_ledger``:
+
+    {"ledger_version": 1, "created_unix": ..., "env": {...},
+     "snapshot": {...}, "kernels": [...], "events": [...],
+     "bench": {...} | null}
+
+``validate`` returns a list of human-readable problems ([] == valid):
+schema version, required blocks + their types, required snapshot/kernel
+columns, strict JSON scalars (no NaN/Inf — ``allow_nan=False`` re-dump),
+and no numpy ≥2 scalar reprs (``np.float32(...)``) leaked into any
+string field — the fstring-numpy bug class must never reach the ledger,
+which is an egress artifact other tooling parses.
+
+``load_any`` also accepts the two trace shapes (a Chrome-trace JSON-lines
+file from ``SFT_TRACE_PATH``, or a ``{"traceEvents": [...]}`` document)
+so ``sfprof report`` runs on either a ledger or a raw trace.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+# Mirror of spatialflink_tpu/telemetry.py:LEDGER_VERSION — kept as a
+# literal so the CLI never imports spatialflink_tpu (whose import
+# configures jax). Bump BOTH constants together; the cross-pin lives in
+# tests/test_sfprof.py (ledger schema test writes with the telemetry
+# constant and validates with this one).
+LEDGER_VERSION = 1
+
+REQUIRED_BLOCKS: Tuple[Tuple[str, type], ...] = (
+    ("ledger_version", int),
+    ("created_unix", (int, float)),
+    ("env", dict),
+    ("snapshot", dict),
+    ("kernels", list),
+    ("events", list),
+)
+REQUIRED_SNAPSHOT_KEYS = (
+    "compiles", "bytes_h2d", "bytes_d2h", "max_watermark_lag_ms",
+    "late_dropped", "dropped_events", "kernels",
+)
+REQUIRED_KERNEL_KEYS = (
+    "kernel", "signature", "calls", "dispatch_ns", "first_call_ns",
+)
+
+# numpy ≥2 scalar repr leaking into a string — the bug that shipped twice.
+_NUMPY_REPR = re.compile(r"np\.(?:float|int|uint|bool|complex)[0-9_]*\(")
+
+
+def load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def is_ledger(doc: Any) -> bool:
+    return isinstance(doc, dict) and "ledger_version" in doc
+
+
+def load_any(path: str) -> Tuple[Optional[Dict[str, Any]], List[dict]]:
+    """(ledger_doc_or_None, events) from a ledger, a ``{"traceEvents"}``
+    document, or a JSON-lines trace file."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # JSON-lines Chrome trace (telemetry's SFT_TRACE_PATH format).
+        events = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        return None, events
+    if is_ledger(doc):
+        return doc, doc.get("events") or []
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return None, doc["traceEvents"]
+    if isinstance(doc, dict):
+        # Single-event-per-line file whose first line parsed as one dict.
+        events = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        return None, events
+    raise ValueError(f"{path}: neither a ledger nor a trace")
+
+
+def _scan_strings(value: Any, path: str, problems: List[str]) -> None:
+    if isinstance(value, str):
+        if _NUMPY_REPR.search(value):
+            problems.append(
+                f"numpy scalar repr leaked into {path}: {value[:80]!r}"
+            )
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _scan_strings(v, f"{path}.{k}", problems)
+    elif isinstance(value, list):
+        for i, v in enumerate(value):
+            _scan_strings(v, f"{path}[{i}]", problems)
+
+
+def validate(doc: Any) -> List[str]:
+    """Schema problems ([] == valid). See module docstring."""
+    if not isinstance(doc, dict):
+        return ["ledger is not a JSON object"]
+    problems: List[str] = []
+    for key, typ in REQUIRED_BLOCKS:
+        if key not in doc:
+            problems.append(f"missing block: {key}")
+        elif not isinstance(doc[key], typ):
+            problems.append(
+                f"block {key} has type {type(doc[key]).__name__}"
+            )
+    ver = doc.get("ledger_version")
+    if isinstance(ver, int) and ver != LEDGER_VERSION:
+        problems.append(
+            f"ledger_version {ver} != supported {LEDGER_VERSION}"
+        )
+    snap = doc.get("snapshot")
+    if isinstance(snap, dict):
+        for key in REQUIRED_SNAPSHOT_KEYS:
+            if key not in snap:
+                problems.append(f"snapshot missing key: {key}")
+    kernels = doc.get("kernels")
+    if isinstance(kernels, list):
+        for i, row in enumerate(kernels):
+            if not isinstance(row, dict):
+                problems.append(f"kernels[{i}] is not an object")
+                continue
+            for key in REQUIRED_KERNEL_KEYS:
+                if key not in row:
+                    problems.append(f"kernels[{i}] missing key: {key}")
+    try:
+        json.dumps(doc, allow_nan=False)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not strictly JSON-safe: {e}")
+    _scan_strings(doc, "ledger", problems)
+    return problems
