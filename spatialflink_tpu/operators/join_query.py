@@ -17,6 +17,7 @@ PointPointJoinQuery.java:128-146).
 
 from __future__ import annotations
 
+import functools
 import heapq
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Sequence, Tuple
@@ -181,8 +182,10 @@ def grid_hash_join_batches(grid, left_batch, right_batch, radius, cap, offsets,
     # Reference semantics: out-of-grid points carry keys that never match a
     # neighbor set (HelperClass.assignGridCellID), so they never join.
     left_in_grid = left_batch.valid & (left_batch.cell < grid.num_cells)
-    cells_sorted, order = sort_by_cell(
-        jnp.asarray(right_batch.cell), grid.num_cells
+    # Jitted, not eager: an eager sort_by_cell is three un-jitted
+    # dispatches (argsort + gather + cast) per window over the tunnel.
+    cells_sorted, order = jitted(sort_by_cell, "n_total_cells")(
+        jnp.asarray(right_batch.cell), n_total_cells=grid.num_cells
     )
     args = (
         jnp.asarray(center_coords(grid, left_batch.xy, dtype)),
@@ -540,6 +543,20 @@ def _aligned_soa_windows(gen_l, gen_r, start_l, start_r):
             wr = next(gen_r, None)
 
 
+@functools.lru_cache(maxsize=None)
+def _dummy_geometry(capacity: int):
+    """Constant dummy (capacity, 2, 2) verts + (capacity, 1) edge masks
+    for the approximate (bbox-only) kernel modes — the kernel never reads
+    them, the shapes just have to line up. Allocated ON DEVICE once per
+    capacity bucket and reused every window (lru-cached): the previous
+    inline ``jnp.zeros`` pair was two eager dispatches + transfers per
+    window over the tunnel."""
+    return (
+        jnp.zeros((capacity, 2, 2), np.float32),
+        jnp.zeros((capacity, 1), bool),
+    )
+
+
 def _centered_bbox(grid, bbox: np.ndarray, dtype, pad: bool = True) -> np.ndarray:
     """Center a (N, 4) minx,miny,maxx,maxy array the way device
     coordinates are centered (operators/base.py:center_coords) so bbox
@@ -679,11 +696,7 @@ class _PointGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
         """
         approx = self.conf.approximate_query
         if approx:
-            geom = (
-                jnp.zeros((gb.capacity, 2, 2), np.float32),
-                jnp.zeros((gb.capacity, 1), bool),
-                jnp.asarray(gb.valid),
-            )
+            geom = _dummy_geometry(gb.capacity) + (jnp.asarray(gb.valid),)
         else:
             geom = (
                 self.device_verts(gb.verts, dtype),
@@ -881,14 +894,11 @@ class _GeometryGeometryJoinQuery(SpatialOperator, _PrunedGeomJoinRetry):
             # (N, 2, 2) verts instead of the real boundaries (saves
             # O(N·V) per window over the tunnel; cand clamp keys on
             # bbbox). pad=False: these boxes are the distance operands.
-            args = (
-                jnp.zeros((la.capacity, 2, 2), np.float32),
-                jnp.zeros((la.capacity, 1), bool),
+            args = _dummy_geometry(la.capacity) + (
                 jnp.asarray(la.valid[ho]),
                 jnp.asarray(_centered_bbox(self.grid, la.bbox[ho], dtype,
                                            pad=False)),
-                jnp.zeros((ra.capacity, 2, 2), np.float32),
-                jnp.zeros((ra.capacity, 1), bool),
+            ) + _dummy_geometry(ra.capacity) + (
                 jnp.asarray(ra.valid),
                 jnp.asarray(_centered_bbox(self.grid, ra.bbox, dtype,
                                            pad=False)),

@@ -905,7 +905,7 @@ class TAggregateQuery(SpatialOperator):
             self.grid, chunks, self.conf, dtype
         ):
             ts_p = pad_to_bucket(
-                np.asarray(win.arrays["ts"], np.int64), len(valid)
+                np.asarray(win.arrays["ts"], np.int64), len(valid)  # sfcheck: ok=recompile-surface -- `valid` is already bucket-padded by device_point_args; len(valid) IS the ladder bucket, not a raw count
             )
             self._ingest_window(ts_p, cell, oid, valid, win.count)
             yield self._aggregate_state(win, lookup=str)

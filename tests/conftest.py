@@ -30,6 +30,28 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# The sfcheck fixture corpus contains deliberate violations AND mini
+# test repos (meshparity_*/tests/test_*.py) that only import relative to
+# their own project root — never collect them as real tests.
+collect_ignore_glob = ["fixtures/*"]
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# The 16 pre-existing pallas-interpret failures (present since seed, see
+# CHANGES.md PR 1 addendum): this jax build's pallas interpret mode on
+# CPU rejects the int64 dtypes the digest/join kernels use for index
+# math under x64 ("ValueError: Invalid dtype ..."), and the forced-pallas
+# self-check paths turn that into a RuntimeError. One shared marker so
+# tier-1 is green, and strict=False so a jax upgrade that fixes Pallas
+# interpret shows up as XPASS instead of staying silently masked
+# (PARITY.md "Known deviations").
+PALLAS_INT64_REASON = (
+    "pallas interpret-mode int64 dtype gap in this jax build — "
+    "pre-existing since seed; PARITY.md 'Known deviations'"
+)
+pallas_int64_xfail = pytest.mark.xfail(strict=False,
+                                       reason=PALLAS_INT64_REASON)

@@ -1,0 +1,30 @@
+"""Deliberate donation-safety violations (fixture): reads of a local
+after it was passed at a donate_argnums position — the device buffer is
+deleted at dispatch."""
+
+import jax
+
+step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+
+
+def use_after_donate(x):
+    y = step(x)
+    return x + y  # BAD: x's buffer was donated to step
+
+
+def inline_form(x):
+    y = jax.jit(lambda a: a * 2, donate_argnums=(0,))(x)
+    return x - y  # BAD: donated at the inline jit call
+
+
+def loop_no_rebind(xs, x):
+    acc = None
+    for _ in range(3):
+        acc = step(x)  # BAD: x re-donated (and re-read) every iteration
+    return acc
+
+
+def local_wrapper(x):
+    prog = jax.jit(lambda a: a - 1, donate_argnums=(0,))
+    out = prog(x)
+    return x, out  # BAD: x read after donation to the local wrapper

@@ -1,0 +1,32 @@
+"""Clean twin of donation_safety_bad.py: the rebind idiom and
+no-reuse patterns that make donation safe."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+plain = jax.jit(lambda x: x + 1)  # no donation: reuse is fine
+
+
+def rebind_loop(x):
+    for _ in range(3):
+        x = step(x)  # canonical double-buffer idiom: donate + rebind
+    return x
+
+
+def no_reuse(x):
+    y = step(x)
+    return y * 2  # x never touched again
+
+
+def fresh_each_iter(chunks):
+    out = []
+    for c in chunks:
+        buf = jnp.asarray(c)  # rebound inside the loop every iteration
+        out.append(step(buf))
+    return out
+
+
+def non_donating(x):
+    y = plain(x)
+    return x + y  # fine: plain jit call keeps x alive

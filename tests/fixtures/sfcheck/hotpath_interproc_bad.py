@@ -1,0 +1,30 @@
+"""Deliberate hotpath-interproc violations (fixture — excluded from the
+default scan).
+
+The eager jnp work sits TWO call hops away from the per-window loop, so
+the per-file syntactic `hotpath` pass (module-scope jnp in ops/ only)
+provably cannot see it — tests/test_sfcheck.py pins that blindness."""
+
+import jax.numpy as jnp
+
+
+def tally(dists):
+    # hop 2: innocent-looking forwarder
+    return summarize(dists)
+
+
+def summarize(dists):
+    # BAD: eager jnp compute, transitively called per window (2 hops)
+    return jnp.sort(dists)[:8]
+
+
+def run(stream):
+    out = []
+    for win in windows(stream):  # per-window loop  # noqa: F821
+        out.append(tally(win.dists))  # hop 1
+    return out
+
+
+def run_direct(stream):
+    for win in windows(stream):  # noqa: F821
+        yield jnp.sum(win.x)  # BAD: eager jnp directly inside the loop

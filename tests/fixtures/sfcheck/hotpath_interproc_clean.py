@@ -1,0 +1,35 @@
+"""Clean twin of hotpath_interproc_bad.py: the same 2-hop call chain,
+but the jnp work is traced (jax.jit) and the loop-body helpers are
+host-only — zero findings."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def summarize(dists):
+    # Fine: decorated device entry — this jnp.sort is traced, not eager.
+    return jnp.sort(dists)[:8]
+
+
+def tally(dists):
+    return summarize(dists)
+
+
+def stage(win):
+    # Fine: jnp.asarray is the sanctioned device SHIP, not compute.
+    return jnp.asarray(win.x)
+
+
+def run(stream):
+    out = []
+    for win in windows(stream):  # noqa: F821
+        out.append(tally(stage(win)))
+    return out
+
+
+def host_only(stream):
+    total = 0
+    for win in windows(stream):  # noqa: F821
+        total += sum(win.counts)  # plain-Python host work: fine
+    return total

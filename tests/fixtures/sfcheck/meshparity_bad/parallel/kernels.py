@@ -1,0 +1,25 @@
+"""Fixture mini-repo: parallel/ kernels violating the mesh-parity
+contract (analyzed with --project-root at the mini-repo root)."""
+
+from ops.single import base_kernel
+
+
+def sharded_untested(mesh, x):
+    # counterpart resolves (base_kernel in ops/), but NO test names this
+    # kernel -> one finding
+    return base_kernel(x)
+
+
+def sharded_orphan(mesh, x):
+    # no ops/ counterpart AND no test -> two findings
+    return x + 1
+
+
+def _private_helper(mesh, x):
+    # underscore-private: exempt
+    return x
+
+
+def mesh_builder(shape):
+    # not a kernel (no mesh-first signature): exempt
+    return shape

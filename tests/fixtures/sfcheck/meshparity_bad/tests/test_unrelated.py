@@ -1,0 +1,9 @@
+"""Fixture test file that references NEITHER parallel kernel — present
+so the mesh-parity test-reference half is evaluated (a project with no
+test files skips it as vacuous)."""
+
+from ops.single import base_kernel
+
+
+def test_base_kernel():
+    assert base_kernel(2) == 4

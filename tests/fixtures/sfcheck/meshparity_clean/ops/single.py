@@ -1,0 +1,5 @@
+"""Fixture: the single-device counterpart kernel."""
+
+
+def base_kernel(x):
+    return x * 2

@@ -1,0 +1,14 @@
+"""Fixture mini-repo: a parallel/ kernel satisfying the mesh-parity
+contract — ops/ counterpart + name-referenced parity test."""
+
+from ops.single import base_kernel
+
+
+def sharded_ok(mesh, x):
+    return base_kernel(x)
+
+
+def sharded_dispatcher(mesh, kernel, n_args):
+    # generic dispatcher (kernel param): exempt from the counterpart
+    # half, still needs a test reference
+    return kernel(n_args)

@@ -1,0 +1,11 @@
+"""Fixture parity test: references both kernels by name."""
+
+from parallel.kernels import sharded_dispatcher, sharded_ok
+
+
+def test_sharded_ok_matches_single():
+    assert sharded_ok(None, 3) == 6
+
+
+def test_dispatcher():
+    assert sharded_dispatcher(None, lambda n: n, 5) == 5

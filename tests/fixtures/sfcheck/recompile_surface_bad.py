@@ -1,0 +1,23 @@
+"""Deliberate recompile-surface violations (fixture): device shapes
+derived raw from data-dependent Python ints on a per-window path — one
+XLA compile per distinct window size."""
+
+import jax.numpy as jnp
+
+
+def run(stream, prog):
+    for win in windows(stream):  # noqa: F821
+        n = len(win.events)
+        buf = jnp.zeros((n, 2))  # BAD: raw len() becomes a device shape
+        prog(buf)
+
+
+def pad_stage(win):
+    # BAD (reached from the loop below): .shape-derived bucket, unrouted
+    m = win.xs.shape[0]
+    return pad_to_bucket(win.ts, m)  # noqa: F821
+
+
+def run_padded(stream, prog):
+    for win in windows(stream):  # noqa: F821
+        prog(pad_stage(win))

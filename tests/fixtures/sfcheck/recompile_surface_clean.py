@@ -1,0 +1,25 @@
+"""Clean twin of recompile_surface_bad.py: the same data-dependent ints
+routed through the compaction ladder before they become shapes."""
+
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.compaction import pick_capacity
+from spatialflink_tpu.utils.padding import next_bucket, pad_to_bucket
+
+
+def run(stream, prog):
+    for win in windows(stream):  # noqa: F821
+        n = len(win.events)
+        b = pick_capacity(n, 1024)  # ladder-routed: ≤K stable shapes
+        buf = jnp.zeros((b, 2))
+        prog(buf)
+
+
+def pad_stage(win):
+    m = next_bucket(win.xs.shape[0])  # bucketed before it is a shape
+    return pad_to_bucket(win.ts, m)
+
+
+def run_padded(stream, prog):
+    for win in windows(stream):  # noqa: F821
+        prog(pad_stage(win))

@@ -1,0 +1,83 @@
+"""Tier-1 tools/ci: the pre-commit gate's stage plan, fail-fast
+behavior, and environment hygiene (no axon dial, toy last-good). The
+stages themselves (sfcheck / pytest / bench+sfprof) have their own
+suites — here we pin the orchestration only."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import ci  # noqa: E402
+
+
+def test_dry_run_lists_all_stages(capsys):
+    assert ci.main(["--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "[sfcheck]" in out
+    assert "[pytest-quick]" in out
+    assert "[bench-smoke+health]" in out
+    assert "tools.sfprof health" in out.replace(sys.executable, "py")
+
+
+def test_skip_flags_trim_stages(capsys):
+    assert ci.main(["--dry-run", "--skip-tests", "--skip-bench"]) == 0
+    out = capsys.readouterr().out
+    assert "[sfcheck]" in out
+    assert "pytest" not in out and "bench" not in out
+
+
+def test_changed_flag_passes_through(capsys):
+    assert ci.main(["--dry-run", "--changed"]) == 0
+    assert "--changed" in capsys.readouterr().out
+
+
+def test_fail_fast_propagates_stage_exit(monkeypatch):
+    calls = []
+
+    class P:
+        def __init__(self, rc):
+            self.returncode = rc
+
+    def fake_run(cmd, cwd=None, env=None):
+        calls.append(cmd)
+        return P(7 if "pytest" in " ".join(cmd) else 0)
+
+    monkeypatch.setattr(ci.subprocess, "run", fake_run)
+    assert ci.main([]) == 7
+    joined = [" ".join(c) for c in calls]
+    assert any("tools.sfcheck" in c for c in joined)
+    assert any("pytest" in c for c in joined)
+    # fail-fast: the bench stage never ran
+    assert not any("bench.py" in c for c in joined)
+
+
+def test_all_green_runs_every_stage(monkeypatch):
+    calls = []
+    envs = []
+
+    class P:
+        returncode = 0
+
+    def fake_run(cmd, cwd=None, env=None):
+        calls.append(" ".join(cmd))
+        envs.append(env)
+        return P()
+
+    monkeypatch.setattr(ci.subprocess, "run", fake_run)
+    assert ci.main([]) == 0
+    assert any("bench.py" in c for c in calls)
+    assert any("tools.sfprof health" in c for c in calls)
+    # every stage env disarms the axon dial
+    assert all(e["PALLAS_AXON_POOL_IPS"] == "" for e in envs)
+    bench_env = envs[[i for i, c in enumerate(calls)
+                      if "bench.py" in c][0]]
+    assert bench_env["SFT_BENCH_SMOKE"] == "1"
+    # toy numbers must never enter the real last-good store
+    assert "ci_last_good" in bench_env["SFT_BENCH_LAST_GOOD"]
+    assert bench_env["SFT_LEDGER_PATH"]

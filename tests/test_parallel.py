@@ -199,6 +199,220 @@ def test_sharded_knn_multi_matches_single(rng):
                                   np.asarray(single.num_valid))
 
 
+def test_sharded_window_kernel_matches_single(rng, mesh):
+    """The generic mesh dispatcher (sharded_window_kernel) must produce
+    bit-identical outputs to the module-cached single-device jit of the
+    SAME fused kernel — the parity contract of the operator mesh path."""
+    from spatialflink_tpu.operators.base import jitted
+    from spatialflink_tpu.ops.range import range_points_fused
+    from spatialflink_tpu.parallel.sharded import sharded_window_kernel
+
+    batch = make_batch(rng)
+    q = np.array([[5.0, 5.0], [1.0, 9.0]])
+    r = 1.5
+    flags = GRID.neighbor_flags(r, [GRID.flat_cell(*p) for p in q])
+    args = (
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(batch.cell), jnp.asarray(flags), jnp.asarray(q), r,
+    )
+    prog = sharded_window_kernel(mesh, range_points_fused, (0, 1, 2), 6,
+                                 approximate=False)
+    keep_s, dist_s = prog(*args)
+    keep_1, dist_1 = jitted(range_points_fused, "approximate")(
+        *args, approximate=False
+    )
+    np.testing.assert_array_equal(np.asarray(keep_s), np.asarray(keep_1))
+    np.testing.assert_allclose(np.asarray(dist_s), np.asarray(dist_1),
+                               rtol=1e-12)
+
+
+def test_sharded_range_query_2d_matches_single(rng):
+    """2-D mesh range query (points over data, queries over query with a
+    pmin merge) must equal the single-device kernel — min-of-mins is
+    exact, so bit-identical."""
+    from spatialflink_tpu.parallel.sharded import sharded_range_query_2d
+
+    mesh2d = make_mesh((4, 2), ("data", "query"))
+    batch = make_batch(rng)
+    q = np.array([[5.0, 5.0], [1.0, 9.0]])
+    r = 1.5
+    flags = GRID.neighbor_flags(r, [GRID.flat_cell(*p) for p in q])
+    pflags = np.asarray(
+        gather_cell_flags(jnp.asarray(batch.cell), jnp.asarray(flags))
+    )
+    keep_s, dist_s = sharded_range_query_2d(
+        mesh2d, jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(pflags), jnp.asarray(q), r,
+    )
+    keep_1, dist_1 = range_query_kernel(
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(pflags), jnp.asarray(q), r,
+    )
+    np.testing.assert_array_equal(np.asarray(keep_s), np.asarray(keep_1))
+    np.testing.assert_allclose(np.asarray(dist_s), np.asarray(dist_1),
+                               rtol=1e-12)
+
+
+def _compact_pair_set(res):
+    li = np.asarray(res.left_index)
+    ri = np.asarray(res.right_index)
+    d = np.asarray(res.dist)
+    keep = li >= 0
+    return {
+        (int(a), int(b), round(float(dd), 9))
+        for a, b, dd in zip(li[keep], ri[keep], d[keep])
+    }
+
+
+def test_sharded_join_window_compact_matches_single(rng, mesh):
+    """Device-compacted sharded join: identical pair SET to the fused
+    single-device join_window_compact (per-shard compaction reorders
+    pairs; the set and the overflow counter must match exactly)."""
+    from spatialflink_tpu.ops.join import join_window_compact
+    from spatialflink_tpu.parallel.sharded import sharded_join_window_compact
+
+    a = make_batch(rng, n=700, bucket=1024)
+    b = make_batch(rng, n=300, bucket=512)
+    r = 0.6
+    lci = GRID.cell_xy_indices_np(a.xy)
+    offsets = jnp.asarray(GRID.neighbor_offsets(r))
+    common = (
+        jnp.asarray(a.xy), jnp.asarray(a.valid), jnp.asarray(lci),
+        jnp.asarray(b.xy), jnp.asarray(b.valid), jnp.asarray(b.cell),
+        offsets,
+    )
+    res_1 = join_window_compact(*common, grid_n=GRID.n, radius=r, cap=32,
+                                max_pairs=4096)
+    res_s = sharded_join_window_compact(mesh, *common, grid_n=GRID.n,
+                                        radius=r, cap=32, max_pairs=4096)
+    assert _compact_pair_set(res_s) == _compact_pair_set(res_1)
+    assert _compact_pair_set(res_1)  # non-trivial window
+    # Sharded count may over-report (max_local·n_shards retry contract)
+    # but never under-report the true pair count.
+    assert int(res_s.count) >= int(res_1.count)
+    assert int(res_s.overflow) == int(res_1.overflow)
+
+
+def _square_polygons(rng, m, size=0.25):
+    from spatialflink_tpu.models.objects import Polygon
+
+    out = []
+    for i in range(m):
+        cx, cy = rng.uniform(0.5, 9.5, 2)
+        ring = np.array([
+            [cx - size, cy - size], [cx + size, cy - size],
+            [cx + size, cy + size], [cx - size, cy + size],
+            [cx - size, cy - size],
+        ])
+        out.append(Polygon(obj_id=f"g{i}", timestamp=i, rings=[ring]))
+    return out
+
+
+def test_sharded_point_geometry_join_pruned_matches_single(rng, mesh):
+    """Grid-pruned point ⋈ polygon join on the mesh: the point side
+    shards contiguously; the pair set must equal the single-device
+    pruned kernel (generous cand/max_pairs so both runs are exact)."""
+    from spatialflink_tpu.models.batch import GeometryBatch
+    from spatialflink_tpu.ops.join import point_geometry_join_pruned_kernel
+    from spatialflink_tpu.parallel.sharded import (
+        sharded_point_geometry_join_pruned,
+    )
+
+    batch = make_batch(rng, n=1500, bucket=2048)
+    gb = GeometryBatch.from_objects(_square_polygons(rng, 60),
+                                    dtype=np.float64)
+    r = 0.15
+    args = (
+        jnp.asarray(batch.xy), jnp.asarray(batch.valid),
+        jnp.asarray(gb.verts), jnp.asarray(gb.edge_valid),
+        jnp.asarray(gb.valid), jnp.asarray(gb.bbox), r,
+    )
+    kw = dict(polygonal=True, block=256, cand=gb.capacity,
+              max_pairs=4096, pair_cap=8)
+    res_1 = point_geometry_join_pruned_kernel(*args, **kw)
+    res_s = sharded_point_geometry_join_pruned(mesh, *args, **kw)
+    assert int(res_1.cand_overflow) == 0 and int(res_1.pair_overflow) == 0
+    assert int(res_s.cand_overflow) == 0 and int(res_s.pair_overflow) == 0
+    assert _compact_pair_set(res_s) == _compact_pair_set(res_1)
+    assert _compact_pair_set(res_1)  # non-trivial window
+
+
+def test_sharded_geometry_geometry_join_pruned_matches_single(rng, mesh):
+    """Grid-pruned polygon ⋈ polygon join on the mesh: the left geometry
+    batch shards over data (bucket 128 divides the 8-device axis); pair
+    set parity with the single-device kernel."""
+    from spatialflink_tpu.models.batch import GeometryBatch
+    from spatialflink_tpu.ops.join import (
+        geometry_geometry_join_pruned_kernel,
+    )
+    from spatialflink_tpu.parallel.sharded import (
+        sharded_geometry_geometry_join_pruned,
+    )
+
+    la = GeometryBatch.from_objects(_square_polygons(rng, 120, size=0.3),
+                                    dtype=np.float64, bucket=128)
+    rb = GeometryBatch.from_objects(
+        _square_polygons(np.random.default_rng(13), 80, size=0.3),
+        dtype=np.float64,
+    )
+    r = 0.2
+    args = (
+        jnp.asarray(la.verts), jnp.asarray(la.edge_valid),
+        jnp.asarray(la.valid), jnp.asarray(la.bbox),
+        jnp.asarray(rb.verts), jnp.asarray(rb.edge_valid),
+        jnp.asarray(rb.valid), jnp.asarray(rb.bbox), r,
+    )
+    kw = dict(a_polygonal=True, b_polygonal=True, block=16,
+              cand=rb.capacity, max_pairs=4096, pair_cap=16)
+    res_1 = geometry_geometry_join_pruned_kernel(*args, **kw)
+    res_s = sharded_geometry_geometry_join_pruned(mesh, *args, **kw)
+    assert int(res_1.cand_overflow) == 0 and int(res_1.pair_overflow) == 0
+    assert int(res_s.cand_overflow) == 0 and int(res_s.pair_overflow) == 0
+    assert _compact_pair_set(res_s) == _compact_pair_set(res_1)
+    assert _compact_pair_set(res_1)
+
+
+def test_sharded_traj_stats_pane_matches_single(rng, mesh):
+    """Trajectory-parallel pane tStats: contiguous oid blocks shard over
+    data with zero collectives — rows must be bit-identical to the
+    single-device pane kernel (x64 parity)."""
+    from spatialflink_tpu.ops.trajectory import traj_stats_pane_kernel
+    from spatialflink_tpu.parallel.sharded import sharded_traj_stats_pane
+
+    num_oids, slide_ms, ppw = 16, 1000, 3
+    n = 4096
+    oid = np.sort(rng.integers(0, num_oids, n)).astype(np.int32)
+    ts = np.zeros(n, np.int32)
+    for o in range(num_oids):
+        idx = np.nonzero(oid == o)[0]
+        ts[idx] = np.arange(len(idx), dtype=np.int32) * 400
+    x = rng.uniform(0, 10, n)
+    y = rng.uniform(0, 10, n)
+    valid = np.ones(n, bool)
+    n_panes = int(ts.max() // slide_ms) + 1
+
+    single = traj_stats_pane_kernel(
+        jnp.asarray(ts), jnp.asarray(x), jnp.asarray(y), jnp.asarray(oid),
+        jnp.asarray(valid), num_oids=num_oids, slide_ms=slide_ms, ppw=ppw,
+        n_panes=n_panes,
+    )
+    sharded = sharded_traj_stats_pane(
+        mesh, ts, x, y, oid, valid, num_oids=num_oids, slide_ms=slide_ms,
+        ppw=ppw, n_panes=n_panes,
+    )
+    # atol: windows with no live pair hold cumsum cancellation residue
+    # (~1e-15) that reassociates under the per-shard split; the host
+    # wrapper's alive filter discards them (test_parallel_operators pins
+    # the operator-level bit-parity).
+    np.testing.assert_allclose(np.asarray(sharded.spatial),
+                               np.asarray(single.spatial),
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(np.asarray(sharded.temporal),
+                                  np.asarray(single.temporal))
+    np.testing.assert_array_equal(np.asarray(sharded.count),
+                                  np.asarray(single.count))
+
+
 def test_initialize_distributed_noop_single_process(monkeypatch):
     from spatialflink_tpu.parallel.multihost import initialize_distributed
 

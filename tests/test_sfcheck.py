@@ -18,8 +18,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from tools.sfcheck import core  # noqa: E402
-from tools.sfcheck.passes import ALL_PASSES, PASS_NAMES, get_pass  # noqa: E402
+from tools.sfcheck import core, driver  # noqa: E402
+from tools.sfcheck.passes import (  # noqa: E402
+    ALL_PASSES,
+    PASS_NAMES,
+    PROJECT_PASSES,
+    get_pass,
+)
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures", "sfcheck")
@@ -48,16 +53,21 @@ def _cli(*args):
 
 # -- the analyzer itself -----------------------------------------------------
 
-def test_all_five_passes_registered():
+def test_all_ten_passes_registered():
     assert set(PASS_NAMES) == {
+        # file passes
         "hotpath", "trace-hygiene", "fixed-shape", "sync-discipline",
         "fstring-numpy",
+        # whole-program passes
+        "hotpath-interproc", "mesh-parity", "recompile-surface",
+        "donation-safety", "pragma-staleness",
     }
-    for p in ALL_PASSES:
+    for p in ALL_PASSES + PROJECT_PASSES:
         assert p.description and p.invariant
 
 
-def test_repo_tree_is_clean():
+def test_repo_tree_is_clean_file_passes():
+    # The per-file framework alone (back-compat surface: run_paths).
     report = core.run_paths(core.default_targets())
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings
@@ -66,16 +76,37 @@ def test_repo_tree_is_clean():
     assert report.files > 100
 
 
-def test_cli_json_breakdown_over_real_tree():
-    # The ISSUE's CI contract: full analyzer over the package, bench.py
-    # and tools/ reports a per-pass breakdown of all zeros.
+def test_repo_tree_is_clean_whole_program():
+    # The full driver: file passes + project passes + pragma-staleness.
+    report = driver.run(use_cache=False)
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+    assert report.files > 100
+    assert set(report.pass_names) == set(PASS_NAMES)
+
+
+def test_cli_json_breakdown_over_subtree():
+    # Explicit targets form a PARTIAL project view: the file passes
+    # report a zero breakdown; whole-program passes are deliberately
+    # absent (they would see an incomplete world — no tests/, missing
+    # callers — and manufacture findings). The full ten-pass verdict is
+    # the default no-args run (test_repo_tree_is_clean_whole_program).
     res = _cli("--json", "spatialflink_tpu", "bench.py", "tools")
     assert res.returncode == 0, res.stdout + res.stderr
     data = json.loads(res.stdout)
     assert data["findings"] == []
-    assert set(data["counts"]) == set(PASS_NAMES)
+    assert set(data["counts"]) == {p.name for p in ALL_PASSES}
     assert all(v == 0 for v in data["counts"].values())
     assert data["files"] > 70
+
+
+def test_single_file_invocation_has_no_partial_view_false_positives():
+    # `sfcheck <file I edited>` must not exit 1 with bogus mesh-parity /
+    # staleness findings just because the rest of the program is outside
+    # the view.
+    res = _cli("--no-cache", "spatialflink_tpu/parallel/sharded.py")
+    assert res.returncode == 0, res.stdout + res.stderr
 
 
 # -- fixture corpus: one true-positive + one clean file per pass -------------
@@ -96,7 +127,7 @@ def test_fixture_corpus(pass_name, expect_bad):
 
 
 def test_pragma_fixture_suppresses_every_class():
-    assert _fixture("pragmas_ok.py", list(PASS_NAMES)) == []
+    assert _fixture("pragmas_ok.py", [p.name for p in ALL_PASSES]) == []
 
 
 # -- pragma semantics --------------------------------------------------------
@@ -133,6 +164,20 @@ def test_pragma_spans_multiline_call():
     assert _check(src, "fixed-shape") == []
 
 
+def test_string_embedded_pragma_does_not_suppress_file_pass():
+    # pragma-looking text inside a string ARGUMENT of the flagged node
+    # must not suppress (the old line-regex suppression did): only real
+    # comment tokens count.
+    src = """
+        import jax
+        def f(x):
+            return jax.block_until_ready(
+                x, "docs say use # sfcheck: ok here"
+            )
+    """
+    assert len(_check(src, "sync-discipline")) == 1
+
+
 def test_syntax_error_is_reported_not_swallowed():
     findings = core.check_source("broken.py", "def f(:\n", ALL_PASSES,
                                  force=True)
@@ -163,10 +208,81 @@ def test_cli_json_on_fixture():
     assert all(f["line"] > 0 and f["message"] for f in data["findings"])
 
 
+def test_cli_json_carries_evidence_chain():
+    res = _cli("--no-cache", "--pass", "hotpath-interproc", "--json",
+               os.path.join(FIXTURES, "hotpath_interproc_bad.py"))
+    assert res.returncode == 1, res.stdout + res.stderr
+    data = json.loads(res.stdout)
+    assert data["counts"]["hotpath-interproc"] == 2
+    evs = [f["evidence"] for f in data["findings"]]
+    assert all(evs), "every project finding carries evidence"
+    assert any(len(e) >= 3 for e in evs), "2-hop call path resolved"
+
+
+def test_cli_mesh_parity_fixture_repo_via_project_root():
+    root = os.path.join(FIXTURES, "meshparity_bad")
+    res = _cli("--no-cache", "--pass", "mesh-parity",
+               "--project-root", root, "--json", root)
+    assert res.returncode == 1, res.stdout + res.stderr
+    data = json.loads(res.stdout)
+    assert data["counts"]["mesh-parity"] == 3
+    assert any("counterpart: ops/single.py:base_kernel" in e
+               for f in data["findings"] for e in f["evidence"])
+
+
+def test_cli_broken_pipe_preserves_gate_verdict(monkeypatch):
+    """`sfcheck | head` closing the pipe mid-print must not flip the
+    exit code: findings stay 1, clean stays 0 (the exit code IS the
+    pre-commit gate)."""
+    import builtins
+
+    from tools.sfcheck import cli
+    from tools.sfcheck.core import Finding, Report
+
+    # neutralize the stdout detach under pytest's fd-level capture
+    monkeypatch.setattr(os, "dup2", lambda a, b: None)
+
+    def exploding_print(*a, **k):
+        raise BrokenPipeError
+
+    monkeypatch.setattr(builtins, "print", exploding_print)
+    monkeypatch.setattr(cli.driver, "run", lambda **k: Report(
+        [Finding("f.py", 1, 1, "hotpath", "boom")], 1, ["hotpath"]))
+    assert cli.main([]) == 1
+    monkeypatch.setattr(cli.driver, "run",
+                        lambda **k: Report([], 1, ["hotpath"]))
+    assert cli.main([]) == 0
+    # a pipe break OUTSIDE the guarded print sections: verdict unknown,
+    # fail safe
+    def boom(args):
+        raise BrokenPipeError
+
+    monkeypatch.setattr(cli, "_run", boom)
+    assert cli.main([]) == 1
+
+
+def test_cli_internal_crash_is_exit_three(monkeypatch, capsys):
+    from tools.sfcheck import cli
+
+    def crash(**kwargs):
+        raise RuntimeError("injected analyzer crash")
+
+    monkeypatch.setattr(cli.driver, "run", crash)
+    assert cli.main([]) == 3
+    assert "injected analyzer crash" in capsys.readouterr().err
+
+
 def test_cli_unknown_pass_is_usage_error():
     res = _cli("--pass", "no-such-pass")
     assert res.returncode == 2
     assert "unknown pass" in res.stderr
+
+
+def test_cli_missing_path_is_usage_error_not_crash():
+    res = _cli("no_such_file_xyz.py")
+    assert res.returncode == 2
+    assert "no such file" in res.stderr
+    assert "Traceback" not in res.stderr
 
 
 def test_cli_list_passes():
@@ -174,6 +290,223 @@ def test_cli_list_passes():
     assert res.returncode == 0
     for name in PASS_NAMES:
         assert name in res.stdout
+
+
+# -- whole-program passes: fixture corpus + evidence chains ------------------
+
+def _project_fixture(name, pass_name, project_root=None):
+    path = os.path.join(FIXTURES, name)
+    report = driver.run(
+        paths=[path], pass_names=[pass_name], use_cache=False,
+        project_root=project_root,
+    )
+    return report.findings
+
+
+@pytest.mark.parametrize("pass_name,expect_bad", [
+    ("hotpath-interproc", 2),
+    ("recompile-surface", 2),
+    ("donation-safety", 4),
+])
+def test_project_fixture_corpus(pass_name, expect_bad):
+    stem = pass_name.replace("-", "_")
+    bad = _project_fixture(f"{stem}_bad.py", pass_name)
+    assert len(bad) == expect_bad, "\n".join(f.format() for f in bad)
+    assert all(f.pass_name == pass_name for f in bad)
+    # every finding carries a resolved evidence chain
+    assert all(f.evidence for f in bad)
+    assert _project_fixture(f"{stem}_clean.py", pass_name) == []
+
+
+def test_mesh_parity_fixture_repo():
+    root = os.path.join(FIXTURES, "meshparity_bad")
+    bad = _project_fixture("meshparity_bad", "mesh-parity",
+                           project_root=root)
+    # sharded_untested: no test; sharded_orphan: no counterpart + no test
+    assert len(bad) == 3, "\n".join(f.format() for f in bad)
+    msgs = "\n".join(f.message for f in bad)
+    assert "referenced by no test" in msgs
+    assert "no single-device ops/ counterpart" in msgs
+    # cross-file evidence: the resolved counterpart for the tested half
+    ev = "\n".join(e for f in bad for e in f.evidence)
+    assert "counterpart: ops/single.py:base_kernel" in ev
+    clean_root = os.path.join(FIXTURES, "meshparity_clean")
+    assert _project_fixture("meshparity_clean", "mesh-parity",
+                            project_root=clean_root) == []
+
+
+def test_interproc_catches_what_the_syntactic_pass_misses():
+    """The acceptance pin: eager jnp two call hops from a per-window
+    loop. The per-file hotpath pass (module-scope jnp in ops/) finds
+    NOTHING even force-run on the file; the call-graph pass finds it and
+    names every hop."""
+    path = os.path.join(FIXTURES, "hotpath_interproc_bad.py")
+    assert _fixture("hotpath_interproc_bad.py", ["hotpath"]) == []
+    findings = _project_fixture("hotpath_interproc_bad.py",
+                                "hotpath-interproc")
+    two_hop = [f for f in findings if len(f.evidence) >= 3]
+    assert two_hop, "\n".join(f.format() for f in findings)
+    ev = two_hop[0].evidence
+    assert "per-window loop" in ev[0]
+    assert "`tally` calls `summarize" in ev[1]
+    assert "eager `jnp.sort" in ev[2]
+    # and the direct-in-loop case is one-step evidence
+    direct = [f for f in findings if "directly inside" in f.evidence[0]]
+    assert len(direct) == 1
+
+
+def test_recompile_surface_accepts_ladder_routed_form():
+    """The acceptance pin: a raw len() shape is flagged; the
+    pick_capacity/next_bucket-routed twin is accepted."""
+    bad = _project_fixture("recompile_surface_bad.py", "recompile-surface")
+    assert any("len(win.events)" in f.message for f in bad)
+    assert any("shape" in f.message and ".shape[0]" in f.message
+               for f in bad)
+    assert _project_fixture("recompile_surface_clean.py",
+                            "recompile-surface") == []
+
+
+def test_donation_cross_evidence_names_wrapper_definition():
+    bad = _project_fixture("donation_safety_bad.py", "donation-safety")
+    ev = "\n".join(e for f in bad for e in f.evidence)
+    assert "donating wrapper `step" in ev
+    assert "inline `jax.jit(…, donate_argnums=…)` call" in ev
+
+
+# -- pragma staleness --------------------------------------------------------
+
+def _staleness(tmp_path, source):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(source))
+    report = driver.run(paths=[str(f)], pass_names=["pragma-staleness"],
+                        use_cache=False)
+    return report.findings
+
+
+def test_stale_pragma_is_a_finding(tmp_path):
+    findings = _staleness(tmp_path, """
+        x = 1  # sfcheck: ok=hotpath -- suppresses nothing
+    """)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "pragma-staleness"
+    assert "hotpath" in findings[0].message
+
+
+def test_live_pragma_is_not_stale(tmp_path):
+    findings = _staleness(tmp_path, """
+        import jax
+        def f(x):
+            jax.block_until_ready(x)  # sfcheck: ok=sync-discipline -- why
+    """)
+    assert findings == []
+
+
+def test_pragma_in_string_or_prose_is_not_a_pragma(tmp_path):
+    findings = _staleness(tmp_path, '''
+        SRC = """
+        y = jnp.zeros(4)  # sfcheck: ok=hotpath -- inside a string
+        """
+        # doc comment mentioning `# sfcheck: ok` semantics is prose
+        x = 1
+    ''')
+    assert findings == []
+
+
+def test_stale_pragma_not_self_suppressible(tmp_path):
+    # A bare pragma would suppress every pass on its line — staleness
+    # findings deliberately bypass suppression or every dead bare pragma
+    # would hide itself.
+    findings = _staleness(tmp_path, """
+        x = 1  # sfcheck: ok
+    """)
+    assert len(findings) == 1
+
+
+# -- incremental cache / --changed -------------------------------------------
+
+def test_cache_invalidation_and_hits(tmp_path, monkeypatch):
+    import time as _time
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    a = proj / "aa.py"
+    b = proj / "bb.py"
+    a.write_text("import jax\ndef f(x):\n    jax.block_until_ready(x)\n")
+    b.write_text("x = 1\n")
+    monkeypatch.setattr(core, "default_targets", lambda: [str(proj)])
+    cache_path = str(tmp_path / "cache.json")
+
+    analyzed = []
+    real = driver._analyze_file
+
+    def counting(path, relpath, passes, force):
+        analyzed.append(relpath)
+        return real(path, relpath, passes, force)
+
+    monkeypatch.setattr(driver, "_analyze_file", counting)
+
+    r1 = driver.run(changed=True, cache_path=cache_path)
+    assert sorted(analyzed) == ["aa.py", "bb.py"]
+    assert [f.pass_name for f in r1.findings] == ["sync-discipline"]
+    assert os.path.exists(cache_path)
+
+    # untouched → cache hit: nothing re-analyzed, identical findings
+    analyzed.clear()
+    t0 = _time.monotonic()
+    r2 = driver.run(changed=True, cache_path=cache_path)
+    warm_s = _time.monotonic() - t0
+    assert analyzed == []
+    assert [(f.pass_name, f.lineno) for f in r2.findings] == \
+        [(f.pass_name, f.lineno) for f in r1.findings]
+    assert warm_s < 1.0  # the sub-second pre-commit contract
+
+    # edit one file → exactly that file re-analyzed, verdict updates
+    a.write_text("x = 2\n")
+    analyzed.clear()
+    r3 = driver.run(changed=True, cache_path=cache_path)
+    assert analyzed == ["aa.py"]
+    assert r3.findings == []
+
+    # mtime bump with unchanged content (git checkout): still a cache
+    # hit via the sha check, and the entry's stored mtime refreshes so
+    # the NEXT run takes the stat fast path again
+    os.utime(b, ns=(1, 1))
+    analyzed.clear()
+    driver.run(changed=True, cache_path=cache_path)
+    assert analyzed == []
+    entry = json.load(open(cache_path))["files"]["bb.py"]
+    assert entry["mtime_ns"] == os.stat(b).st_mtime_ns
+
+    # plain (non --changed) runs ignore the cache and fully re-analyze
+    analyzed.clear()
+    driver.run(changed=False, cache_path=cache_path)
+    assert sorted(analyzed) == ["aa.py", "bb.py"]
+
+
+def test_cache_entries_survive_roundtrip_uncorrupted(tmp_path, monkeypatch):
+    """Two consecutive cached runs must agree with the uncached verdict —
+    regression for the facts_from_dict mutation that gutted call facts
+    out of the cache on re-save."""
+    proj = tmp_path / "proj"
+    (proj / "parallel").mkdir(parents=True)
+    (proj / "ops").mkdir()
+    (proj / "parallel" / "k.py").write_text(
+        "from ops.s import base\n\ndef sharded_k(mesh, x):\n"
+        "    return base(x)\n"
+    )
+    (proj / "ops" / "s.py").write_text("def base(x):\n    return x\n")
+    (proj / "tests").mkdir()
+    (proj / "tests" / "test_k.py").write_text(
+        "from parallel.k import sharded_k\n"
+    )
+    monkeypatch.setattr(core, "default_targets", lambda: [str(proj)])
+    monkeypatch.setattr(core, "relpath_of", lambda p: os.path.relpath(
+        os.path.abspath(p), str(proj)).replace(os.sep, "/"))
+    cache_path = str(tmp_path / "cache.json")
+    for _ in range(3):  # cold, warm, warm-after-resave
+        report = driver.run(changed=True, cache_path=cache_path)
+        assert report.findings == [], "\n".join(
+            f.format() for f in report.findings)
 
 
 # -- targeted regressions for the violations fixed in this tree --------------

@@ -1,0 +1,121 @@
+"""THE pre-commit gate: ``python -m tools.ci`` (repo root).
+
+One shot, three stages, fail-fast, distinct banners:
+
+1. **sfcheck** — the whole-program static analyzer (all ten passes;
+   ``--changed`` passes the incremental flag through for the sub-second
+   path);
+2. **quick-tier pytest** — ``pytest tests/ -m 'not slow'`` on CPU
+   (PALLAS_AXON_POOL_IPS emptied so nothing dials the axon tunnel at
+   interpreter boot — the CLAUDE.md outage rule);
+3. **bench smoke + sfprof health** — an ``SFT_BENCH_SMOKE`` toy-size
+   bench.py run on XLA:CPU writing a run ledger, then
+   ``python -m tools.sfprof health <ledger>`` threshold verdicts
+   (recompile churn, overflows, late drops, watermark lag).
+
+Exit code: the first failing stage's (sfcheck keeps its 0/1/2/3
+contract; pytest and sfprof theirs). ``--skip-tests`` / ``--skip-bench``
+trim stages for quick iteration; ``--dry-run`` prints the stage commands
+without running anything (pinned by tests/test_ci.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cpu_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    # Never dial the axon tunnel from a pre-commit run (a down/half-open
+    # tunnel hangs ANY python start when the pool IPs are set).
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SFT_BENCH_CHILD", None)
+    return env
+
+
+def stages(changed: bool, skip_tests: bool, skip_bench: bool,
+           ledger_path: Optional[str] = None) \
+        -> List[Tuple[str, List[List[str]]]]:
+    """(name, [argv, ...]) per stage — a stage may chain commands."""
+    py = sys.executable
+    out: List[Tuple[str, List[List[str]]]] = []
+    sfcheck = [py, "-m", "tools.sfcheck"]
+    if changed:
+        sfcheck.append("--changed")
+    out.append(("sfcheck", [sfcheck]))
+    if not skip_tests:
+        out.append(("pytest-quick", [[
+            py, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+            "-p", "no:cacheprovider",
+        ]]))
+    if not skip_bench:
+        ledger = ledger_path or os.path.join(
+            tempfile.gettempdir(), "sft_ci_ledger.json")
+        out.append(("bench-smoke+health", [
+            [py, "bench.py"],
+            [py, "-m", "tools.sfprof", "health", ledger],
+        ]))
+    return out
+
+
+def _bench_env(ledger: str, tmpdir: str) -> Dict[str, str]:
+    env = _cpu_env()
+    env.update({
+        "SFT_BENCH_SMOKE": "1",
+        "SFT_BENCH_BACKOFFS": "0",
+        # toy numbers must never touch the real last-good store
+        "SFT_BENCH_LAST_GOOD": os.path.join(tmpdir, "ci_last_good.json"),
+        "SFT_LEDGER_PATH": ledger,
+    })
+    return env
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ci",
+        description="pre-commit gate: sfcheck → quick pytest → "
+                    "bench smoke + sfprof health",
+    )
+    ap.add_argument("--changed", action="store_true",
+                    help="incremental sfcheck (--changed cache mode)")
+    ap.add_argument("--skip-tests", action="store_true",
+                    help="skip the quick-tier pytest stage")
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="skip the bench-smoke + sfprof health stage")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the stage commands and exit 0")
+    args = ap.parse_args(argv)
+
+    with tempfile.TemporaryDirectory(prefix="sft_ci_") as tmpdir:
+        ledger = os.path.join(tmpdir, "ledger.json")
+        plan = stages(args.changed, args.skip_tests, args.skip_bench,
+                      ledger_path=ledger)
+        if args.dry_run:
+            for name, cmds in plan:
+                for cmd in cmds:
+                    print(f"[{name}] {' '.join(cmd)}")
+            return 0
+        for name, cmds in plan:
+            for cmd in cmds:
+                print(f"== ci stage: {name}: {' '.join(cmd)}", flush=True)
+                env = _bench_env(ledger, tmpdir) \
+                    if name.startswith("bench") else _cpu_env()
+                proc = subprocess.run(cmd, cwd=REPO_ROOT, env=env)
+                if proc.returncode != 0:
+                    print(f"== ci FAILED at stage {name} "
+                          f"(exit {proc.returncode})", flush=True)
+                    return proc.returncode
+        print("== ci: all stages green", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

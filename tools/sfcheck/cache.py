@@ -1,0 +1,153 @@
+"""sfcheck incremental cache — per-file mtime + content-hash entries.
+
+One JSON document (default ``REPO_ROOT/.sfcheck_cache.json``, never
+committed) holding, per analyzed file: the stat mtime_ns + sha256 it was
+analyzed at, the file-pass findings (post-suppression), the consumed-
+pragma ledger, and the extracted ``FileFacts``. A ``--changed`` run
+re-analyzes only files whose mtime OR hash moved and rebuilds the
+whole-program passes from cached facts — sub-second on a one-file edit.
+
+The cache self-invalidates when the analyzer changes shape: entries are
+keyed under a fingerprint of (schema version, registered pass names), so
+adding a pass or bumping ``SCHEMA_VERSION`` discards stale results
+wholesale rather than trusting them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from tools.sfcheck.core import Finding
+from tools.sfcheck.project import FileFacts, facts_from_dict
+
+SCHEMA_VERSION = 1
+
+_SFCHECK_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _analyzer_stamp() -> str:
+    """Stamp of the analyzer's OWN sources (relpath:mtime:size of every
+    tools/sfcheck .py file): editing a pass's rules invalidates the
+    whole cache — `--changed` must never trust verdicts computed under
+    old rules."""
+    parts = []
+    for dirpath, dirnames, filenames in os.walk(_SFCHECK_DIR):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            fp = os.path.join(dirpath, name)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            rel = os.path.relpath(fp, _SFCHECK_DIR)
+            parts.append(f"{rel}:{st.st_mtime_ns}:{st.st_size}")
+    return hashlib.sha256(";".join(parts).encode()).hexdigest()[:16]
+
+
+def fingerprint(pass_names) -> str:
+    return (f"v{SCHEMA_VERSION}:{_analyzer_stamp()}:"
+            + ",".join(sorted(pass_names)))
+
+
+def sha256_of(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {"path": f.path, "lineno": f.lineno, "end_lineno": f.end_lineno,
+            "pass_name": f.pass_name, "message": f.message,
+            "evidence": list(f.evidence)}
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(d["path"], d["lineno"], d["end_lineno"], d["pass_name"],
+                   d["message"], tuple(d.get("evidence", ())))
+
+
+class Cache:
+    def __init__(self, path: str, pass_names):
+        self.path = path
+        self.fp = fingerprint(pass_names)
+        self.entries: Dict[str, dict] = {}
+        self.loaded = False
+        self.dirty = False
+
+    def load(self) -> bool:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if doc.get("fingerprint") != self.fp:
+            return False
+        self.entries = doc.get("files", {})
+        self.loaded = True
+        return True
+
+    def lookup(self, relpath: str, path: str) \
+            -> Optional[Tuple[list, list, FileFacts]]:
+        """(findings, consumed, facts) if the entry is valid for the
+        file's CURRENT mtime+content, else None (file changed/new)."""
+        e = self.entries.get(relpath)
+        if e is None:
+            return None
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        if st.st_mtime_ns == e["mtime_ns"]:
+            pass                      # fast path: untouched since analysis
+        else:
+            try:
+                with open(path, "rb") as f:
+                    if sha256_of(f.read()) != e["sha256"]:
+                        return None
+            except OSError:
+                return None
+            # same content, new mtime (git checkout etc.): refresh the
+            # stored mtime so future runs take the stat fast path again
+            # instead of re-hashing this file forever
+            e["mtime_ns"] = st.st_mtime_ns
+            self.dirty = True
+        return ([_finding_from_dict(d) for d in e["findings"]],
+                [tuple(c) for c in e["consumed"]],
+                facts_from_dict(e["facts"]))
+
+    def store(self, relpath: str, path: str, source_bytes: bytes,
+              findings, consumed, facts: FileFacts,
+              mtime_ns: Optional[int] = None):
+        if mtime_ns is None:
+            # caller should stat BEFORE reading (an edit between read and
+            # stat would pair new mtime with old content); this fallback
+            # keeps the API usable but is race-prone
+            try:
+                mtime_ns = os.stat(path).st_mtime_ns
+            except OSError:
+                mtime_ns = 0
+        self.entries[relpath] = {
+            "mtime_ns": mtime_ns,
+            "sha256": sha256_of(source_bytes),
+            "findings": [_finding_to_dict(f) for f in findings],
+            "consumed": [list(c) for c in consumed],
+            "facts": facts.to_dict(),
+        }
+        self.dirty = True
+
+    def save(self):
+        if self.loaded and not self.dirty:
+            return  # every entry came straight off disk — nothing to write
+        doc = {"fingerprint": self.fp, "files": self.entries}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                # one dumps + one write: json.dump's chunked iterencode
+                # write path is ~2× slower on a multi-MB document
+                f.write(json.dumps(doc, separators=(",", ":")))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # caching is best-effort; never fail the check over it

@@ -1,81 +1,155 @@
-"""sfcheck CLI: ``python -m tools.sfcheck [--pass NAME] [--json] [paths…]``.
+"""sfcheck CLI: ``python -m tools.sfcheck [--changed] [--pass NAME] [--json]``.
 
-No paths → scan the repo's default target set (core.DEFAULT_TARGETS).
-Explicit FILE paths given together with ``--pass`` are force-checked
-regardless of each pass's directory scope (how fixtures and ad-hoc files
-get linted); directories are always scope-filtered.
+No paths → whole-program analysis of the repo's default target set
+(file passes per file, project passes over the cross-file model,
+pragma-staleness last). Explicit FILE paths given together with
+``--pass`` are force-checked regardless of scope; an explicit DIRECTORY
+becomes its own project root (how the fixture mini-repos are analyzed).
 
-Exit codes: 0 clean, 1 findings, 2 usage error. Human mode prints one
-``path:line: [pass] message`` per finding and nothing when clean (same
-contract as the old lint_hotpath CLI); ``--json`` prints a single object
-with the findings plus a per-pass count breakdown.
+``--changed`` reuses the mtime+content-hash cache
+(``.sfcheck_cache.json``) so a one-file edit re-analyzes one file — the
+sub-second pre-commit mode. Plain runs re-analyze everything and refresh
+the cache; ``--no-cache`` touches no cache at all.
+
+Exit codes: 0 clean, 1 findings, 2 usage error, 3 internal crash
+(findings-vs-crash are distinct so CI can tell a regression from a
+broken analyzer). Human mode prints ``path:line: [pass] message`` plus
+indented ``↳`` evidence-chain lines; ``--json`` carries the evidence
+chain per finding and a per-pass count breakdown. Survives ``| head``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import traceback
 
-from tools.sfcheck import core
-from tools.sfcheck.passes import ALL_PASSES, PASS_NAMES, get_pass
+from tools.sfcheck import driver
+from tools.sfcheck.passes import (
+    ALL_PASSES,
+    PASS_NAMES,
+    PROJECT_PASSES,
+    STALENESS,
+    get_pass,
+)
 
 
-def main(argv=None) -> int:
+def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m tools.sfcheck",
-        description="multi-pass static analyzer for the kernel/host "
+        description="whole-program static analyzer for the kernel/host "
                     "architecture invariants",
     )
     ap.add_argument("paths", nargs="*",
-                    help="files/directories (default: the repo tree)")
+                    help="files/directories (default: the repo tree; a "
+                         "directory becomes its own project root)")
     ap.add_argument("--pass", dest="pass_names", action="append",
                     metavar="NAME",
                     help=f"run only this pass (repeatable; one of: "
                          f"{', '.join(PASS_NAMES)})")
+    ap.add_argument("--project-root", default=None, metavar="DIR",
+                    help="re-root project-relative paths at DIR (fixture "
+                         "mini-repos with their own parallel/ + tests/)")
+    ap.add_argument("--changed", action="store_true",
+                    help="reuse the per-file cache; only changed files "
+                         "are re-analyzed (pre-commit fast path)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="never read or write the cache")
+    ap.add_argument("--cache-path", default=None,
+                    help="cache file (default: .sfcheck_cache.json at "
+                         "the repo root)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output with per-pass counts")
+                    help="machine-readable output with per-pass counts "
+                         "and per-finding evidence chains")
     ap.add_argument("--list-passes", action="store_true",
                     help="list passes and the invariant each enforces")
-    args = ap.parse_args(argv)
+    return ap
 
+
+def _detach_stdout():
+    # a consumer like `| head` closed the pipe: not an error, but the
+    # interpreter's exit flush must stay quiet
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+def _run(args) -> int:
     if args.list_passes:
-        for p in ALL_PASSES:
-            print(f"{p.name}: {p.description}")
-            print(f"    invariant: {p.invariant}")
+        try:
+            for p in ALL_PASSES + PROJECT_PASSES + (STALENESS,):
+                kind = "project" if p not in ALL_PASSES else "file"
+                print(f"{p.name} ({kind}): {p.description}")
+                print(f"    invariant: {p.invariant}")
+        except BrokenPipeError:
+            _detach_stdout()
         return 0
 
     if args.pass_names:
         try:
-            passes = [get_pass(n) for n in args.pass_names]
+            for n in args.pass_names:
+                get_pass(n)
         except KeyError as e:
             print(f"sfcheck: {e.args[0]}", file=sys.stderr)
             return 2
-    else:
-        passes = list(ALL_PASSES)
 
-    targets = args.paths or core.default_targets()
-    report = core.run_paths(
-        targets, passes, force_files=bool(args.pass_names and args.paths)
+    for p in args.paths:
+        if not os.path.exists(p):
+            # a typo'd path is a USAGE error (2), not an analyzer crash (3)
+            print(f"sfcheck: no such file or directory: {p}",
+                  file=sys.stderr)
+            return 2
+
+    report = driver.run(
+        paths=args.paths or None,
+        pass_names=args.pass_names,
+        changed=args.changed,
+        use_cache=not args.no_cache,
+        cache_path=args.cache_path,
+        project_root=args.project_root,
     )
 
-    if args.as_json:
-        print(json.dumps({
-            "files": report.files,
-            "counts": report.counts(),
-            "findings": [
-                {"path": f.path, "line": f.lineno, "pass": f.pass_name,
-                 "message": f.message}
-                for f in report.findings
-            ],
-        }, indent=2))
-    else:
-        for f in report.findings:
-            print(f.format())
-        if report.findings:
-            print(f"sfcheck: {len(report.findings)} finding(s) across "
-                  f"{report.files} file(s)")
-    return 1 if report.findings else 0
+    # The exit code is the GATE — compute it before printing so a
+    # consumer closing the pipe early (`sfcheck | head`) cannot turn a
+    # dirty tree into exit 0.
+    code = 1 if report.findings else 0
+    try:
+        if args.as_json:
+            print(json.dumps({
+                "files": report.files,
+                "counts": report.counts(),
+                "findings": [
+                    {"path": f.path, "line": f.lineno,
+                     "pass": f.pass_name, "message": f.message,
+                     "evidence": list(f.evidence)}
+                    for f in report.findings
+                ],
+            }, indent=2))
+        else:
+            for f in report.findings:
+                print(f.format())
+            if report.findings:
+                print(f"sfcheck: {len(report.findings)} finding(s) "
+                      f"across {report.files} file(s)")
+    except BrokenPipeError:
+        _detach_stdout()
+    return code
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except BrokenPipeError:
+        # a pipe break outside the guarded print sections (e.g. the exit
+        # flush): the verdict is unknown, so fail safe for the gate
+        _detach_stdout()
+        return 1
+    except Exception:
+        # Findings exit 1; a broken ANALYZER exits 3 so CI can tell a
+        # real regression from a crashed check.
+        traceback.print_exc()
+        return 3
 
 
 if __name__ == "__main__":

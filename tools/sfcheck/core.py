@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import List, Optional, Sequence, Tuple
 
 REPO_ROOT = os.path.dirname(
@@ -34,6 +36,37 @@ REPO_ROOT = os.path.dirname(
 )
 
 PRAGMA_RE = re.compile(r"#\s*sfcheck:\s*ok(?:=(?P<passes>[A-Za-z0-9_,\-]+))?")
+
+#: Anchored twin: a comment IS a pragma only when it starts with one (a
+#: doc comment *mentioning* ``# sfcheck: ok`` is prose, not a
+#: suppression).
+PRAGMA_AT_START = re.compile(
+    r"^#\s*sfcheck:\s*ok(?:=(?P<passes>[A-Za-z0-9_,\-]+))?")
+
+
+def scan_pragmas(source: str) -> List[dict]:
+    """``# sfcheck: ok`` COMMENT tokens only — never string contents
+    (the test corpus embeds pragma-looking text in source strings), and
+    only comments that start with the pragma."""
+    out: List[dict] = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = PRAGMA_AT_START.match(tok.string)
+            if m is None:
+                continue
+            names = m.group("passes")
+            out.append({
+                "line": tok.start[0],
+                "passes": None if names is None
+                else sorted({n.strip() for n in names.split(",")
+                             if n.strip()}),
+            })
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
 
 # Never scanned in directory walks: build trash plus the deliberate-
 # violation corpus (tests/fixtures/sfcheck — loaded explicitly by tests).
@@ -61,9 +94,15 @@ class Finding:
     end_lineno: int
     pass_name: str
     message: str
+    #: the resolved call-path / cross-file evidence chain, one
+    #: "relpath:line: note" string per step (project passes fill this)
+    evidence: Tuple[str, ...] = ()
 
     def format(self) -> str:
-        return f"{self.path}:{self.lineno}: [{self.pass_name}] {self.message}"
+        head = f"{self.path}:{self.lineno}: [{self.pass_name}] {self.message}"
+        if not self.evidence:
+            return head
+        return head + "".join(f"\n    ↳ {e}" for e in self.evidence)
 
 
 @dataclasses.dataclass
@@ -89,6 +128,15 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self._bindings = None
+        self._pragmas = None
+
+    @property
+    def pragmas(self) -> List[dict]:
+        """Tokenize-based pragma inventory (COMMENT tokens only —
+        pragma-looking text inside string literals never suppresses)."""
+        if self._pragmas is None:
+            self._pragmas = scan_pragmas(self.source)
+        return self._pragmas
 
     @property
     def bindings(self):
@@ -118,6 +166,27 @@ class Pass:
         raise NotImplementedError
 
 
+class ProjectPass:
+    """Base class for whole-program passes (registered in
+    passes/__init__.py). Runs once over the project model + call graph
+    instead of once per file; findings carry an evidence chain."""
+
+    name: str = ""
+    description: str = ""
+    invariant: str = ""
+
+    def in_scope(self, relpath: str) -> bool:
+        """Files whose code this pass may REPORT findings in (the whole
+        project always contributes context). Driver force mode widens
+        this to everything."""
+        raise NotImplementedError
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        """``in_scope`` is a callable(relpath) merging self.in_scope with
+        the driver's force flag."""
+        raise NotImplementedError
+
+
 def relpath_of(path: str) -> str:
     ap = os.path.abspath(path)
     if ap == REPO_ROOT or ap.startswith(REPO_ROOT + os.sep):
@@ -125,21 +194,71 @@ def relpath_of(path: str) -> str:
     return os.path.basename(ap)
 
 
-def _suppressed(p: Pass, ctx: FileContext, node: ast.AST) -> bool:
+def _suppressing_pragma(p: Pass, ctx: FileContext,
+                        node: ast.AST) -> Optional[Tuple[str, int]]:
+    """("sfcheck"|"legacy", line) of the pragma suppressing this finding,
+    or None. sfcheck pragmas come from the tokenize inventory (comment
+    tokens only — pragma-looking text inside a string argument of the
+    flagged node never suppresses); only they count for staleness."""
     lineno = getattr(node, "lineno", 1)
-    last = getattr(node, "end_lineno", None) or lineno
-    for ln in range(lineno, min(last, len(ctx.lines)) + 1):
-        line = ctx.lines[ln - 1]
-        m = PRAGMA_RE.search(line)
-        if m is not None:
-            names = m.group("passes")
-            if names is None:
-                return True
-            if p.name in {n.strip() for n in names.split(",")}:
-                return True
-        if p.legacy_pragma is not None and p.legacy_pragma.search(line):
-            return True
-    return False
+    last = max(getattr(node, "end_lineno", None) or lineno, lineno)
+    for pr in ctx.pragmas:
+        if lineno <= pr["line"] <= last:
+            if pr["passes"] is None or p.name in pr["passes"]:
+                return ("sfcheck", pr["line"])
+    if p.legacy_pragma is not None:
+        for ln in range(lineno, min(last, len(ctx.lines)) + 1):
+            if p.legacy_pragma.search(ctx.lines[ln - 1]):
+                return ("legacy", ln)
+    return None
+
+
+def suppressed_by_pragmas(pass_name: str, lineno: int, end_lineno: int,
+                          pragmas) -> Optional[int]:
+    """Pragma-line suppressing a PROJECT-pass finding, from a pragma
+    inventory (project.scan_pragmas dicts) instead of source lines."""
+    for pr in pragmas:
+        if lineno <= pr["line"] <= max(end_lineno, lineno):
+            if pr["passes"] is None or pass_name in pr["passes"]:
+                return pr["line"]
+    return None
+
+
+def analyze_source(
+    path: str,
+    source: str,
+    passes: Sequence[Pass],
+    relpath: Optional[str] = None,
+    force: bool = False,
+) -> Tuple[List[Finding], List[Tuple[int, str]], Optional["FileContext"]]:
+    """File passes over one source: (findings, consumed-pragma records,
+    parsed context). ``consumed`` lists (pragma_line, pass_name) for every
+    suppressed finding — the pragma-staleness rule's liveness evidence."""
+    relpath = relpath_of(path) if relpath is None else relpath
+    try:
+        ctx = FileContext(path, relpath, source)
+    except SyntaxError as e:
+        return ([Finding(path, e.lineno or 1, e.lineno or 1, "syntax",
+                         f"file does not parse: {e.msg}")], [], None)
+    findings: List[Finding] = []
+    consumed: List[Tuple[int, str]] = []
+    base = os.path.basename(relpath)
+    for p in passes:
+        if base in p.allow_basenames:
+            continue
+        if not force and not p.applies_to(relpath):
+            continue
+        for node, message in p.run(ctx):
+            sup = _suppressing_pragma(p, ctx, node)
+            if sup is not None:
+                if sup[0] == "sfcheck":
+                    consumed.append((sup[1], p.name))
+                continue
+            lineno = getattr(node, "lineno", 1)
+            end = getattr(node, "end_lineno", None) or lineno
+            findings.append(Finding(path, lineno, end, p.name, message))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.pass_name))
+    return findings, consumed, ctx
 
 
 def check_source(
@@ -149,27 +268,7 @@ def check_source(
     relpath: Optional[str] = None,
     force: bool = False,
 ) -> List[Finding]:
-    relpath = relpath_of(path) if relpath is None else relpath
-    try:
-        ctx = FileContext(path, relpath, source)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 1, e.lineno or 1, "syntax",
-                        f"file does not parse: {e.msg}")]
-    findings: List[Finding] = []
-    base = os.path.basename(relpath)
-    for p in passes:
-        if base in p.allow_basenames:
-            continue
-        if not force and not p.applies_to(relpath):
-            continue
-        for node, message in p.run(ctx):
-            if _suppressed(p, ctx, node):
-                continue
-            lineno = getattr(node, "lineno", 1)
-            end = getattr(node, "end_lineno", None) or lineno
-            findings.append(Finding(path, lineno, end, p.name, message))
-    findings.sort(key=lambda f: (f.path, f.lineno, f.pass_name))
-    return findings
+    return analyze_source(path, source, passes, relpath, force)[0]
 
 
 def check_file(path: str, passes: Sequence[Pass],
@@ -178,13 +277,17 @@ def check_file(path: str, passes: Sequence[Pass],
         return check_source(path, f.read(), passes, force=force)
 
 
-def iter_python_files(root: str):
+def iter_python_files(root: str, rel_excludes: bool = True):
+    """Walk ``root`` for .py files. ``rel_excludes=False`` drops the
+    repo-relative prefix excludes (the deliberate-violation fixture
+    corpus) — used when a fixture mini-repo IS the analysis target."""
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(
             d for d in dirnames
             if d not in EXCLUDE_DIR_NAMES
-            and not relpath_of(os.path.join(dirpath, d)).startswith(
-                EXCLUDE_REL_PREFIXES)
+            and (not rel_excludes
+                 or not relpath_of(os.path.join(dirpath, d)).startswith(
+                     EXCLUDE_REL_PREFIXES))
         )
         for name in sorted(filenames):
             if name.endswith(".py"):

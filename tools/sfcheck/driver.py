@@ -1,0 +1,209 @@
+"""sfcheck driver — file passes + whole-program passes + staleness + cache.
+
+The orchestration the CLI (and tests) call:
+
+1. resolve targets → (path, relpath, project_root) triples;
+2. per file: run the file passes and extract ``FileFacts`` — or, in
+   ``--changed`` mode, reuse the cache entry when mtime+sha match;
+3. build the ``Project`` + ``CallGraph`` and run the project passes
+   (suppressible by the same ``# sfcheck: ok=<pass>`` pragmas, via the
+   cached pragma inventory);
+4. the pragma-staleness rule: any sfcheck pragma that consumed zero
+   findings across ALL passes is emitted as a finding (staleness
+   findings are deliberately NOT pragma-suppressible — a dead pragma is
+   deleted, not waived).
+
+Scoping mirrors the per-file framework: directory targets are
+scope-filtered, explicit files passed with ``--pass`` are force-checked,
+and a directory passed with ``--pass`` becomes its own project root
+(how the mesh-parity fixture mini-repos are analyzed).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.sfcheck import core
+from tools.sfcheck.cache import Cache
+from tools.sfcheck.callgraph import CallGraph
+from tools.sfcheck.core import Finding, Report
+from tools.sfcheck.project import FileFacts, Project, extract_facts
+from tools.sfcheck.passes import (
+    ALL_PASSES,
+    PASS_NAMES,
+    PROJECT_PASSES,
+    STALENESS,
+)
+
+DEFAULT_CACHE = os.path.join(core.REPO_ROOT, ".sfcheck_cache.json")
+
+
+def _collect_targets(paths: Optional[Sequence[str]],
+                     project_root: Optional[str] = None) \
+        -> Tuple[List[Tuple[str, str, bool]], bool]:
+    """→ ([(path, relpath, is_explicit_file)], default_mode).
+
+    Relpaths are repo-relative (same scoping as the per-file framework)
+    unless ``project_root`` re-roots them — how a fixture mini-repo
+    under tests/fixtures/ becomes its own project with ``parallel/`` and
+    ``tests/`` at its top level."""
+    def rel_of(fp: str) -> str:
+        if project_root is not None:
+            return os.path.relpath(
+                os.path.abspath(fp), os.path.abspath(project_root)
+            ).replace(os.sep, "/")
+        return core.relpath_of(fp)
+
+    out: List[Tuple[str, str, bool]] = []
+    if not paths:
+        for target in core.default_targets():
+            if os.path.isdir(target):
+                for fp in core.iter_python_files(target):
+                    out.append((fp, core.relpath_of(fp), False))
+            else:
+                out.append((target, core.relpath_of(target), False))
+        return out, True
+    for p in paths:
+        if os.path.isdir(p):
+            for fp in core.iter_python_files(
+                    p, rel_excludes=project_root is None):
+                out.append((fp, rel_of(fp), False))
+        else:
+            out.append((p, rel_of(p), True))
+    return out, False
+
+
+def _analyze_file(path: str, relpath: str, passes, force: bool):
+    """→ (findings, consumed, facts, source_bytes, mtime_ns).
+
+    The stat happens BEFORE the read: if the file is edited between the
+    two, the cache entry pairs the OLD mtime with the NEW content and
+    the next --changed run simply re-hashes — never the reverse (new
+    mtime trusted over stale findings)."""
+    try:
+        mtime_ns = os.stat(path).st_mtime_ns
+    except OSError:
+        mtime_ns = 0
+    with open(path, "rb") as f:
+        raw = f.read()
+    source = raw.decode("utf-8")
+    findings, consumed, ctx = core.analyze_source(
+        path, source, passes, relpath=relpath, force=force)
+    if ctx is None:    # syntax error: empty facts keep the project sane
+        facts = FileFacts(relpath=relpath, module="")
+    else:
+        facts = extract_facts(relpath, ctx.tree, source, ctx.bindings)
+    return findings, consumed, facts, raw, mtime_ns
+
+
+def run(
+    paths: Optional[Sequence[str]] = None,
+    pass_names: Optional[Sequence[str]] = None,
+    changed: bool = False,
+    use_cache: bool = True,
+    cache_path: Optional[str] = None,
+    force_files: bool = False,
+    project_root: Optional[str] = None,
+) -> Report:
+    """Full analysis. ``pass_names=None`` → every pass incl. staleness.
+    ``changed=True`` reuses valid cache entries instead of re-analyzing
+    (the sub-second pre-commit mode); plain runs re-analyze everything
+    and refresh the cache."""
+    targets, default_mode = _collect_targets(paths, project_root)
+
+    selected = set(pass_names) if pass_names else set(PASS_NAMES)
+    if not default_mode and not pass_names:
+        # Ad-hoc targets form a PARTIAL project view — whole-program
+        # passes would see an incomplete world (no ops/ counterparts, no
+        # callers, no tests) and manufacture findings, and staleness
+        # would mis-report pragmas consumed by cross-file evidence. File
+        # passes only; an explicit --pass opts a project pass back in.
+        selected -= {p.name for p in PROJECT_PASSES} | {STALENESS.name}
+    want_staleness = STALENESS.name in selected
+    # staleness needs every pass's suppression ledger, so its selection
+    # forces a full internal run; emission is filtered at the end.
+    internal_file_passes = list(ALL_PASSES) if want_staleness else [
+        p for p in ALL_PASSES if p.name in selected]
+    internal_project_passes = list(PROJECT_PASSES) if want_staleness else [
+        p for p in PROJECT_PASSES if p.name in selected]
+
+    force = force_files or (bool(pass_names) and not default_mode)
+
+    cache: Optional[Cache] = None
+    full_set = selected == set(PASS_NAMES)
+    if use_cache and default_mode and full_set:
+        cache = Cache(cache_path or DEFAULT_CACHE, PASS_NAMES)
+        if changed:
+            cache.load()
+
+    all_findings: List[Finding] = []
+    consumed_by_file: Dict[str, set] = {}
+    project = Project()
+    display_path: Dict[str, str] = {}
+    explicit_rels: set = set()
+    files = 0
+    for path, relpath, explicit in targets:
+        files += 1
+        display_path[relpath] = path
+        if explicit:
+            explicit_rels.add(relpath)
+        hit = cache.lookup(relpath, path) if (cache and cache.loaded) \
+            else None
+        if hit is not None:
+            findings, consumed, facts = hit
+        else:
+            findings, consumed, facts, raw, mtime_ns = _analyze_file(
+                path, relpath, internal_file_passes,
+                force=force and explicit)
+            if cache is not None:
+                cache.store(relpath, path, raw, findings, consumed, facts,
+                            mtime_ns=mtime_ns)
+        all_findings.extend(findings)
+        consumed_by_file[relpath] = {c[0] for c in consumed}
+        project.add(facts)
+
+    if internal_project_passes:
+        graph = CallGraph(project)
+        for p in internal_project_passes:
+            # force-widening mirrors the file passes: explicit FILES are
+            # force-checked, directory contents stay scope-filtered
+            def in_scope(rel, _p=p):
+                return (force and rel in explicit_rels) or _p.in_scope(rel)
+            for f in p.run_project(project, graph, in_scope):
+                facts = project.files.get(f.path)
+                pragmas = facts.pragmas if facts is not None else []
+                sup = core.suppressed_by_pragmas(
+                    f.pass_name, f.lineno, f.end_lineno, pragmas)
+                if sup is not None:
+                    consumed_by_file.setdefault(f.path, set()).add(sup)
+                    continue
+                # project findings carry relpaths; print the real path
+                all_findings.append(Finding(
+                    display_path.get(f.path, f.path), f.lineno,
+                    f.end_lineno, f.pass_name, f.message, f.evidence))
+
+    if want_staleness:
+        for relpath, facts in project.files.items():
+            used = consumed_by_file.get(relpath, set())
+            for pr in facts.pragmas:
+                if pr["line"] in used:
+                    continue
+                names = pr["passes"]
+                what = "all passes" if names is None else ", ".join(names)
+                all_findings.append(Finding(
+                    display_path.get(relpath, relpath), pr["line"],
+                    pr["line"], STALENESS.name,
+                    f"stale `# sfcheck: ok` pragma (suppresses zero "
+                    f"findings for {what}) — delete it; dead "
+                    "suppressions hide future regressions",
+                ))
+
+    if cache is not None:
+        cache.save()
+
+    emitted = [f for f in all_findings
+               if f.pass_name in selected or f.pass_name == "syntax"]
+    emitted.sort(key=lambda f: (f.path, f.lineno, f.pass_name))
+    report = Report(emitted, files, sorted(selected))
+    return report

@@ -1,12 +1,38 @@
-"""Pass registry. Adding a pass = write the module, list it here."""
+"""Pass registry. Adding a pass = write the module, list it here.
+
+Two kinds: per-file passes (``core.Pass`` — one AST at a time) and
+whole-program passes (``core.ProjectPass`` — run over the project model
++ call graph by the driver). ``pragma-staleness`` is a driver-level rule
+(it needs every other pass's suppression ledger) registered here as a
+descriptor so ``--list-passes``/``--pass`` see it.
+"""
 
 from __future__ import annotations
 
+from tools.sfcheck.passes.donation_safety import DonationSafetyPass
 from tools.sfcheck.passes.fixed_shape import FixedShapePass
 from tools.sfcheck.passes.fstring_numpy import FstringNumpyPass
 from tools.sfcheck.passes.hotpath import HotpathPass
+from tools.sfcheck.passes.hotpath_interproc import HotpathInterprocPass
+from tools.sfcheck.passes.mesh_parity import MeshParityPass
+from tools.sfcheck.passes.recompile_surface import RecompileSurfacePass
 from tools.sfcheck.passes.sync_discipline import SyncDisciplinePass
 from tools.sfcheck.passes.trace_hygiene import TraceHygienePass
+
+
+class PragmaStalenessRule:
+    """Descriptor for the driver-computed staleness rule: a
+    ``# sfcheck: ok`` that suppresses zero findings is itself a finding
+    (dead suppressions hide future regressions). Implemented in
+    tools/sfcheck/driver.py — it consumes the suppression ledger of
+    every other pass, so it cannot run as a standalone pass."""
+
+    name = "pragma-staleness"
+    description = ("a `# sfcheck: ok` pragma that suppresses zero "
+                   "findings is itself a finding")
+    invariant = ("suppressions are honest: every pragma pins a real, "
+                 "currently-firing finding with a justification")
+
 
 ALL_PASSES = (
     HotpathPass(),
@@ -16,11 +42,21 @@ ALL_PASSES = (
     FstringNumpyPass(),
 )
 
-PASS_NAMES = tuple(p.name for p in ALL_PASSES)
+PROJECT_PASSES = (
+    HotpathInterprocPass(),
+    MeshParityPass(),
+    RecompileSurfacePass(),
+    DonationSafetyPass(),
+)
+
+STALENESS = PragmaStalenessRule()
+
+PASS_NAMES = tuple(p.name for p in ALL_PASSES) \
+    + tuple(p.name for p in PROJECT_PASSES) + (STALENESS.name,)
 
 
 def get_pass(name: str):
-    for p in ALL_PASSES:
+    for p in ALL_PASSES + PROJECT_PASSES + (STALENESS,):
         if p.name == name:
             return p
     raise KeyError(

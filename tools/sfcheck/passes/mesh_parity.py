@@ -1,0 +1,125 @@
+"""mesh-parity pass — every parallel/ kernel has a counterpart + parity test.
+
+Invariant (CLAUDE.md "Architecture invariants"): *sharding never changes
+semantics — every ``parallel/`` kernel has a bit-identical single-device
+counterpart and a parity test on the 8-device CPU mesh.* Machine-checked
+for the first time:
+
+A **public mesh kernel** is a top-level, non-underscore function in a
+``parallel/`` module whose first parameter is ``mesh`` (the kernel-entry
+signature convention; mesh builders and multihost plumbing don't take a
+mesh first). For each one:
+
+1. **counterpart**: the kernel (or any function it calls within 3 hops,
+   with nested closures attributed to their parent) must call into an
+   ``ops/`` module — the single-device kernel it shard_maps. Generic
+   dispatchers that take the kernel as a parameter (``kernel``/``fn``/
+   ``func``) carry their counterpart at the call site and are exempt
+   from this half.
+2. **parity test**: the kernel's NAME must be referenced somewhere under
+   ``tests/`` — a parity test nobody can find by name is a parity test
+   that silently stops running when the operator layer reroutes.
+
+Findings carry the resolved counterpart (or its absence) and the test
+files scanned as cross-file evidence.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tools.sfcheck.core import Finding, ProjectPass
+
+_GENERIC_PARAMS = ("kernel", "fn", "func")
+
+
+def _segments(relpath: str) -> List[str]:
+    return relpath.split("/")
+
+
+def _in_parallel(relpath: str) -> bool:
+    return "parallel" in _segments(relpath)[:-1]
+
+
+def _in_ops(relpath: str) -> bool:
+    return "ops" in _segments(relpath)[:-1]
+
+
+class MeshParityPass(ProjectPass):
+    name = "mesh-parity"
+    description = ("every public parallel/ mesh kernel resolves to a "
+                   "single-device ops/ counterpart and is referenced by "
+                   "a test")
+    invariant = ("sharding never changes semantics: parallel/ kernels "
+                 "have bit-identical single-device counterparts with "
+                 "parity tests on the CPU mesh")
+
+    def in_scope(self, relpath: str) -> bool:
+        return _in_parallel(relpath)
+
+    def _counterpart(self, graph, rel: str, qualname: str) \
+            -> Optional[Tuple[str, str]]:
+        for ref in graph.counterpart_edges(rel, qualname, depth=3):
+            if _in_ops(ref[0]):
+                return ref
+        return None
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        findings: List[Finding] = []
+        test_files = project.test_files()
+        # A project view with NO test files (e.g. the CLI pointed at a
+        # source subtree) cannot evaluate the reference half; only the
+        # counterpart half runs. The default full-tree scan always
+        # includes tests/.
+        check_tests = bool(test_files)
+        test_names = {}
+        for tf in test_files:
+            for n in tf.names_used:
+                test_names.setdefault(n, tf.relpath)
+        for rel, facts, fn in project.iter_functions():
+            if not _in_parallel(rel) or not in_scope(rel):
+                continue
+            if fn.cls is not None or fn.nested_in is not None:
+                continue
+            if fn.name.startswith("_") or not fn.params:
+                continue
+            if fn.params[0] != "mesh":
+                continue
+            generic = any(p in _GENERIC_PARAMS for p in fn.params)
+            counterpart = self._counterpart(graph, rel, fn.qualname)
+            if counterpart is None and not generic:
+                findings.append(Finding(
+                    rel, fn.lineno, fn.end_lineno, self.name,
+                    f"parallel/ kernel `{fn.name}` resolves to no "
+                    "single-device ops/ counterpart (within 3 call "
+                    "hops) — a sharded kernel must shard_map the same "
+                    "kernel the single-device path jits",
+                    evidence=(
+                        f"{rel}:{fn.lineno}: public mesh kernel "
+                        f"`{fn.name}(mesh, …)`",
+                        "no call edge into an ops/ module found "
+                        "(hops ≤ 3, closures included)",
+                    ),
+                ))
+            tested_in = test_names.get(fn.name)
+            if not check_tests:
+                continue
+            if tested_in is None:
+                findings.append(Finding(
+                    rel, fn.lineno, fn.end_lineno, self.name,
+                    f"parallel/ kernel `{fn.name}` is referenced by no "
+                    "test — the bit-parity invariant for this kernel is "
+                    "not machine-checked (add a single-vs-sharded parity "
+                    "test on the CPU mesh)",
+                    evidence=(
+                        f"{rel}:{fn.lineno}: public mesh kernel "
+                        f"`{fn.name}(mesh, …)`",
+                    ) + ((
+                        f"counterpart: {counterpart[0]}:"
+                        f"{counterpart[1]}",
+                    ) if counterpart else ()) + (
+                        f"scanned {len(test_files)} test file(s); "
+                        f"`{fn.name}` appears in none",
+                    ),
+                ))
+        return findings

@@ -1,0 +1,80 @@
+"""recompile-surface pass — data-dependent shapes must ride the ladder.
+
+Invariant (ops/compaction.py + the PR 1 recompile detector): device
+programs compile once per distinct shape signature, so any per-window
+shape must come from a SMALL STATIC ladder (≤K stable signatures), never
+raw from the data. The runtime detector catches churn after the fact;
+this pass catches it before commit: a device-shape sink — a
+``jnp.zeros/ones/full/empty/arange/…`` dimension or a
+``pad_to_bucket(…, bucket)`` bucket — fed by a
+**data-dependent Python int** (``len()`` of a runtime collection, a
+``.shape[i]`` subscript, a loop index) is a finding when it executes on
+a per-window path, UNLESS the int was routed through a sanctioned
+bucketer first: ``ops/compaction.py:pick_capacity`` /
+``wire_pane_bucket`` / ``capacity_ladder`` or
+``utils/padding.py:next_bucket``.
+
+Host-side numpy staging (``np.zeros(n)`` later padded) is deliberately
+NOT a sink — only the shapes that reach the device matter. Device-
+classified functions are exempt (their shapes are already abstract).
+Findings carry the taint source and the call path from the window loop.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import MODULE_FN
+
+
+class RecompileSurfacePass(ProjectPass):
+    name = "recompile-surface"
+    description = ("per-window device shapes must come from the "
+                   "compaction ladder, not data-dependent Python ints")
+    invariant = ("registration/occupancy churn must not recompile: "
+                 "≤K stable shape signatures per kernel "
+                 "(pick_capacity / wire_pane_bucket / next_bucket)")
+
+    def in_scope(self, relpath: str) -> bool:
+        return relpath.startswith("spatialflink_tpu/")
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel, facts, fn in project.iter_functions():
+            if not in_scope(rel):
+                continue
+            if graph.is_device(rel, fn.qualname):
+                continue
+            chain = graph.hot_chain(rel, fn.qualname)
+            where = ("module scope" if fn.qualname == MODULE_FN
+                     else f"`{fn.name}`")
+            for site in fn.shape_sites:
+                evidence = None
+                if site.get("in_window_loop"):
+                    evidence = [
+                        f"{rel}:{site['lineno']}: {site['desc']} directly "
+                        f"inside a per-window loop at {where}",
+                    ]
+                elif chain is not None:
+                    evidence = [f"{s.relpath}:{s.lineno}: {s.note}"
+                                for s in chain]
+                    evidence.append(
+                        f"{rel}:{site['lineno']}: {site['desc']} in "
+                        f"`{fn.name}`")
+                if evidence is None:
+                    continue
+                evidence.append(
+                    f"shape derives from {site['src']} — a data-"
+                    "dependent Python int (one XLA compile per distinct "
+                    "value)")
+                findings.append(Finding(
+                    rel, site["lineno"], site["end_lineno"], self.name,
+                    f"{site['desc']} derives from {site['src']} on a "
+                    "per-window path — every distinct value is a fresh "
+                    "XLA compile; route through the compaction ladder "
+                    "(ops/compaction.py:pick_capacity / wire_pane_bucket "
+                    "/ utils/padding.py:next_bucket)",
+                    evidence=tuple(evidence),
+                ))
+        return findings

@@ -1,0 +1,591 @@
+"""sfcheck project model — per-file fact extraction for whole-program passes.
+
+The file passes (tools/sfcheck/passes/) see one AST at a time; the
+project passes (hotpath-interproc, mesh-parity, recompile-surface,
+donation-safety) need the cross-file picture CLAUDE.md's invariants are
+actually written about. This module extracts, from each file's AST, a
+compact JSON-serializable ``FileFacts`` summary holding everything those
+passes need:
+
+- **imports**: local name → module / object it resolves to;
+- **functions** (incl. methods and nested defs, qualname-indexed):
+  params, decorators, span, every call site (resolved-enough target
+  expression + argument names + ``donate_argnums`` if literal + whether
+  the call sits inside a per-window loop), per-name load/store lines,
+  loop spans;
+- **candidate sites** evaluated later under call-graph gating:
+  ``eager_jnp`` (jax.numpy COMPUTE calls — ``asarray``/``array`` device
+  ships are sanctioned) and ``shape_sites`` (device-shape sinks whose
+  dimension derives from a data-dependent Python int — ``len()`` of a
+  runtime collection, ``.shape`` subscripts, loop indices — without
+  passing a compaction-ladder sanitizer: ``pick_capacity`` /
+  ``wire_pane_bucket`` / ``next_bucket`` / ``capacity_ladder``);
+- **classes** (bases + methods) and **names_used** (every identifier,
+  for mesh-parity's "referenced by a parity test" check);
+- **pragmas**: ``# sfcheck: ok`` comment tokens (tokenize-based, so
+  pragmas inside string literals — the test corpus embeds some — are
+  not mistaken for real suppressions), consumed-or-stale tracked by the
+  pragma-staleness rule.
+
+Facts round-trip through JSON (``to_dict``/``facts_from_dict``) so the
+incremental cache can skip re-parsing unchanged files entirely.
+
+The per-window loop heuristic matches the repo's (very regular) window
+plumbing: a ``for`` whose iterator is a call to one of
+``WINDOW_ITER_CALLEES`` (``self.windows(...)``, ``asm.stream(...)``,
+``soa_point_batches(...)``, …), or whose loop target is literally
+``win``/``window``. Everything lexically inside such a loop runs once
+per window — the path CLAUDE.md bans eager JAX work on.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from tools.sfcheck.passes._shared import Bindings, dotted
+
+#: Iterator-call terminal names that mark a per-window / per-record loop.
+WINDOW_ITER_CALLEES = frozenset({
+    "windows", "stream", "soa_point_batches", "count_window_batches",
+    "_checkpointable_windows", "_checkpointable_soa_windows", "feed",
+    "flush",
+})
+
+#: Loop targets that mark a per-window loop even without a recognized
+#: iterator call (the repo convention: ``for win in …``).
+WINDOW_TARGET_NAMES = frozenset({"win", "window"})
+
+#: jax.numpy attributes that are device SHIPS, not compute — sanctioned
+#: per window at the documented ship sites (operators/base.py:ship).
+JNP_SHIP_ATTRS = frozenset({"asarray", "array"})
+
+#: jax.numpy attributes that are pure host-side METADATA — no XLA
+#: dispatch happens (dtype lattice queries), so they are never "eager".
+JNP_META_ATTRS = frozenset({
+    "finfo", "iinfo", "dtype", "result_type", "promote_types",
+    "issubdtype", "shape", "ndim",
+})
+
+#: Calls that launder a data-dependent int into a static bucket — the
+#: compaction ladder (ops/compaction.py) + the padding bucketer.
+SHAPE_SANITIZERS = frozenset({
+    "pick_capacity", "wire_pane_bucket", "next_bucket", "capacity_ladder",
+    "max_window_cell_count",
+})
+
+#: Device-shape allocators: a tainted dimension here IS a per-window
+#: recompile (one XLA compile per distinct value).
+JNP_SHAPE_SINKS = frozenset({
+    "zeros", "ones", "full", "empty", "arange", "linspace", "eye",
+})
+
+MODULE_FN = "<module>"
+
+
+@dataclasses.dataclass
+class CallFact:
+    target: str            # dotted expr ("np.zeros", "self.windows", ".item")
+    lineno: int
+    end_lineno: int
+    args: List[Optional[str]]            # dotted names of positional args
+    kw_args: Dict[str, Optional[str]]    # keyword name -> dotted value name
+    donate: Optional[List[int]] = None   # literal donate_argnums, if any
+    in_window_loop: bool = False
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    name: str
+    qualname: str
+    lineno: int
+    end_lineno: int
+    cls: Optional[str] = None            # enclosing class name
+    nested_in: Optional[str] = None      # enclosing function qualname
+    params: List[str] = dataclasses.field(default_factory=list)
+    decorators: List[str] = dataclasses.field(default_factory=list)
+    calls: List[CallFact] = dataclasses.field(default_factory=list)
+    eager_jnp: List[dict] = dataclasses.field(default_factory=list)
+    shape_sites: List[dict] = dataclasses.field(default_factory=list)
+    loops: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    window_loops: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    loads: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    stores: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    #: literal donate_argnums from a @jit/@partial(jax.jit, …) decorator
+    donate_decorator: Optional[List[int]] = None
+
+
+@dataclasses.dataclass
+class FileFacts:
+    relpath: str
+    module: str                           # dotted module name within project
+    imports: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FunctionFacts] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    names_used: List[str] = dataclasses.field(default_factory=list)
+    pragmas: List[dict] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def facts_from_dict(d: dict) -> FileFacts:
+    f = FileFacts(d["relpath"], d["module"], d.get("imports", {}),
+                  {}, d.get("classes", {}), d.get("names_used", []),
+                  d.get("pragmas", []))
+    for q, fd in d.get("functions", {}).items():
+        # .get, never .pop: the dict may be a live cache entry that will
+        # be re-serialized — mutating it here gutted the on-disk cache.
+        calls = [CallFact(**c) for c in fd.get("calls", [])]
+        fn = FunctionFacts(**{k: v for k, v in fd.items() if k != "calls"})
+        fn.calls = calls
+        fn.loops = [tuple(s) for s in fn.loops]
+        fn.window_loops = [tuple(s) for s in fn.window_loops]
+        f.functions[q] = fn
+    return f
+
+
+def module_name_of(relpath: str) -> str:
+    """Dotted module name for a project-relative path ("a/b/c.py" →
+    "a.b.c"; "__init__.py" collapses to the package)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [p for p in mod.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# Pragma scanning lives in core (the file passes' suppression shares
+# the same tokenize inventory); re-exported here for the facts builder.
+from tools.sfcheck.core import PRAGMA_AT_START, scan_pragmas  # noqa: F401,E402
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int) \
+                    and not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class _Extractor(ast.NodeVisitor):
+    """One source-ordered walk collecting every FileFacts field."""
+
+    #: propagate taint through these plain builtins
+    _TAINT_PROPAGATORS = frozenset({"int", "max", "min", "abs", "sum"})
+
+    def __init__(self, facts: FileFacts, bindings: Bindings):
+        self.facts = facts
+        self.b = bindings
+        self.fn_stack: List[FunctionFacts] = []
+        self.cls_stack: List[str] = []
+        self.loop_stack: List[Tuple[int, int, bool]] = []  # (start, end, window)
+        self.tainted_stack: List[set] = []
+        self.names_used: set = set()
+        module_fn = FunctionFacts(MODULE_FN, MODULE_FN, 1, 10 ** 9)
+        facts.functions[MODULE_FN] = module_fn
+        self.fn_stack.append(module_fn)
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def fn(self) -> FunctionFacts:
+        return self.fn_stack[-1]
+
+    def _qual(self, name: str) -> str:
+        parts = []
+        if len(self.fn_stack) > 1:
+            parts.append(self.fn_stack[-1].qualname)
+        elif self.cls_stack:
+            parts.append(".".join(self.cls_stack))
+        parts.append(name)
+        return ".".join(parts)
+
+    def _in_window_loop(self) -> bool:
+        return any(w for _, _, w in self.loop_stack)
+
+    def _tainted(self) -> dict:
+        return self.tainted_stack[-1] if self.tainted_stack else {}
+
+    # -- taint evaluation ----------------------------------------------------
+
+    def _taints(self, node: ast.AST) -> Optional[str]:
+        """A short description of why ``node`` is a data-dependent Python
+        int, or None if it is not (conservatively)."""
+        if isinstance(node, ast.Name):
+            return self._tainted().get(node.id)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            term = (d or "").split(".")[-1]
+            if term in SHAPE_SANITIZERS:
+                return None
+            if d == "len" and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                return f"`{ast.unparse(node)}`"
+            if term in self._TAINT_PROPAGATORS:
+                for a in node.args:
+                    why = self._taints(a)
+                    if why:
+                        return why
+            return None
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] — a runtime array dimension.
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "shape":
+                return f"`{ast.unparse(node)}`"
+            return None
+        if isinstance(node, ast.BinOp):
+            return self._taints(node.left) or self._taints(node.right)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                why = self._taints(elt)
+                if why:
+                    return why
+            return None
+        if isinstance(node, ast.Starred):
+            return self._taints(node.value)
+        return None
+
+    def _record_store_taint(self, target: ast.AST, value: ast.AST):
+        if not self.tainted_stack:
+            return
+        tset = self.tainted_stack[-1]
+        if isinstance(target, ast.Name):
+            why = self._taints(value)
+            if why:
+                tset[target.id] = why
+            else:
+                tset.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)) and isinstance(
+                value, (ast.Tuple, ast.List)) \
+                and len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                self._record_store_taint(t, v)
+
+    # -- scope plumbing ------------------------------------------------------
+
+    def _visit_function(self, node):
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            self.visit(d)
+        qual = self._qual(node.name)
+        fn = FunctionFacts(
+            name=node.name, qualname=qual, lineno=node.lineno,
+            end_lineno=node.end_lineno or node.lineno,
+            cls=self.cls_stack[-1] if self.cls_stack and len(
+                self.fn_stack) == 1 else None,
+            nested_in=self.fn.qualname if len(self.fn_stack) > 1 else None,
+            params=[a.arg for a in node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs]
+            + ([node.args.vararg.arg] if node.args.vararg else [])
+            + ([node.args.kwarg.arg] if node.args.kwarg else []),
+            decorators=[d for d in (
+                dotted(dec.func) if isinstance(dec, ast.Call) else dotted(dec)
+                for dec in node.decorator_list) if d],
+        )
+        # partial(jax.jit, ...) decorators: keep the wrapped target too,
+        # and literal donate_argnums make the def a donating callable.
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                if dec.args:
+                    inner = dotted(dec.args[0])
+                    if inner:
+                        fn.decorators.append(inner)
+                for kw in dec.keywords:
+                    if kw.arg == "donate_argnums":
+                        fn.donate_decorator = _literal_int_tuple(kw.value)
+        self.facts.functions[qual] = fn
+        self.fn_stack.append(fn)
+        self.tainted_stack.append({})
+        saved_loops = self.loop_stack
+        self.loop_stack = []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_stack = saved_loops
+        self.tainted_stack.pop()
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node):
+        # Lambdas stay anonymous: record their body's calls against the
+        # enclosing function (they execute in its dynamic extent).
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        bases = [d for d in (dotted(b) for b in node.bases) if d]
+        self.cls_stack.append(node.name)
+        self.facts.classes[node.name] = {"bases": bases, "methods": {}}
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.facts.classes[node.name]["methods"][stmt.name] = \
+                    self._qual(stmt.name)
+            self.visit(stmt)
+        self.cls_stack.pop()
+
+    # -- imports -------------------------------------------------------------
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.facts.imports[bound] = {"kind": "module", "target": target,
+                                         "attr": None}
+            self.names_used.add(bound)
+
+    def visit_ImportFrom(self, node):
+        # Import-only references still count as "referenced by name" —
+        # a parity test importing a kernel names it.
+        for alias in node.names:
+            self.names_used.add(alias.asname or alias.name)
+            self.names_used.add(alias.name)
+        if node.module is None or node.level:
+            return  # relative imports: out of heuristic resolution scope
+        for alias in node.names:
+            bound = alias.asname or alias.name
+            self.facts.imports[bound] = {
+                "kind": "object", "target": node.module, "attr": alias.name,
+            }
+
+    # -- loops ---------------------------------------------------------------
+
+    def _iter_is_window(self, node: ast.For) -> bool:
+        it = node.iter
+        if isinstance(it, ast.Call):
+            d = dotted(it.func)
+            if d and d.split(".")[-1] in WINDOW_ITER_CALLEES:
+                return True
+        targets = []
+        t = node.target
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                targets.append(n.id)
+        return any(t in WINDOW_TARGET_NAMES for t in targets)
+
+    def visit_For(self, node):
+        window = self._iter_is_window(node)
+        span = (node.lineno, node.end_lineno or node.lineno)
+        self.fn.loops.append(span)
+        if window:
+            self.fn.window_loops.append(span)
+        self.visit(node.iter)
+        # Loop indices over runtime collections are data-dependent ints.
+        if self.tainted_stack and isinstance(node.iter, ast.Call):
+            d = dotted(node.iter.func)
+            if d in ("range", "enumerate"):
+                why = any(self._taints(a) for a in node.iter.args)
+                if d == "enumerate" or why:
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            self.tainted_stack[-1][n.id] = (
+                                f"loop index `{n.id}`")
+                            break  # first target only (the index)
+        self.visit(node.target)
+        self.loop_stack.append((span[0], span[1], window))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node):
+        span = (node.lineno, node.end_lineno or node.lineno)
+        self.fn.loops.append(span)
+        self.visit(node.test)
+        self.loop_stack.append((span[0], span[1], False))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    # -- assignments (taint) -------------------------------------------------
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self._record_store_taint(t, node.value)
+            self.visit(t)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        if self.tainted_stack and isinstance(node.target, ast.Name):
+            why = self._taints(node.value)
+            if why:
+                self.tainted_stack[-1][node.target.id] = why
+        self.visit(node.target)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._record_store_taint(node.target, node.value)
+        self.visit(node.target)
+
+    # -- names ---------------------------------------------------------------
+
+    def visit_Name(self, node):
+        self.names_used.add(node.id)
+        book = self.fn.loads if isinstance(node.ctx, ast.Load) else \
+            self.fn.stores
+        book.setdefault(node.id, []).append(node.lineno)
+
+    def visit_Attribute(self, node):
+        self.names_used.add(node.attr)
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------------
+
+    def _arg_name(self, node: ast.AST) -> Optional[str]:
+        return dotted(node)
+
+    def visit_Call(self, node):
+        d = dotted(node.func)
+        if d is None and isinstance(node.func, ast.Attribute):
+            d = "." + node.func.attr      # method on a non-name expression
+        if d is None and isinstance(node.func, ast.Call):
+            # jax.jit(f, donate_argnums=…)(x): record the OUTER call as a
+            # donating call on the inner jit's wrapped function.
+            inner = node.func
+            idott = dotted(inner.func)
+            donate = None
+            for kw in inner.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _literal_int_tuple(kw.value)
+            if idott and donate is not None:
+                self.fn.calls.append(CallFact(
+                    target=idott + "()", lineno=node.lineno,
+                    end_lineno=node.end_lineno or node.lineno,
+                    args=[self._arg_name(a) for a in node.args],
+                    kw_args={kw.arg: self._arg_name(kw.value)
+                             for kw in node.keywords if kw.arg},
+                    donate=donate, in_window_loop=self._in_window_loop(),
+                ))
+        if d is not None:
+            donate = None
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _literal_int_tuple(kw.value)
+            self.fn.calls.append(CallFact(
+                target=d, lineno=node.lineno,
+                end_lineno=node.end_lineno or node.lineno,
+                args=[self._arg_name(a) for a in node.args],
+                kw_args={kw.arg: self._arg_name(kw.value)
+                         for kw in node.keywords if kw.arg},
+                donate=donate, in_window_loop=self._in_window_loop(),
+            ))
+        self._check_eager_jnp(node)
+        self._check_shape_sink(node, d)
+        self.generic_visit(node)
+
+    def _check_eager_jnp(self, node: ast.Call):
+        attr = self.b.jnp_call(node.func)
+        if attr is None:
+            return
+        term = attr.split(".")[-1]
+        if term in JNP_SHIP_ATTRS or term in JNP_META_ATTRS:
+            return
+        self.fn.eager_jnp.append({
+            "attr": attr, "lineno": node.lineno,
+            "end_lineno": node.end_lineno or node.lineno,
+            "expr": ast.unparse(node.func),
+            "in_window_loop": self._in_window_loop(),
+        })
+
+    def _check_shape_sink(self, node: ast.Call, d: Optional[str]):
+        """Device-shape sinks fed by a data-dependent Python int."""
+        jattr = self.b.jnp_call(node.func)
+        why = None
+        desc = None
+        if jattr in JNP_SHAPE_SINKS and node.args:
+            why = self._taints(node.args[0])
+            desc = f"`{ast.unparse(node.func)}(…)` dimension"
+        elif d and d.split(".")[-1] == "pad_to_bucket" and len(node.args) >= 2:
+            # The one shape that ALWAYS reaches the device. A host-side
+            # numpy stage (np.zeros(n)/.reshape(n, …) later padded) is
+            # deliberately not a sink — only device shapes recompile.
+            why = self._taints(node.args[1])
+            desc = "`pad_to_bucket(…, bucket)` bucket"
+        if why:
+            self.fn.shape_sites.append({
+                "lineno": node.lineno,
+                "end_lineno": node.end_lineno or node.lineno,
+                "desc": desc, "src": why,
+                "in_window_loop": self._in_window_loop(),
+            })
+
+
+def is_test_relpath(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return parts[0] == "tests" or parts[-1].startswith("test_")
+
+
+def _prune_books(fn: FunctionFacts):
+    """Keep load/store lines only for names the donation-safety pass can
+    ever ask about — positional call arguments and names stored on a
+    donating-call line — so cache entries stay small."""
+    keep = set()
+    for call in fn.calls:
+        for a in call.args:
+            if a and "." not in a:
+                keep.add(a)
+        if call.donate is not None:
+            for name, lines in fn.stores.items():
+                if any(call.lineno <= ln <= call.end_lineno
+                       for ln in lines):
+                    keep.add(name)
+    fn.loads = {k: v for k, v in fn.loads.items() if k in keep}
+    fn.stores = {k: v for k, v in fn.stores.items() if k in keep}
+
+
+def extract_facts(relpath: str, tree: ast.AST, source: str,
+                  bindings: Optional[Bindings] = None) -> FileFacts:
+    """Extract the whole-program fact summary of one parsed file."""
+    facts = FileFacts(relpath=relpath, module=module_name_of(relpath))
+    b = bindings if bindings is not None else Bindings.scan(tree)
+    ex = _Extractor(facts, b)
+    ex.visit(tree)
+    # names_used feeds exactly one question — "does any test reference
+    # this kernel's name" (mesh-parity) — so only test files carry it.
+    facts.names_used = sorted(ex.names_used) if is_test_relpath(relpath) \
+        else []
+    for fn in facts.functions.values():
+        _prune_books(fn)
+    facts.pragmas = scan_pragmas(source)
+    return facts
+
+
+class Project:
+    """The whole-program view: FileFacts per project-relative path."""
+
+    def __init__(self, files: Optional[Dict[str, FileFacts]] = None):
+        self.files: Dict[str, FileFacts] = files or {}
+        self._by_module: Optional[Dict[str, FileFacts]] = None
+
+    def add(self, facts: FileFacts):
+        self.files[facts.relpath] = facts
+        self._by_module = None
+
+    def by_module(self) -> Dict[str, FileFacts]:
+        if self._by_module is None:
+            self._by_module = {f.module: f for f in self.files.values()}
+        return self._by_module
+
+    def test_files(self) -> List[FileFacts]:
+        return [f for rel, f in self.files.items() if is_test_relpath(rel)]
+
+    def iter_functions(self):
+        for rel, f in self.files.items():
+            for fn in f.functions.values():
+                yield rel, f, fn
