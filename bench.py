@@ -155,6 +155,58 @@ def _load_last_good():
         return None
 
 
+def _seal_stream_supervisor(reason: str) -> None:
+    """Failure-path ledger-stream seal from the SUPERVISOR process.
+
+    The child owns the stream (telemetry writes it), but on the
+    deadline/SIGTERM/child-crash paths the child died without its
+    epilogue. The stream is plain JSONL, so the supervisor — which never
+    imports jax — can append the sealing epilogue itself, turning an
+    abandoned stream into an attributable artifact (``sfprof recover``
+    reports the termination reason instead of guessing). Skips cleanly
+    when no stream was configured/created or the child already sealed."""
+    import os
+    import time
+
+    path = os.environ.get("SFT_LEDGER_STREAM")
+    if not path or not os.path.exists(path):
+        return
+    try:
+        # Tail big enough to hold any single record (epilogues carry the
+        # bench record + SLO verdict; checkpoints the kernel table) — a
+        # 4 KiB peek once started MID-epilogue and double-sealed.
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - (4 << 20), 0))
+            tail = f.read()
+        # Walk complete tail lines newest-first; the first one that
+        # parses tells us whether the child already sealed (the chunk
+        # boundary may cut the oldest line — parse failures there are
+        # expected and skipped).
+        for line in reversed(tail.splitlines()):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # half-written tail / chunk-boundary fragment
+            if isinstance(rec, dict) and rec.get("t") == "epilogue":
+                return  # the child sealed it before dying
+            break  # newest parseable record is not an epilogue: seal
+        with open(path, "ab") as f:
+            lead = b"" if tail.endswith(b"\n") or not tail else b"\n"
+            # The leading newline terminates a half-written last line so
+            # the epilogue starts on its own line (recover scans past
+            # the corrupt fragment and still honors this seal).
+            f.write(lead + json.dumps({
+                "t": "epilogue", "unix": time.time(),
+                "reason": str(reason), "sealed_by": "supervisor",
+            }).encode() + b"\n")
+    except OSError as e:  # pragma: no cover - fs trouble is non-fatal
+        sys.stderr.write(f"ledger stream not sealed: {e}\n")
+
+
 def _supervise() -> None:
     """Retry-with-backoff around the real benchmark: a down tunnel hangs
     device init in an unkillable C call, so each dial attempt is a FRESH
@@ -233,6 +285,10 @@ def _supervise() -> None:
         if state["done"]:  # the one-line contract: never print twice
             return
         state["done"] = True
+        # Seal BEFORE printing: the driver may kill us the instant the
+        # line lands, and the epilogue is what makes the dead child's
+        # stream recoverable with an honest termination reason.
+        _seal_stream_supervisor(error)
         print(json.dumps(final_record(error)))
         sys.stdout.flush()
 
@@ -386,15 +442,39 @@ def main() -> None:
         WINDOW, SLIDE, N_WINDOWS = 4_096, 2_048, 8
         NUM_SEGMENTS, RADIUS, CAND = 512, 0.5, 256
 
-    from spatialflink_tpu.telemetry import instrument_jit, telemetry
+    from spatialflink_tpu.telemetry import (
+        LinkProbe,
+        instrument_jit,
+        telemetry,
+    )
 
     # Runtime telemetry rides the measured run: recompile detection on the
     # jitted steps, host→device bytes at the staging device_puts,
     # device→host bytes + true-sync timing at the fetches the loops
     # already do (zero extra round trips), window latency from the
     # latency-probe spans. Summary lands in the JSON line's "telemetry"
-    # block; SFT_TRACE_PATH additionally captures a Chrome-trace file.
-    telemetry.enable(trace_path=_os.environ.get("SFT_TRACE_PATH"))
+    # block; SFT_TRACE_PATH additionally captures a Chrome-trace file;
+    # SFT_LEDGER_STREAM makes the capture incrementally durable (JSONL
+    # checkpoints at window/phase boundaries — a SIGKILL mid-run loses at
+    # most one flush interval; `sfprof recover` rebuilds the ledger).
+    telemetry.enable(
+        trace_path=_os.environ.get("SFT_TRACE_PATH"),
+        stream_path=_os.environ.get("SFT_LEDGER_STREAM"),
+    )
+
+    # Live SLO gating (SFT_SLO_SPEC=<spec.json>): the declarative spec is
+    # evaluated incrementally as probe windows fire; violations become
+    # slo_violation:* events in the trace/stream and the verdict block
+    # rides the record + ledger. `sfprof health --slo` applies the SAME
+    # spec post-hoc.
+    slo_engine = None
+    _spec_path = _os.environ.get("SFT_SLO_SPEC")
+    if _spec_path:
+        from spatialflink_tpu import slo as slo_mod
+
+        slo_engine = slo_mod.install(
+            slo_mod.SloEngine(slo_mod.SloSpec.from_file(_spec_path))
+        )
 
     grid = UniformGrid(**BEIJING_GRID_ARGS)
     wf = WireFormat.for_grid(grid)
@@ -444,6 +524,20 @@ def main() -> None:
     seg0, rep0, warm = jstep(empty_seg, empty_rep, slide_wire(0), q_d)
     jax.device_get(warm.num_valid)  # true sync (block_until_ready is a
     # no-op on the axon tunnel)
+
+    # Link-health probe: tiny fixed-shape round trips at PHASE BOUNDARIES
+    # only (never inside a window span), so "chip slow" and "tunnel
+    # degraded" are distinguishable in the record — the gauges land in
+    # the telemetry snapshot and the JSON line's "link_probe" block, and
+    # `sfprof diff` annotates (never widens) its bands with them.
+    probe = None
+    if not _os.environ.get("SFT_NO_LINK_PROBE"):
+        probe = LinkProbe(dev)
+        probe.sample()
+    # Phase boundary: warm-up done — checkpoint the ledger stream now so
+    # a crash during the throughput loops already has a recoverable
+    # prefix (the SIGKILL chaos test kills right after this point).
+    telemetry.maybe_flush_stream(force=True)
 
     import contextlib
     import os as _os
@@ -511,8 +605,26 @@ def main() -> None:
         results = [int(v) for v in telemetry.fetch(fired)]
         return time.perf_counter() - t0, results
 
+    if slo_engine is not None:
+        # Start the engine's EPS clock NOW: the first real feed happens
+        # after run 1 completes, and crediting run 1's points without
+        # run 1's elapsed time would inflate live EPS ~25% (an
+        # eps_floor gate that under-gates is worse than none).
+        slo_engine.observe_window(0)
     with trace_ctx:
-        runs = [timed_run() for _ in range(5)]
+        runs = []
+        for _ in range(5):
+            runs.append(timed_run())
+            # Between timed runs = a phase boundary: probe the link and
+            # feed the SLO engine the windows that just fired (outside
+            # the timed region — the engine's counters are host-cheap
+            # but the EPS floor must see real points).
+            if probe is not None:
+                probe.sample()
+            if slo_engine is not None:
+                for _ in range(N_WINDOWS):
+                    slo_engine.observe_window(SLIDE, lag_ms=0.0)
+    telemetry.maybe_flush_stream(force=True)
     t_total = float(np.median([t for t, _ in runs]))
     results = runs[-1][1]
 
@@ -548,6 +660,15 @@ def main() -> None:
                 nv = jax.device_get(res.num_valid)
                 latencies.append(time.perf_counter() - t0)
         telemetry.account_d2h(np.asarray(nv).nbytes)
+        if slo_engine is not None:
+            # Outside the window span, after the clock stopped: the
+            # bench's synthetic stream is in order, so lag is 0 — the
+            # engine still sees every probe window for its EPS/budget
+            # checks.
+            slo_engine.observe_window(SLIDE, lag_ms=0.0)
+    if probe is not None:
+        probe.sample()  # phase boundary: latency probe done
+    telemetry.maybe_flush_stream(force=True)
 
     # ---- Device-resident throughput: ingest off the critical path. ----
     # Slides 1..N stay staged in HBM (60 MB of wire records); one
@@ -596,6 +717,9 @@ def main() -> None:
         return time.perf_counter() - t0, all_out
 
     res_runs = [resident_run() for _ in range(5)]
+    if probe is not None:
+        probe.sample()  # phase boundary: resident loops done
+    telemetry.maybe_flush_stream(force=True)
     t_res = float(np.median([t for t, _ in res_runs]))
     resident_pps = passes * N_WINDOWS * SLIDE / t_res
     for _, all_out in res_runs[-1:]:
@@ -635,6 +759,14 @@ def main() -> None:
         # the bench's synthetic stream is in order by construction).
         "telemetry": telemetry.summary(),
     }
+    # Measured link health at the record's phase boundaries: lets the
+    # reader (and sfprof diff) separate "tunnel degraded" from "chip
+    # slow" instead of blaming the ±50% band blindly.
+    link = telemetry.link_gauges()
+    if link:
+        out["link_probe"] = link
+    if slo_engine is not None:
+        out["slo"] = slo_engine.verdict()
     if smoke:
         out["smoke"] = True
     # Measured CPU-backend throughput of the same fused program on this
@@ -671,6 +803,9 @@ def main() -> None:
             telemetry.write_ledger(ledger_path, bench=out)
         except Exception as e:
             sys.stderr.write(f"ledger not written: {e!r}\n")
+    # A run with only a stream (no SFT_LEDGER_PATH) still seals cleanly;
+    # no-op when write_ledger above already sealed it.
+    telemetry.seal_stream("complete", bench=out)
 
 
 if __name__ == "__main__":

@@ -1276,9 +1276,14 @@ def main():
             # (re-)enabled around each config so every ledger carries
             # exactly that config's spans/kernel table/byte tallies,
             # plus the config's own result record as the bench block.
+            # Each config also streams to <name>.stream.jsonl — a
+            # multi-hour suite run killed mid-config keeps every
+            # finished config's ledger AND a recoverable prefix of the
+            # one in flight (`sfprof recover`).
             from spatialflink_tpu.telemetry import telemetry
 
-            telemetry.enable()
+            telemetry.enable(stream_path=os.path.join(
+                ledger_dir, f"{name}.stream.jsonl"))
             res = fn()
             try:
                 telemetry.write_ledger(

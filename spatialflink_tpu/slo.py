@@ -1,0 +1,290 @@
+"""Online SLO engine — declarative per-run objectives evaluated WHILE
+the stream runs, not after it dies.
+
+The observability stack so far is post-hoc: sfprof renders verdicts from
+a ledger written at run end (and the r3–r5 chip captures showed what
+that costs when the run doesn't reach its end). This module inverts it:
+a declarative :class:`SloSpec` — watermark-lag p99 freshness ceiling,
+EPS floor, late-drop/overflow budgets, recompile ceiling (the
+"per-query freshness SLOs" of ROADMAP item 5) — is evaluated
+incrementally from telemetry gauge deltas as windows fire. Violations
+become structured ``slo_violation:*`` instant events in the trace and
+ledger stream (flushed immediately — a violation is exactly what must
+survive a crash) plus a verdict block in the ledger, and ``python -m
+tools.sfprof health --slo <spec>`` applies the SAME spec post-hoc, so
+one JSON file gates both the live run and the recovered artifact.
+
+Wiring follows the telemetry idiom: a module-level engine slot,
+``install()`` to opt in, and a free-when-disabled hook
+(:func:`on_window_fired`) at the window-fire sites where
+``record_watermark_lag`` already lives (streams/windows.py,
+streams/soa.py) — one global read + None check per fired window while
+no engine is installed.
+
+Spec schema twin: ``tools/sfcheck``-style no-cross-import rule — the
+validator-side mirror lives in ``tools/sfprof/slo.py`` (same
+``SLO_VERSION``, same field names; tests/test_slo.py cross-pins them).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Dict, List, Optional
+
+from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
+from spatialflink_tpu.telemetry import telemetry
+
+#: Spec schema version. Twin: tools/sfprof/slo.py:SLO_VERSION.
+SLO_VERSION = 1
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """Declarative SLO thresholds; ``None`` means unchecked.
+
+    - ``watermark_lag_p99_ms``: freshness — p99 of the event-time lag
+      between a window's end and the watermark that fired it;
+    - ``eps_floor``: sustained events/sec over the run so far (checked
+      only after ``warmup_windows`` fired windows — the first windows
+      pay XLA compiles);
+    - ``late_drop_budget`` / ``overflow_budget``: counter ceilings (ANY
+      excess violates);
+    - ``recompile_ceiling``: total distinct-signature compiles — bucket
+      ladders are bounded, churn is not;
+    - ``eval_interval_s``: pacing of the incremental evaluation (the
+      per-window cost between evaluations is counter updates only).
+    """
+
+    name: str = "default"
+    watermark_lag_p99_ms: Optional[float] = None
+    eps_floor: Optional[float] = None
+    late_drop_budget: Optional[int] = None
+    overflow_budget: Optional[int] = None
+    recompile_ceiling: Optional[int] = None
+    eval_interval_s: float = 1.0
+    warmup_windows: int = 8
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SloSpec":
+        """Strict parse: an unknown key is a spec typo, and a typo'd
+        threshold silently unchecked is the worst failure mode a gate can
+        have — raise instead. ``slo_version`` (when present) must match."""
+        d = dict(d)
+        ver = d.pop("slo_version", SLO_VERSION)
+        if ver != SLO_VERSION:
+            raise ValueError(
+                f"slo_version {ver} != supported {SLO_VERSION}"
+            )
+        known = {f.name for f in fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise ValueError(f"unknown SLO spec keys: {unknown}")
+        return cls(**d)
+
+    @classmethod
+    def from_file(cls, path: str) -> "SloSpec":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"slo_version": SLO_VERSION}
+        for f in fields(self):
+            out[f.name] = getattr(self, f.name)
+        return out
+
+
+def _find_overflows(value, out: List[int]):
+    """Sum every numeric counter whose key mentions ``overflow`` — the
+    same substring contract ``sfprof health`` applies to ledgers."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if ("overflow" in str(k)
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                out.append(int(v))
+            else:
+                _find_overflows(v, out)
+
+
+class SloEngine:
+    """Incremental evaluator of one :class:`SloSpec` against the live
+    telemetry gauges.
+
+    ``observe_window`` is the per-window hook: counter updates under a
+    lock, and — at most every ``eval_interval_s`` — a full check pass.
+    Each check TRANSITION into violation appends a violation record and
+    emits a ``slo_violation:<check>`` instant event (stream-flushed
+    immediately); recovery transitions emit ``slo_recovered:<check>``
+    without clearing the recorded violation — the verdict is about the
+    run, not the final second."""
+
+    def __init__(self, spec: SloSpec, tel=telemetry):
+        self.spec = spec
+        self.tel = tel
+        self._lock = threading.Lock()
+        self.windows = 0
+        self.points = 0
+        self.evaluations = 0
+        self.violations: List[dict] = []
+        self.lag = FixedBucketLatency()
+        self._violated: Dict[str, bool] = {}
+        self._last_checks: List[dict] = []
+        # EPS clock starts at the FIRST fired window, not at engine
+        # construction: install() happens before warm-up (XLA compiles,
+        # probe samples), and a floor calibrated from bench throughput
+        # would spuriously violate if that dead time counted as elapsed.
+        self._t0: Optional[float] = None
+        self._last_eval = time.monotonic()
+
+    # -- per-window hook -------------------------------------------------------
+
+    def observe_window(self, n_events: int = 0,
+                       lag_ms: Optional[float] = None):
+        now = time.monotonic()
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            self.windows += 1
+            self.points += int(n_events)
+            if lag_ms is not None:
+                self.lag.observe(float(lag_ms))
+            due = now - self._last_eval >= self.spec.eval_interval_s
+            if due:
+                self._last_eval = now
+        if due:
+            self.evaluate()
+
+    # -- evaluation ------------------------------------------------------------
+
+    def _checks(self) -> List[dict]:
+        sp = self.spec
+        out: List[dict] = []
+
+        def check(name, value, bound, ok):
+            out.append({"check": name, "value": json_safe(value),
+                        "bound": bound, "ok": bool(ok)})
+
+        with self._lock:
+            windows, points = self.windows, self.points
+            t0 = self._t0
+            lag_count = self.lag.count
+            lag_p99 = self.lag.percentile(0.99) if lag_count else None
+        if sp.watermark_lag_p99_ms is not None and lag_p99 is not None:
+            check("watermark_lag_p99_ms", lag_p99,
+                  f"<= {float(sp.watermark_lag_p99_ms):g}",
+                  lag_p99 <= sp.watermark_lag_p99_ms)
+        if sp.eps_floor is not None and t0 is not None \
+                and windows > sp.warmup_windows:
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            eps = points / elapsed
+            check("eps_floor", eps, f">= {float(sp.eps_floor):g}",
+                  eps >= sp.eps_floor)
+        if sp.late_drop_budget is not None:
+            late = self.tel.late_drops
+            check("late_drop_budget", late,
+                  f"<= {int(sp.late_drop_budget)}",
+                  late <= sp.late_drop_budget)
+        if sp.recompile_ceiling is not None:
+            compiles = self.tel.compile_count
+            check("recompile_ceiling", compiles,
+                  f"<= {int(sp.recompile_ceiling)}",
+                  compiles <= sp.recompile_ceiling)
+        if sp.overflow_budget is not None:
+            counts: List[int] = []
+            _find_overflows(self.tel.snapshot(), counts)
+            total = sum(counts)
+            check("overflow_budget", total,
+                  f"<= {int(sp.overflow_budget)}",
+                  total <= sp.overflow_budget)
+        return out
+
+    def evaluate(self) -> List[dict]:
+        """One full check pass; returns the check rows. Violation events
+        are emitted on TRANSITIONS only (a stall that lasts a thousand
+        windows is one violation, not a thousand)."""
+        rows = self._checks()
+        transitions = []
+        with self._lock:
+            self.evaluations += 1
+            self._last_checks = rows
+            for row in rows:
+                was = self._violated.get(row["check"], False)
+                now_bad = not row["ok"]
+                self._violated[row["check"]] = now_bad
+                if now_bad and not was:
+                    rec = {
+                        "check": row["check"], "value": row["value"],
+                        "bound": row["bound"], "unix": time.time(),
+                        "window_seq": self.windows,
+                    }
+                    self.violations.append(rec)
+                    transitions.append(("slo_violation", rec))
+                elif was and not now_bad:
+                    transitions.append(("slo_recovered", {
+                        "check": row["check"], "value": row["value"],
+                        "bound": row["bound"], "unix": time.time(),
+                        "window_seq": self.windows,
+                    }))
+        for kind, rec in transitions:
+            self.tel.emit_instant(f"{kind}:{rec['check']}",
+                                  value=rec["value"], bound=rec["bound"],
+                                  window_seq=rec["window_seq"])
+        if any(kind == "slo_violation" for kind, _ in transitions):
+            # A violation is exactly the record that must survive the
+            # run dying right after it — force the stream segment out.
+            self.tel.maybe_flush_stream(force=True)
+        return rows
+
+    def verdict(self) -> Dict[str, Any]:
+        """The ledger/epilogue block: spec, final check states (one last
+        evaluation), every violation recorded over the run, and the
+        boolean gate (``ok`` == zero violations EVER)."""
+        rows = self.evaluate()
+        with self._lock:
+            return json_safe({
+                "slo_version": SLO_VERSION,
+                "spec": self.spec.to_dict(),
+                "ok": not self.violations,
+                "windows": self.windows,
+                "points": self.points,
+                "evaluations": self.evaluations,
+                "checks": rows,
+                "violations": list(self.violations),
+            })
+
+
+# -- module-level wiring (the telemetry singleton idiom) -----------------------
+
+_engine: Optional[SloEngine] = None
+
+
+def install(engine: SloEngine) -> SloEngine:
+    """Make ``engine`` the process-global SLO engine: window-fire sites
+    start feeding it, and ``telemetry.write_ledger``/``seal_stream``
+    embed its verdict."""
+    global _engine
+    _engine = engine
+    engine.tel.slo_provider = engine.verdict
+    return engine
+
+
+def uninstall():
+    global _engine
+    if _engine is not None:
+        _engine.tel.slo_provider = None
+    _engine = None
+
+
+def engine() -> Optional[SloEngine]:
+    return _engine
+
+
+def on_window_fired(n_events: int = 0, lag_ms: Optional[float] = None):
+    """The window-fire hook (streams/windows.py, streams/soa.py): free
+    when no engine is installed — one global read and a None check."""
+    eng = _engine
+    if eng is not None:
+        eng.observe_window(n_events, lag_ms)

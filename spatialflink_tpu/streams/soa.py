@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from spatialflink_tpu import slo
 from spatialflink_tpu.telemetry import telemetry
 
 
@@ -115,8 +116,10 @@ class _SlidingAssemblerBase:
                 out.append(self._window(s, e, lo, hi))
                 if record_lag:
                     # Event-time ms between window end and the watermark
-                    # that fired it.
+                    # that fired it. The SLO hook rides the same fire
+                    # site (free when no engine is installed).
                     telemetry.record_watermark_lag(wm - e)
+                    slo.on_window_fired(hi - lo, lag_ms=wm - e)
                 self._next_start += self.slide
             elif lo < len(ts):
                 # Empty window: fast-forward to the earliest window holding

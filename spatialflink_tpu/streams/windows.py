@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Iterable, Iterator, List, Optional, Tuple, TypeVar
 
+from spatialflink_tpu import slo
 from spatialflink_tpu.telemetry import telemetry
 
 T = TypeVar("T")
@@ -165,8 +166,12 @@ class WindowAssembler(Generic[T]):
                 fired.append(WindowBatch(spec.start, spec.end, list(self._buffers[spec])))
                 self._fired[spec] = True
                 # Watermark lag: event-time ms between window end and the
-                # watermark that fired it (how late the firing was).
+                # watermark that fired it (how late the firing was). The
+                # SLO hook rides the same fire site (free when no engine
+                # is installed).
                 telemetry.record_watermark_lag(wm - spec.end)
+                slo.on_window_fired(len(self._buffers[spec]),
+                                    lag_ms=wm - spec.end)
         # Garbage-collect windows past the lateness horizon. The fired-flag
         # entry goes too: re-entry of a GC'd window is already blocked by the
         # spec.end + lateness <= wm check in feed(), and keeping the flags

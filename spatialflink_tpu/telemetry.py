@@ -59,6 +59,39 @@ from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 #: (tests/test_sfprof.py cross-pins them).
 LEDGER_VERSION = 1
 
+#: Ledger-STREAM record-layout version (the JSONL segment format behind
+#: ``SFT_LEDGER_STREAM``). Twin constant: tools/sfprof/stream.py:
+#: STREAM_VERSION — same no-cross-import rule, same cross-pin test.
+STREAM_VERSION = 1
+
+
+def _sanitize_nonfinite(value):
+    """(sanitized, count): every non-finite float (NaN/±Inf) anywhere in
+    the structure becomes ``None``, counted. A NaN at the very END of a
+    run used to raise out of ``write_ledger`` (``allow_nan=False``) and
+    lose the whole capture — sanitize-and-count keeps the artifact and
+    makes the corruption visible (``nonfinite_values`` field) instead."""
+    if isinstance(value, float):
+        if value != value or value in (float("inf"), float("-inf")):
+            return None, 1
+        return value, 0
+    if isinstance(value, dict):
+        n = 0
+        out = {}
+        for k, v in value.items():
+            out[k], dn = _sanitize_nonfinite(v)
+            n += dn
+        return out, n
+    if isinstance(value, (list, tuple)):
+        n = 0
+        out = []
+        for v in value:
+            sv, dn = _sanitize_nonfinite(v)
+            out.append(sv)
+            n += dn
+        return out, n
+    return value, 0
+
 
 class RecompileWarning(UserWarning):
     """One kernel crossed the distinct-abstract-shape threshold — bucket
@@ -156,6 +189,17 @@ class Telemetry:
         self.recompile_warn_threshold = 8
         self.trace_path: Optional[str] = None
         self._trace_file = None
+        # Append-only ledger stream (SFT_LEDGER_STREAM): JSONL segments —
+        # versioned prologue, window-boundary checkpoint/span-batch
+        # flushes, sealing epilogue. tools/sfprof recover rebuilds a
+        # gateable ledger from a truncated stream.
+        self.stream_path: Optional[str] = None
+        self._stream_file = None
+        self._stream_sealed = False
+        self.stream_flush_interval_s = 1.0
+        # Optional verdict callback installed by slo.install(): called at
+        # ledger-write/seal time to embed the live SLO verdict block.
+        self.slo_provider = None
         self._lock = threading.RLock()
         self._reset_state()
 
@@ -173,6 +217,20 @@ class Telemetry:
         self.max_watermark_lag_ms = 0
         self.late_drops = 0
         self.window_latency = FixedBucketLatency()
+        # Watermark-lag distribution (not just the max): the SLO engine's
+        # p99-freshness checks and the ledger's watermark_lag_p99_ms ride
+        # this histogram.
+        self.watermark_lag = FixedBucketLatency()
+        # Link-probe rolling samples (LinkProbe.sample → record_link_sample):
+        # bounded; snapshot() exports p50/last gauges.
+        self._link_samples: list = []
+        # Ledger-stream bookkeeping: events since the last stream flush,
+        # monotonically increasing segment seq, flush pacing clock, and
+        # the running count of sanitized non-finite values.
+        self._stream_pending: list = []
+        self._stream_seq = 0
+        self._stream_last_flush = time.monotonic()
+        self.nonfinite_values = 0
         # engine → {capacity bucket → {"picks", "max_live"}} — the
         # compaction control plane's pick log (ops/compaction.py).
         self._compaction: Dict[str, Dict[int, Dict[str, int]]] = {}
@@ -186,15 +244,51 @@ class Telemetry:
     # -- lifecycle ------------------------------------------------------------
 
     def enable(self, trace_path: Optional[str] = None,
-               recompile_warn_threshold: int = 8):
+               recompile_warn_threshold: int = 8,
+               stream_path: Optional[str] = None,
+               stream_flush_interval_s: Optional[float] = None):
         """Reset all state and start recording. ``trace_path``: optional
         Chrome-trace JSON-lines file (events also buffer in memory, capped
-        at ``max_events``)."""
+        at ``max_events``). ``stream_path``: optional append-only ledger
+        stream (JSONL) — a versioned prologue now, checkpoint + span-batch
+        segments at window boundaries (paced by
+        ``stream_flush_interval_s``, default 1 s or the
+        ``SFT_LEDGER_STREAM_INTERVAL_S`` env), a sealing epilogue at
+        ``write_ledger``/``disable``. A run killed mid-stream loses at
+        most one flush interval; ``tools/sfprof recover`` rebuilds the
+        ledger from the truncated stream."""
         with self._lock:
             self.disable()
             self._reset_state()
             self.recompile_warn_threshold = int(recompile_warn_threshold)
             self.trace_path = trace_path
+            self.stream_path = stream_path
+            self._stream_sealed = False
+            if stream_path:
+                if stream_flush_interval_s is None:
+                    stream_flush_interval_s = float(os.environ.get(
+                        "SFT_LEDGER_STREAM_INTERVAL_S", "1.0"))
+                self.stream_flush_interval_s = float(stream_flush_interval_s)
+                d = os.path.dirname(os.path.abspath(stream_path))
+                os.makedirs(d, exist_ok=True)
+                self._stream_file = open(stream_path, "w")
+                # Prologue env is deliberately jax-free: enable() must
+                # not import jax (bench enables before the backend is
+                # settled in some paths); the full env block rides the
+                # epilogue's ledger / the recovered document notes the
+                # difference.
+                self._write_stream({
+                    "t": "prologue",
+                    "stream_version": STREAM_VERSION,
+                    "ledger_version": LEDGER_VERSION,
+                    "created_unix": time.time(),
+                    "env": {
+                        "python": sys.version.split()[0],
+                        "pid": os.getpid(),
+                        "argv0": os.path.basename(sys.argv[0] or "python"),
+                    },
+                })
+                self._stream_file.flush()
             if trace_path:
                 d = os.path.dirname(os.path.abspath(trace_path))
                 os.makedirs(d, exist_ok=True)
@@ -212,10 +306,18 @@ class Telemetry:
             self.enabled = True
 
     def disable(self):
+        """Stop recording and SEAL both sinks: the ledger stream gets its
+        epilogue (a disable() with no ``write_ledger`` used to leave the
+        stream unsealed — indistinguishable from a crash), and the trace
+        file is explicitly flushed before close so a mid-run disable can
+        never strand ``_since_flush`` buffered events."""
         with self._lock:
             self.enabled = False
+            self.seal_stream("disabled")
             if self._trace_file is not None:
-                self._trace_file.close()  # close flushes buffered events
+                self._trace_file.flush()
+                self._since_flush = 0
+                self._trace_file.close()
                 self._trace_file = None
 
     FLUSH_EVERY = 256
@@ -240,6 +342,82 @@ class Telemetry:
         if self._since_flush >= self.FLUSH_EVERY:
             self._trace_file.flush()
             self._since_flush = 0
+
+    # -- ledger stream ---------------------------------------------------------
+
+    def _write_stream(self, record: dict):
+        """One JSONL stream record (caller holds the lock). Non-finite
+        floats are sanitized to null and counted — a strict-JSON raise
+        here would lose the stream's whole point (crash resilience)."""
+        record, n = _sanitize_nonfinite(json_safe(record))
+        if n:
+            self.nonfinite_values += n
+        self._stream_file.write(json.dumps(record, allow_nan=False) + "\n")
+
+    def maybe_flush_stream(self, force: bool = False):
+        """Window-boundary stream flush: a span batch (events since the
+        last flush) + a full checkpoint (snapshot + kernel table), paced
+        by ``stream_flush_interval_s`` so the disk work stays off the
+        per-window hot path. ``force=True`` flushes regardless — phase
+        boundaries and SLO violations use it."""
+        with self._lock:
+            if self._stream_file is None or self._stream_sealed:
+                return
+            now = time.monotonic()
+            if (not force and now - self._stream_last_flush
+                    < self.stream_flush_interval_s):
+                return
+            self._stream_last_flush = now
+            self._flush_stream_locked()
+
+    def _flush_stream_locked(self):
+        self._stream_seq += 1
+        seq = self._stream_seq
+        if self._stream_pending:
+            self._write_stream({
+                "t": "spans", "seq": seq, "events": self._stream_pending,
+            })
+            self._stream_pending = []
+        ck = {
+            "t": "checkpoint", "seq": seq, "unix": time.time(),
+            "snapshot": self.snapshot(), "kernels": self.kernel_table(),
+        }
+        if self.nonfinite_values:
+            ck["nonfinite_values"] = self.nonfinite_values
+        self._write_stream(ck)
+        self._stream_file.flush()
+
+    def seal_stream(self, reason: str, bench: Optional[dict] = None,
+                    slo: Optional[dict] = None):
+        """Terminal stream segment: final span batch + checkpoint, then
+        the epilogue carrying the termination ``reason`` (and the bench
+        record / SLO verdict when the run completed normally). Idempotent
+        — the first seal wins; later calls (e.g. ``disable()`` after
+        ``write_ledger``) are no-ops."""
+        with self._lock:
+            if self._stream_file is None or self._stream_sealed:
+                return
+            self._flush_stream_locked()
+            if slo is None and self.slo_provider is not None:
+                try:
+                    slo = self.slo_provider()
+                except Exception:  # a broken verdict must not block the seal
+                    slo = None
+            ep = {
+                "t": "epilogue", "seq": self._stream_seq,
+                "unix": time.time(), "reason": str(reason),
+            }
+            if bench is not None:
+                ep["bench"] = bench
+            if slo is not None:
+                ep["slo"] = slo
+            if self.nonfinite_values:
+                ep["nonfinite_values"] = self.nonfinite_values
+            self._write_stream(ep)
+            self._stream_file.flush()
+            self._stream_file.close()
+            self._stream_file = None
+            self._stream_sealed = True
 
     # -- spans ----------------------------------------------------------------
 
@@ -269,6 +447,22 @@ class Telemetry:
         if name.startswith("window"):
             with self._lock:
                 self.window_latency.observe(dur_ns / 1e6)
+            # Window boundary = the stream's flush point (interval-paced
+            # inside, so per-window cost is one clock read + a compare).
+            self.maybe_flush_stream()
+
+    def emit_instant(self, name: str, **args):
+        """Structured instant event (``ph:"i"``) into the buffer, trace
+        file, and ledger stream — the SLO engine's violation events and
+        any other out-of-band markers ride this."""
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "cat": "telemetry", "ph": "i",
+            "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
+            "tid": threading.get_ident(), "s": "t",
+            "args": json_safe(args),
+        })
 
     def _emit(self, event: dict):
         with self._lock:
@@ -276,6 +470,11 @@ class Telemetry:
                 self.events.append(event)
             else:
                 self.dropped_events += 1
+            if self._stream_file is not None and not self._stream_sealed:
+                # The stream keeps EVERY event (like the trace file): the
+                # max_events cap bounds memory, not the artifact; pending
+                # drains into a span batch at each stream flush.
+                self._stream_pending.append(event)
             if self._trace_file is not None:
                 tid = event.get("tid")
                 if tid is not None and tid not in self._named_tids:
@@ -492,9 +691,12 @@ class Telemetry:
         lazily here unless ``capture_costs=False``), the buffered span
         events (so ``tools/sfprof report`` can attribute phases without
         a separate trace file), and the caller's bench record. Strict
-        JSON (``allow_nan=False``) — a NaN/Inf anywhere is a bug and
-        raises rather than shipping an unparseable artifact. Consumed by
-        ``python -m tools.sfprof`` (report / diff --gate / health)."""
+        JSON (``allow_nan=False``) — but a NaN/Inf anywhere is sanitized
+        to null and COUNTED (``nonfinite_values``) rather than raised: a
+        raise at the very end of a run used to lose the whole capture.
+        Seals the ledger stream (``reason: complete``) when one is open.
+        Consumed by ``python -m tools.sfprof`` (report / diff --gate /
+        health)."""
         import jax
 
         if capture_costs:
@@ -514,6 +716,12 @@ class Telemetry:
                            for k, v in dict(mesh.shape).items()}
         with self._lock:
             events = list(self.events)
+        slo_block = None
+        if self.slo_provider is not None:
+            try:
+                slo_block = json_safe(self.slo_provider())
+            except Exception:  # a broken verdict must not block the ledger
+                slo_block = None
         doc = {
             "ledger_version": LEDGER_VERSION,
             "created_unix": time.time(),
@@ -523,11 +731,17 @@ class Telemetry:
             "events": events,
             "bench": json_safe(bench) if bench is not None else None,
         }
+        if slo_block is not None:
+            doc["slo"] = slo_block
+        doc, nonfinite = _sanitize_nonfinite(doc)
+        if nonfinite:
+            doc["nonfinite_values"] = nonfinite
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
             json.dump(doc, f, allow_nan=False)
             f.write("\n")
+        self.seal_stream("complete", bench=doc["bench"], slo=slo_block)
         return path
 
     # -- compaction bucket accounting -----------------------------------------
@@ -566,12 +780,63 @@ class Telemetry:
 
     def record_watermark_lag(self, lag_ms: int):
         """Event-time ms between a fired window's end and the watermark at
-        fire time — how late the window fired relative to its span."""
+        fire time — how late the window fired relative to its span. Feeds
+        both the max gauge and the lag histogram (the SLO engine's p99
+        freshness checks read the distribution, not just the worst case)."""
         if not self.enabled:
             return
         with self._lock:
+            self.watermark_lag.observe(float(lag_ms))
             if lag_ms > self.max_watermark_lag_ms:
                 self.max_watermark_lag_ms = int(lag_ms)
+
+    # -- link-health probe gauges ----------------------------------------------
+
+    LINK_SAMPLES_MAX = 256
+
+    def record_link_sample(self, latency_ms: float, roundtrip_mbps: float,
+                           payload_bytes: int):
+        """One LinkProbe round trip: rolling host↔device latency/bandwidth
+        gauges (bounded window), an instant trace event, and — because a
+        probe sample is exactly the moment to persist — a paced stream
+        flush."""
+        if not self.enabled:
+            return
+        sample = {
+            "unix": time.time(),
+            "latency_ms": float(latency_ms),
+            "roundtrip_mbps": float(roundtrip_mbps),
+            "payload_bytes": int(payload_bytes),
+        }
+        with self._lock:
+            self._link_samples.append(sample)
+            if len(self._link_samples) > self.LINK_SAMPLES_MAX:
+                del self._link_samples[0]
+        self.emit_instant("link_probe", latency_ms=float(latency_ms),
+                          roundtrip_mbps=float(roundtrip_mbps))
+        self.maybe_flush_stream()
+
+    def link_gauges(self) -> Optional[Dict[str, Any]]:
+        """Rolling link-health summary (None before the first sample):
+        sample count + p50/last latency and round-trip bandwidth. bench.py
+        stamps this into its record; ``sfprof diff`` uses it to ANNOTATE
+        (never widen) its tolerance bands — a degraded tunnel explains an
+        e2e EPS drop without excusing a device-resident one."""
+        with self._lock:
+            samples = list(self._link_samples)
+        if not samples:
+            return None
+        lat = sorted(s["latency_ms"] for s in samples)
+        bw = sorted(s["roundtrip_mbps"] for s in samples)
+        mid = len(samples) // 2
+        return json_safe({
+            "samples": len(samples),
+            "latency_ms_p50": lat[mid],
+            "latency_ms_last": samples[-1]["latency_ms"],
+            "roundtrip_mbps_p50": bw[mid],
+            "roundtrip_mbps_last": samples[-1]["roundtrip_mbps"],
+            "payload_bytes": samples[-1]["payload_bytes"],
+        })
 
     def record_late_drop(self, n: int = 1):
         if not self.enabled:
@@ -603,6 +868,7 @@ class Telemetry:
         with self._lock:
             p50 = self.window_latency.percentile(0.50)
             p95 = self.window_latency.percentile(0.95)
+            lag99 = self.watermark_lag.percentile(0.99)
             out = {
                 "compiles": len(self.compile_events),
                 "bytes_h2d": self.h2d_bytes,
@@ -610,6 +876,7 @@ class Telemetry:
                 "window_latency_p50_ms": None if p50 != p50 else p50,
                 "window_latency_p95_ms": None if p95 != p95 else p95,
                 "max_watermark_lag_ms": self.max_watermark_lag_ms,
+                "watermark_lag_p99_ms": None if lag99 != lag99 else lag99,
                 "late_dropped": self.late_drops,
             }
         return json_safe(out)
@@ -629,6 +896,9 @@ class Telemetry:
                     for eng, caps in self._compaction.items()
                 },
             )
+        link = self.link_gauges()
+        if link is not None:
+            out["link_probe"] = link
         return json_safe(out)
 
 
@@ -655,6 +925,59 @@ def write_ledger(path: str, bench: Optional[dict] = None, mesh=None,
                  capture_costs: bool = True) -> str:
     return telemetry.write_ledger(path, bench=bench, mesh=mesh,
                                   capture_costs=capture_costs)
+
+
+class LinkProbe:
+    """Tunnel/link-health probe: a tiny FIXED-SHAPE device round trip
+    measuring host↔device latency (8-float RTT) and bandwidth (one fixed
+    payload, default 256 KiB, shipped out and fetched back).
+
+    True sync is the ``jax.device_get`` — ``block_until_ready`` is a
+    NO-OP over the axon tunnel (CLAUDE.md), so the fetch IS the
+    measurement. The two transfer directions cannot be timed separately
+    over the tunnel (there is no honest put-only sync), so bandwidth is
+    reported as the ROUND-TRIP aggregate: ``2·payload/elapsed``.
+
+    Call ``sample()`` only at phase boundaries — never inside a window
+    span — so probe traffic lands in host gaps, not in measured windows.
+    Samples feed the rolling gauges in ``telemetry`` (snapshot's
+    ``link_probe`` block); bench.py stamps them into its record, and
+    ``sfprof diff`` annotates its verdicts with the link ratio so "chip
+    slow" is distinguishable from "tunnel degraded"."""
+
+    def __init__(self, device=None, payload_bytes: int = 262_144,
+                 tel: Optional[Telemetry] = None):
+        import numpy as np
+
+        self.device = device
+        self.payload_bytes = int(payload_bytes)
+        self._tel = tel
+        # Fixed shapes, allocated once: the probe must never cause an
+        # XLA compile (device_put/get are pure transfers) nor shape churn.
+        self._tiny = np.zeros(8, np.float32)
+        self._payload = np.zeros(max(self.payload_bytes // 4, 1),
+                                 np.float32)
+
+    def sample(self) -> Dict[str, float]:
+        """One probe round trip; records into the telemetry gauges (when
+        enabled) and returns the raw sample."""
+        import jax
+
+        t0 = time.perf_counter()
+        jax.device_get(jax.device_put(self._tiny, self.device))
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        got = jax.device_get(jax.device_put(self._payload, self.device))
+        dt = max(time.perf_counter() - t1, 1e-9)
+        roundtrip_mbps = 2.0 * float(got.nbytes) / dt / 1e6
+        tel = self._tel if self._tel is not None else telemetry
+        tel.record_link_sample(latency_ms, roundtrip_mbps,
+                               int(got.nbytes))
+        return {
+            "latency_ms": float(latency_ms),
+            "roundtrip_mbps": float(roundtrip_mbps),
+            "payload_bytes": int(got.nbytes),
+        }
 
 
 def _abstract_leaf(a):

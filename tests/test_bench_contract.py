@@ -243,6 +243,213 @@ class TestDeadlineAndKill:
         assert rec["last_good"]["value"] == FIXTURE_GOOD["record"]["value"]
 
 
+class TestStreamSealing:
+    """Satellite: bench.py's failure paths (deadline / SIGTERM / child
+    crash) must seal a configured ledger stream with an epilogue carrying
+    the termination reason — the supervisor appends it as plain JSONL (no
+    jax), so even a run whose child died dialing leaves an attributable,
+    recoverable artifact."""
+
+    @staticmethod
+    def _dead_child_stream(tmp_path):
+        """A stream as a killed child leaves it: prologue + one
+        checkpoint, no epilogue."""
+        stream = tmp_path / "stream.jsonl"
+        snapshot = {
+            "compiles": 1, "bytes_h2d": 64, "bytes_d2h": 64,
+            "window_latency_p50_ms": None, "window_latency_p95_ms": None,
+            "max_watermark_lag_ms": 0, "watermark_lag_p99_ms": None,
+            "late_dropped": 0, "h2d_transfers": 1, "d2h_transfers": 1,
+            "events": 0, "dropped_events": 0, "kernels": {"k": 1},
+            "compaction": {},
+        }
+        stream.write_text(
+            json.dumps({"t": "prologue", "stream_version": 1,
+                        "ledger_version": 1, "created_unix": 1.0,
+                        "env": {"python": "3", "pid": 1,
+                                "argv0": "bench.py"}}) + "\n"
+            + json.dumps({"t": "checkpoint", "seq": 1, "unix": 2.0,
+                          "snapshot": snapshot, "kernels": []}) + "\n"
+        )
+        return stream
+
+    def test_failure_path_seals_stream_with_reason(self, tmp_path):
+        stream = self._dead_child_stream(tmp_path)
+        p, lines, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_LEDGER_STREAM": str(stream)},
+        )
+        assert p.returncode == 3
+        assert len(lines) == 1  # the one-line contract holds
+        recs = [json.loads(ln) for ln in
+                stream.read_text().splitlines() if ln.strip()]
+        assert recs[-1]["t"] == "epilogue"
+        assert recs[-1]["sealed_by"] == "supervisor"
+        assert "failed rc=3" in recs[-1]["reason"]
+        # The sealed stream recovers into a valid, attributable ledger.
+        from tools.sfprof import ledger as ledger_mod
+        from tools.sfprof import stream as stream_mod
+
+        doc, info = stream_mod.recover(str(stream))
+        assert ledger_mod.validate(doc) == []
+        assert info["sealed"] is True
+        assert info["sealed_by"] == "supervisor"
+        assert "failed rc=3" in info["reason"]
+        # A supervisor seal attributes the crash — it does NOT make the
+        # capture complete: the child died without its final flush.
+        assert info["truncated"] is True
+        assert "one flush interval" in info["loss_bound"]
+        # last_seq falls back to the checkpoint's (supervisor epilogues
+        # carry no seq).
+        assert info["last_seq"] == 1
+
+    def test_sigterm_path_seals_stream(self, tmp_path):
+        import signal
+        import time
+
+        stream = self._dead_child_stream(tmp_path)
+        env = {
+            **os.environ,
+            "SFT_BENCH_BACKOFFS": "0",
+            "SFT_BENCH_LAST_GOOD": str(tmp_path / "lg.json"),
+            "PALLAS_AXON_POOL_IPS": "",
+            "SFT_BENCH_HANG": "60",
+            "SFT_BENCH_DEADLINE": "600",
+            "SFT_LEDGER_STREAM": str(stream),
+        }
+        env.pop("SFT_BENCH_CHILD", None)
+        p = subprocess.Popen(
+            [sys.executable, BENCH], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        time.sleep(2.0)
+        p.send_signal(signal.SIGTERM)
+        p.communicate(timeout=60)
+        recs = [json.loads(ln) for ln in
+                stream.read_text().splitlines() if ln.strip()]
+        assert recs[-1]["t"] == "epilogue"
+        assert "SIGTERM" in recs[-1]["reason"]
+
+    def test_already_sealed_stream_not_resealed(self, tmp_path):
+        stream = self._dead_child_stream(tmp_path)
+        with open(stream, "a") as f:
+            f.write(json.dumps({"t": "epilogue", "unix": 3.0,
+                                "reason": "complete"}) + "\n")
+        before = stream.read_text()
+        p, _, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_LEDGER_STREAM": str(stream)},
+        )
+        assert p.returncode == 3
+        assert stream.read_text() == before  # the child's seal wins
+
+    def test_oversized_child_epilogue_detected_not_resealed(self, tmp_path):
+        """A child epilogue longer than any small tail peek (bench
+        record + SLO verdict easily beats 4 KiB) must still be detected
+        as a seal — a duplicate supervisor epilogue would shadow the
+        child's bench/slo blocks in recovery."""
+        stream = self._dead_child_stream(tmp_path)
+        with open(stream, "a") as f:
+            f.write(json.dumps({
+                "t": "epilogue", "unix": 3.0, "reason": "complete",
+                "bench": {"value": 9.0, "pad": "x" * 8192},
+            }) + "\n")
+        before = stream.read_text()
+        p, _, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_LEDGER_STREAM": str(stream)},
+        )
+        assert p.returncode == 3
+        assert stream.read_text() == before
+
+    def test_seal_after_partial_tail_line_stays_decodable(self, tmp_path):
+        """A child killed mid-flush leaves a half-written LAST line with
+        no newline; the supervisor epilogue must land on its OWN line
+        (not concatenate into the fragment) and recovery must honor both
+        the truncation and the termination reason."""
+        stream = self._dead_child_stream(tmp_path)
+        with open(stream, "a") as f:
+            f.write('{"t": "spans", "seq": 2, "events": [{"na')  # cut
+        p, _, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_FORCE_FAIL": "1", "SFT_LEDGER_STREAM": str(stream)},
+        )
+        assert p.returncode == 3
+        from tools.sfprof import ledger as ledger_mod
+        from tools.sfprof import stream as stream_mod
+
+        doc, info = stream_mod.recover(str(stream))
+        assert ledger_mod.validate(doc) == []
+        assert info["sealed"] is True  # the supervisor's seal survives
+        assert "failed rc=3" in info["reason"]
+        assert info["partial_tail"] is True
+        assert info["truncated"] is True  # honest: data was still lost
+
+    @pytest.mark.slow
+    def test_sigkill_chaos_recovers_gateable_ledger(self, tmp_path):
+        """The acceptance chaos test: a real bench-smoke run streaming
+        with interval 0, SIGKILLed mid-run (no handler can save it),
+        must recover into a schema-valid ledger that passes `sfprof
+        health`, reporting the truncation honestly."""
+        import time
+
+        stream = tmp_path / "chaos_stream.jsonl"
+        env = {
+            **os.environ,
+            "SFT_BENCH_CHILD": "1",  # ONE process: the kill hits the run
+            "SFT_BENCH_SMOKE": "1",
+            "SFT_BENCH_LAST_GOOD": str(tmp_path / "lg.json"),
+            "SFT_LEDGER_STREAM": str(stream),
+            "SFT_LEDGER_STREAM_INTERVAL_S": "0",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        p = subprocess.Popen(
+            [sys.executable, BENCH], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+        def n_checkpoints():
+            try:
+                return stream.read_text().count('"t": "checkpoint"')
+            except OSError:
+                return 0
+
+        # Wait for ≥2 durable checkpoints (warm-up boundary + first
+        # post-run flush), then SIGKILL while the rest of the run —
+        # latency probe, resident passes, ledger write — is still ahead.
+        deadline = time.time() + 480
+        while time.time() < deadline and n_checkpoints() < 2:
+            if p.poll() is not None:
+                pytest.fail(
+                    "bench exited before the kill: rc="
+                    f"{p.returncode}\n{p.stderr.read()[-4000:]}"
+                )
+            time.sleep(0.25)
+        assert n_checkpoints() >= 2, "no checkpoints within the deadline"
+        p.kill()  # SIGKILL: no handler, no seal, no epilogue
+        p.wait(timeout=60)
+
+        from tools.sfprof import ledger as ledger_mod
+        from tools.sfprof import stream as stream_mod
+        from tools.sfprof.cli import main as sfprof_main
+
+        doc, info = stream_mod.recover(str(stream))
+        assert ledger_mod.validate(doc) == [], ledger_mod.validate(doc)
+        assert info["sealed"] is False  # honest: the run never completed
+        assert info["truncated"] is True
+        assert "one flush interval" in info["loss_bound"]
+        assert doc["bench"] is None  # no fabricated record
+        # The recovered snapshot carries real measured state.
+        assert doc["snapshot"]["compiles"] >= 1
+        assert doc["snapshot"]["bytes_h2d"] > 0
+        # CLI round trip: recover exit 0, recovered ledger passes the
+        # post-bench health gate.
+        out = tmp_path / "recovered.json"
+        assert sfprof_main(["recover", str(stream), "-o", str(out)]) == 0
+        assert sfprof_main(["health", str(out)]) == 0
+
+
 class TestTelemetryBlock:
     def test_fake_record_with_telemetry_relays_verbatim(self, tmp_path):
         """The supervisor must relay the telemetry block untouched."""

@@ -22,7 +22,12 @@ def test_dry_run_lists_all_stages(capsys):
     assert "[sfcheck]" in out
     assert "[pytest-quick]" in out
     assert "[bench-smoke+health]" in out
-    assert "tools.sfprof health" in out.replace(sys.executable, "py")
+    plain = out.replace(sys.executable, "py")
+    assert "tools.sfprof health" in plain
+    # The crash-recovery round trip: recover the stream the smoke run
+    # wrote, then health-gate the recovered ledger.
+    assert "tools.sfprof recover" in plain
+    assert plain.count("tools.sfprof health") == 2
 
 
 def test_skip_flags_trim_stages(capsys):
@@ -73,6 +78,10 @@ def test_all_green_runs_every_stage(monkeypatch):
     assert ci.main([]) == 0
     assert any("bench.py" in c for c in calls)
     assert any("tools.sfprof health" in c for c in calls)
+    assert any("tools.sfprof recover" in c for c in calls)
+    # recover targets the stream the bench env configured, and the
+    # recovered ledger is health-gated too (2 health invocations).
+    assert sum("tools.sfprof health" in c for c in calls) == 2
     # every stage env disarms the axon dial
     assert all(e["PALLAS_AXON_POOL_IPS"] == "" for e in envs)
     bench_env = envs[[i for i, c in enumerate(calls)
@@ -81,3 +90,6 @@ def test_all_green_runs_every_stage(monkeypatch):
     # toy numbers must never enter the real last-good store
     assert "ci_last_good" in bench_env["SFT_BENCH_LAST_GOOD"]
     assert bench_env["SFT_LEDGER_PATH"]
+    assert bench_env["SFT_LEDGER_STREAM"]
+    recover_call = next(c for c in calls if "tools.sfprof recover" in c)
+    assert bench_env["SFT_LEDGER_STREAM"] in recover_call

@@ -514,11 +514,16 @@ def test_cache_entries_survive_roundtrip_uncorrupted(tmp_path, monkeypatch):
 def test_no_block_until_ready_outside_telemetry():
     # __graft_entry__.py and tests/test_graft_entry.py used the no-op
     # block_until_ready as a "sync"; they now device_get. The ban covers
-    # the driver surface, bench, and the whole test tree.
+    # the driver surface, bench, the whole test tree, and the PR 7
+    # additions: the SLO engine and the sfprof stream/recover modules
+    # (the link probe's true-sync fetch lives in telemetry.py, the ONE
+    # exempt module).
     sync = get_pass("sync-discipline")
     report = core.run_paths(
         [os.path.join(REPO, p) for p in
-         ("__graft_entry__.py", "bench.py", "bench_suite.py", "tests")],
+         ("__graft_entry__.py", "bench.py", "bench_suite.py", "tests",
+          os.path.join("spatialflink_tpu", "slo.py"),
+          os.path.join("tools", "sfprof"))],
         [sync], force_files=True,
     )
     assert report.findings == [], "\n".join(
@@ -528,18 +533,39 @@ def test_no_block_until_ready_outside_telemetry():
 
 def test_egress_fstrings_are_numpy_safe():
     # The twice-shipped bug: numpy ≥2 scalars reaching egress f-strings
-    # print as np.float32(…). The egress layers now wrap in float().
+    # print as np.float32(…). The egress layers now wrap in float() —
+    # including the PR 7 surfaces: the SLO engine (check rows/violation
+    # events land in ledgers and streams) and all of tools/sfprof
+    # (report/diff/health/recover print parsed ledger values).
     fstr = get_pass("fstring-numpy")
     report = core.run_paths(
         [os.path.join(REPO, "bench.py"),
          os.path.join(REPO, "spatialflink_tpu", "sncb"),
          os.path.join(REPO, "spatialflink_tpu", "mn"),
-         os.path.join(REPO, "spatialflink_tpu", "telemetry.py")],
+         os.path.join(REPO, "spatialflink_tpu", "telemetry.py"),
+         os.path.join(REPO, "spatialflink_tpu", "slo.py"),
+         os.path.join(REPO, "tools", "sfprof")],
         [fstr], force_files=True,
     )
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings
     )
+
+
+def test_new_observability_modules_are_in_pass_scope():
+    """The scope EXTENSION itself is pinned: fstring-numpy must apply to
+    the SLO engine and every sfprof module; sync-discipline must apply
+    everywhere except telemetry.py (slo.py and the stream modules are
+    NOT exempt)."""
+    fstr = get_pass("fstring-numpy")
+    assert fstr.applies_to("spatialflink_tpu/slo.py")
+    assert fstr.applies_to("tools/sfprof/stream.py")
+    assert fstr.applies_to("tools/sfprof/slo.py")
+    assert fstr.applies_to("tools/sfprof/cli.py")
+    sync = get_pass("sync-discipline")
+    assert sync.applies_to("spatialflink_tpu/slo.py")
+    assert sync.applies_to("tools/sfprof/stream.py")
+    assert not sync.applies_to("spatialflink_tpu/telemetry.py")
 
 
 def test_trajectory_wkt_formats_numpy_scalars_clean():

@@ -234,11 +234,28 @@ def test_validate_flags_broken_documents(tmp_path):
     assert ledger_mod.validate([1, 2]) == ["ledger is not a JSON object"]
 
 
-def test_write_ledger_rejects_nan(tmp_path):
+def test_write_ledger_sanitizes_nonfinite(tmp_path):
+    """Regression: a NaN/Inf in the bench record used to raise out of
+    write_ledger (allow_nan=False) at the very END of a run — losing the
+    whole capture. Non-finite floats now become null, counted in the
+    ``nonfinite_values`` warning field, and the document stays
+    schema-valid."""
     telemetry.enable()
-    with pytest.raises(ValueError):
-        telemetry.write_ledger(str(tmp_path / "nan.json"),
-                               bench={"value": float("nan")})
+    path = telemetry.write_ledger(
+        str(tmp_path / "nan.json"),
+        bench={"value": float("nan"), "rate": float("inf"),
+               "series": [1.0, float("-inf"), 3.0], "fine": 7.0},
+    )
+    doc = ledger_mod.load(path)
+    assert ledger_mod.validate(doc) == []
+    assert doc["bench"]["value"] is None
+    assert doc["bench"]["rate"] is None
+    assert doc["bench"]["series"] == [1.0, None, 3.0]
+    assert doc["bench"]["fine"] == 7.0
+    assert doc["nonfinite_values"] == 3
+    # A clean ledger carries no warning field at all.
+    clean = ledger_mod.load(_make_ledger(tmp_path, name="clean.json"))
+    assert "nonfinite_values" not in clean
 
 
 def test_load_any_accepts_trace_shapes(tmp_path):
@@ -408,6 +425,29 @@ def test_diff_gate_fails_when_candidate_loses_a_metric(tmp_path):
     assert sfprof_main(["diff", path, str(lost_path), "--gate"]) == 1
     # The reverse direction — B gained a metric A lacks — is fine.
     assert sfprof_main(["diff", str(lost_path), path, "--gate"]) == 0
+
+
+def test_diff_link_annotation_never_gates(tmp_path, capsys):
+    """Link-probe gauges ANNOTATE a diff (tunnel degraded vs chip slow)
+    but never gate it, and never widen the bands: two ledgers identical
+    except for a 2x-degraded link must still self-diff clean — with the
+    degradation called out in the output."""
+    path = _make_ledger(tmp_path)
+    doc = ledger_mod.load(path)
+    for name, bw in (("fast.json", 28.0), ("slow_link.json", 11.0)):
+        d = json.loads(json.dumps(doc))
+        d["snapshot"]["link_probe"] = {
+            "samples": 8, "latency_ms_p50": 1.0, "latency_ms_last": 1.0,
+            "roundtrip_mbps_p50": bw, "roundtrip_mbps_last": bw,
+            "payload_bytes": 262144,
+        }
+        (tmp_path / name).write_text(json.dumps(d))
+    fast, slow = str(tmp_path / "fast.json"), str(tmp_path / "slow_link.json")
+    assert sfprof_main(["diff", fast, slow, "--gate"]) == 0  # not gated
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out and "tunnel" in out
+    assert sfprof_main(["diff", fast, fast, "--gate"]) == 0
+    assert "comparable tunnels" in capsys.readouterr().out
 
 
 def test_diff_guards_cpu_baseline_medians(tmp_path):

@@ -1,0 +1,257 @@
+"""Online SLO engine tests (spatialflink_tpu/slo.py): strict spec
+parsing, incremental evaluation from gauge deltas, violation events into
+the telemetry buffer/stream, the verdict block, the window-fire hook in
+both assemblers, and the live↔post-hoc twin contract with
+tools/sfprof/slo.py."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import slo
+from spatialflink_tpu.streams.soa import SoaWindowAssembler
+from spatialflink_tpu.streams.windows import (
+    TumblingEventTimeWindows,
+    WindowAssembler,
+)
+from spatialflink_tpu.models.objects import Point
+from spatialflink_tpu.telemetry import telemetry
+from tools.sfprof import slo as sfprof_slo
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Every test leaves the module slot empty and telemetry disabled +
+    reset (same discipline as test_sfprof.py's fixture)."""
+    yield
+    slo.uninstall()
+    telemetry.enable()
+    telemetry.disable()
+
+
+def _spec(**kw):
+    kw.setdefault("eval_interval_s", 0.0)  # evaluate on every window
+    kw.setdefault("warmup_windows", 0)
+    return slo.SloSpec(**kw)
+
+
+# -- spec parsing -------------------------------------------------------------
+
+
+def test_spec_from_dict_strict():
+    sp = slo.SloSpec.from_dict(
+        {"name": "q", "eps_floor": 100.0, "late_drop_budget": 0}
+    )
+    assert sp.eps_floor == 100.0
+    assert sp.watermark_lag_p99_ms is None  # absent = unchecked
+    with pytest.raises(ValueError, match="unknown SLO spec keys"):
+        slo.SloSpec.from_dict({"eps_flor": 1.0})  # the typo must raise
+    with pytest.raises(ValueError, match="slo_version"):
+        slo.SloSpec.from_dict({"slo_version": 99})
+
+
+def test_spec_file_roundtrip(tmp_path):
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps({"slo_version": slo.SLO_VERSION,
+                             "name": "smoke", "recompile_ceiling": 24}))
+    sp = slo.SloSpec.from_file(str(p))
+    assert sp.recompile_ceiling == 24
+    assert sp.to_dict()["slo_version"] == slo.SLO_VERSION
+
+
+def test_spec_twin_constants_and_fields_in_sync():
+    """Live engine (spatialflink_tpu/slo.py) and post-hoc evaluator
+    (tools/sfprof/slo.py) deliberately don't import each other — this is
+    the cross-pin: same version, same field set."""
+    assert slo.SLO_VERSION == sfprof_slo.SLO_VERSION
+    live_fields = {f.name for f in dataclasses.fields(slo.SloSpec)}
+    assert live_fields == set(sfprof_slo.SPEC_KEYS)
+
+
+# -- incremental evaluation ---------------------------------------------------
+
+
+def test_lag_p99_violation_is_a_transition_not_a_spam():
+    telemetry.enable()
+    eng = slo.install(slo.SloEngine(_spec(watermark_lag_p99_ms=8.0)))
+    for _ in range(20):
+        eng.observe_window(10, lag_ms=1.0)
+    assert eng.violations == []
+    for _ in range(200):  # push p99 over the ceiling, many evaluations
+        eng.observe_window(10, lag_ms=5000.0)
+    checks = {r["check"]: r for r in eng.evaluate()}
+    assert not checks["watermark_lag_p99_ms"]["ok"]
+    # One violation record for the whole stall, not one per window.
+    assert [v["check"] for v in eng.violations] == ["watermark_lag_p99_ms"]
+    # The structured event landed in the telemetry buffer.
+    names = [e["name"] for e in telemetry.events]
+    assert "slo_violation:watermark_lag_p99_ms" in names
+    assert eng.verdict()["ok"] is False
+
+
+def test_eps_clock_starts_at_first_window_not_install():
+    """The EPS denominator must exclude pre-window dead time (XLA
+    warm-up, probe samples): a floor the real window rate clears must
+    not violate just because the engine was installed early."""
+    import time
+
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(eps_floor=100_000.0, warmup_windows=0))
+    assert eng._t0 is None  # clock not running yet
+    time.sleep(0.06)  # "warm-up": would drag EPS under the floor if
+    # the clock had started at construction (1000 pts / 0.06 s ≈ 17k)
+    eng.observe_window(500)
+    eng.observe_window(500)
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert rows["eps_floor"]["ok"], rows["eps_floor"]
+    assert eng.violations == []
+
+
+def test_eps_floor_respects_warmup_then_violates():
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(eps_floor=1e15, warmup_windows=5))
+    for _ in range(5):
+        eng.observe_window(10)
+    assert all(r["check"] != "eps_floor" for r in eng.evaluate())
+    eng.observe_window(10)  # past warmup: the impossible floor trips
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert not rows["eps_floor"]["ok"]
+    assert eng.violations and eng.violations[0]["check"] == "eps_floor"
+
+
+def test_budget_checks_read_telemetry_gauges():
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(late_drop_budget=1, recompile_ceiling=0))
+    telemetry.record_late_drop(2)
+    telemetry.record_jit_call("k", ((4,),))
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert not rows["late_drop_budget"]["ok"]
+    assert rows["late_drop_budget"]["value"] == 2
+    assert not rows["recompile_ceiling"]["ok"]
+    v = eng.verdict()
+    assert {x["check"] for x in v["violations"]} == {
+        "late_drop_budget", "recompile_ceiling"}
+    json.dumps(v)  # verdict block is strictly JSON-safe
+
+
+def test_recovery_transition_emits_event_but_keeps_violation():
+    telemetry.enable()
+    eng = slo.install(slo.SloEngine(_spec(late_drop_budget=0)))
+    telemetry.record_late_drop(1)
+    eng.evaluate()
+    assert len(eng.violations) == 1
+    # The gauge can't go back down in telemetry, so emulate recovery by
+    # raising the budget via a fresh spec on the same engine state.
+    eng.spec = _spec(late_drop_budget=5)
+    eng.evaluate()
+    names = [e["name"] for e in telemetry.events]
+    assert "slo_recovered:late_drop_budget" in names
+    # The verdict is about the RUN: the violation stays recorded.
+    assert eng.verdict()["ok"] is False
+
+
+def test_compliant_run_verdict_ok():
+    telemetry.enable()
+    eng = slo.install(slo.SloEngine(_spec(
+        watermark_lag_p99_ms=10_000, eps_floor=0.001,
+        late_drop_budget=0, overflow_budget=0, recompile_ceiling=64,
+    )))
+    for _ in range(10):
+        eng.observe_window(1000, lag_ms=1.0)
+    v = eng.verdict()
+    assert v["ok"] is True and v["violations"] == []
+    assert v["windows"] == 10 and v["points"] == 10_000
+
+
+# -- window-fire hook ---------------------------------------------------------
+
+
+def test_hook_free_when_no_engine_installed():
+    assert slo.engine() is None
+    slo.on_window_fired(100, lag_ms=5.0)  # must be a no-op, no raise
+
+
+def test_object_assembler_feeds_engine():
+    telemetry.enable()
+    eng = slo.install(slo.SloEngine(_spec()))
+    asm = WindowAssembler(
+        TumblingEventTimeWindows(10), timestamp_fn=lambda e: e.timestamp
+    )
+    asm.feed(Point(obj_id="a", timestamp=1, x=0.0, y=0.0))
+    asm.feed(Point(obj_id="a", timestamp=25, x=0.0, y=0.0))  # fires [0,10)
+    assert eng.windows == 1
+    assert eng.points == 1  # the one event buffered in the fired window
+    assert eng.lag.count == 1  # lag observed at the same fire site
+
+
+def test_soa_assembler_feeds_engine():
+    telemetry.enable()
+    eng = slo.install(slo.SloEngine(_spec()))
+    asm = SoaWindowAssembler(10, 5)
+    chunk = {
+        "ts": np.asarray([1, 3, 9], np.int64),
+        "x": np.zeros(3), "y": np.zeros(3),
+        "oid": np.zeros(3, np.int32),
+    }
+    asm.feed(chunk)
+    asm.feed({"ts": np.asarray([27], np.int64), "x": np.zeros(1),
+              "y": np.zeros(1), "oid": np.zeros(1, np.int32)})
+    assert eng.windows >= 1
+    assert eng.points >= 3
+    # flush()'s artificial watermark must not feed the engine's lag
+    # histogram (same contract as the telemetry gauge).
+    before = eng.lag.count
+    asm.flush()
+    assert eng.lag.count == before
+
+
+# -- ledger integration -------------------------------------------------------
+
+
+def test_installed_engine_verdict_rides_ledger_and_health_slo(tmp_path):
+    telemetry.enable()
+    slo.install(slo.SloEngine(_spec(eps_floor=1e15, warmup_windows=0)))
+    eng = slo.engine()
+    for _ in range(3):
+        eng.observe_window(1)
+    path = str(tmp_path / "ledger.json")
+    telemetry.write_ledger(path, bench={"value": 1.0,
+                                        "points_per_sec": 1.0})
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["slo"]["ok"] is False
+    assert doc["slo"]["spec"]["eps_floor"] == 1e15
+
+    from tools.sfprof.cli import main as sfprof_main
+
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps({"name": "gate", "eps_floor": 1e15}))
+    # Violated live AND post-hoc: the same spec fails health --slo.
+    assert sfprof_main(["health", path, "--slo", str(spec_path)]) == 1
+    # Without --slo the embedded verdict does not gate plain health.
+    assert sfprof_main(["health", path]) == 0
+    # A compliant spec still fails: the LIVE verdict recorded violations.
+    ok_spec = tmp_path / "ok.json"
+    ok_spec.write_text(json.dumps({"name": "gate", "late_drop_budget": 9}))
+    assert sfprof_main(["health", path, "--slo", str(ok_spec)]) == 1
+
+
+def test_posthoc_evaluate_matches_live_semantics(tmp_path):
+    """Post-hoc eps answers come from bench points_per_sec/value; a spec
+    naming a floor the ledger cannot answer FAILS (silence never
+    passes)."""
+    spec = {"name": "x", "eps_floor": 100.0}
+    doc = {"snapshot": {}, "bench": {"points_per_sec": 250.0}}
+    rows = {r[0]: r for r in sfprof_slo.evaluate(spec, doc)}
+    assert rows["slo:eps_floor"][3] is True
+    doc_silent = {"snapshot": {}, "bench": {}}
+    rows = {r[0]: r for r in sfprof_slo.evaluate(spec, doc_silent)}
+    assert rows["slo:eps_floor"][3] is False
+    # Lag falls back to the max gauge (an upper bound: stricter, never
+    # laxer) when the p99 histogram is absent.
+    spec = {"name": "x", "watermark_lag_p99_ms": 10.0}
+    doc = {"snapshot": {"max_watermark_lag_ms": 50}, "bench": None}
+    rows = {r[0]: r for r in sfprof_slo.evaluate(spec, doc)}
+    assert rows["slo:watermark_lag_p99_ms"][3] is False

@@ -9,9 +9,13 @@ One shot, three stages, fail-fast, distinct banners:
    (PALLAS_AXON_POOL_IPS emptied so nothing dials the axon tunnel at
    interpreter boot — the CLAUDE.md outage rule);
 3. **bench smoke + sfprof health** — an ``SFT_BENCH_SMOKE`` toy-size
-   bench.py run on XLA:CPU writing a run ledger, then
-   ``python -m tools.sfprof health <ledger>`` threshold verdicts
-   (recompile churn, overflows, late drops, watermark lag).
+   bench.py run on XLA:CPU writing a run ledger AND a ledger stream
+   (``SFT_LEDGER_STREAM``), then ``python -m tools.sfprof health
+   <ledger>`` threshold verdicts (recompile churn, overflows, late
+   drops, watermark lag), then the crash-recovery round trip:
+   ``sfprof recover <stream>`` → ``sfprof health <recovered>`` — every
+   commit proves the durable capture path still reconstructs a
+   gateable ledger.
 
 Exit code: the first failing stage's (sfcheck keeps its 0/1/2/3
 contract; pytest and sfprof theirs). ``--skip-tests`` / ``--skip-bench``
@@ -42,7 +46,8 @@ def _cpu_env() -> Dict[str, str]:
 
 
 def stages(changed: bool, skip_tests: bool, skip_bench: bool,
-           ledger_path: Optional[str] = None) \
+           ledger_path: Optional[str] = None,
+           stream_path: Optional[str] = None) \
         -> List[Tuple[str, List[List[str]]]]:
     """(name, [argv, ...]) per stage — a stage may chain commands."""
     py = sys.executable
@@ -59,14 +64,23 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
     if not skip_bench:
         ledger = ledger_path or os.path.join(
             tempfile.gettempdir(), "sft_ci_ledger.json")
+        stream = stream_path or os.path.join(
+            tempfile.gettempdir(), "sft_ci_ledger_stream.jsonl")
+        recovered = stream + ".recovered.json"
         out.append(("bench-smoke+health", [
             [py, "bench.py"],
             [py, "-m", "tools.sfprof", "health", ledger],
+            # Crash-recovery round trip on the stream the smoke run just
+            # wrote: recover must rebuild a schema-valid ledger and that
+            # ledger must pass the same health gate.
+            [py, "-m", "tools.sfprof", "recover", stream,
+             "-o", recovered],
+            [py, "-m", "tools.sfprof", "health", recovered],
         ]))
     return out
 
 
-def _bench_env(ledger: str, tmpdir: str) -> Dict[str, str]:
+def _bench_env(ledger: str, stream: str, tmpdir: str) -> Dict[str, str]:
     env = _cpu_env()
     env.update({
         "SFT_BENCH_SMOKE": "1",
@@ -74,6 +88,7 @@ def _bench_env(ledger: str, tmpdir: str) -> Dict[str, str]:
         # toy numbers must never touch the real last-good store
         "SFT_BENCH_LAST_GOOD": os.path.join(tmpdir, "ci_last_good.json"),
         "SFT_LEDGER_PATH": ledger,
+        "SFT_LEDGER_STREAM": stream,
     })
     return env
 
@@ -96,8 +111,9 @@ def main(argv=None) -> int:
 
     with tempfile.TemporaryDirectory(prefix="sft_ci_") as tmpdir:
         ledger = os.path.join(tmpdir, "ledger.json")
+        stream = os.path.join(tmpdir, "ledger_stream.jsonl")
         plan = stages(args.changed, args.skip_tests, args.skip_bench,
-                      ledger_path=ledger)
+                      ledger_path=ledger, stream_path=stream)
         if args.dry_run:
             for name, cmds in plan:
                 for cmd in cmds:
@@ -106,7 +122,7 @@ def main(argv=None) -> int:
         for name, cmds in plan:
             for cmd in cmds:
                 print(f"== ci stage: {name}: {' '.join(cmd)}", flush=True)
-                env = _bench_env(ledger, tmpdir) \
+                env = _bench_env(ledger, stream, tmpdir) \
                     if name.startswith("bench") else _cpu_env()
                 proc = subprocess.run(cmd, cwd=REPO_ROOT, env=env)
                 if proc.returncode != 0:

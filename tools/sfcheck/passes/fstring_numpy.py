@@ -122,11 +122,14 @@ class FstringNumpyPass(Pass):
                  "in float() first (CLAUDE.md)")
 
     def applies_to(self, relpath: str) -> bool:
-        # tools/sfprof is an egress layer too: report/diff/health print
-        # values parsed straight out of ledgers (and the ledger writer
-        # itself lives in telemetry.py) — the np.float32(…) repr class
-        # must not reach either surface.
-        return (relpath in ("bench.py", "spatialflink_tpu/telemetry.py")
+        # tools/sfprof is an egress layer too: report/diff/health/
+        # recover print values parsed straight out of ledgers and
+        # streams (the ledger/stream writers themselves live in
+        # telemetry.py, and the SLO engine's check rows/violation events
+        # land in both artifacts) — the np.float32(…) repr class must
+        # not reach any of these surfaces.
+        return (relpath in ("bench.py", "spatialflink_tpu/telemetry.py",
+                            "spatialflink_tpu/slo.py")
                 or relpath.startswith("spatialflink_tpu/sncb/")
                 or relpath.startswith("spatialflink_tpu/mn/")
                 or relpath.startswith("tools/sfprof/"))

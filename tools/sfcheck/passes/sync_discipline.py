@@ -6,9 +6,11 @@ The only true synchronization is a real device→host fetch
 (``jax.device_get`` / ``np.asarray`` / ``telemetry.fetch``). A "sync"
 that doesn't fetch measures nothing and pushes its cost into the NEXT
 measurement (the bogus 106M pts/s bug). The ban covers everything —
-bench.py, the driver entry, and the tests — except
-``spatialflink_tpu/telemetry.py``, the one module allowed to talk about
-sync primitives directly.
+bench.py, the driver entry, the tests, the SLO engine
+(``spatialflink_tpu/slo.py``), and the sfprof stream/recover modules —
+except ``spatialflink_tpu/telemetry.py``, the ONE module allowed to
+talk about sync primitives directly (which is also why the link-health
+probe, whose fetch IS its measurement, lives there and nowhere else).
 """
 
 from __future__ import annotations

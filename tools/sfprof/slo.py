@@ -1,0 +1,141 @@
+"""Post-hoc SLO evaluation (``sfprof health --slo <spec>``).
+
+Validator-side mirror of ``spatialflink_tpu/slo.py`` — the SAME JSON
+spec that the live engine evaluates incrementally gates a finished (or
+recovered) ledger here, so one file governs both surfaces. Kept as a
+twin module rather than an import because the sfprof CLI deliberately
+never imports spatialflink_tpu (whose import configures jax);
+tests/test_slo.py cross-pins ``SLO_VERSION`` and the field set.
+
+Metric sources in the ledger document:
+
+- ``watermark_lag_p99_ms`` → snapshot's ``watermark_lag_p99_ms`` (falls
+  back to ``max_watermark_lag_ms`` — an upper bound, so the fallback can
+  only be STRICTER than the live check, never laxer);
+- ``eps_floor`` → bench ``points_per_sec``/``value``; a spec that names
+  a floor the ledger cannot answer FAILS the check (the gate must not
+  pass on silence — the ``diff`` lost-metric rule);
+- ``late_drop_budget`` → snapshot ``late_dropped``;
+- ``recompile_ceiling`` → snapshot ``compiles``;
+- ``overflow_budget`` → every ``*overflow*`` counter in the bench block
+  and snapshot, summed.
+
+A live verdict embedded by the engine (``doc["slo"]``) adds one more
+check: ``live_verdict`` fails if the run itself recorded violations.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Mirror of spatialflink_tpu/slo.py:SLO_VERSION.
+SLO_VERSION = 1
+
+#: The spec's threshold fields (mirror of SloSpec). ``name`` /
+#: ``eval_interval_s`` / ``warmup_windows`` are live-engine knobs that a
+#: post-hoc pass accepts and ignores.
+SPEC_KEYS = (
+    "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
+    "overflow_budget", "recompile_ceiling", "eval_interval_s",
+    "warmup_windows",
+)
+
+
+def load_spec(path: str) -> Dict[str, Any]:
+    """Strict spec parse: unknown keys raise (a typo'd threshold that is
+    silently unchecked is the worst failure mode a gate can have)."""
+    with open(path) as f:
+        spec = json.load(f)
+    if not isinstance(spec, dict):
+        raise ValueError("SLO spec is not a JSON object")
+    ver = spec.get("slo_version", SLO_VERSION)
+    if ver != SLO_VERSION:
+        raise ValueError(f"slo_version {ver} != supported {SLO_VERSION}")
+    unknown = sorted(set(spec) - set(SPEC_KEYS) - {"slo_version"})
+    if unknown:
+        raise ValueError(f"unknown SLO spec keys: {unknown}")
+    return spec
+
+
+def find_overflows(value: Any, prefix: str,
+                   out: List[Tuple[str, float]]):
+    """Every numeric counter whose key mentions ``overflow``, with its
+    dotted path (shared with the health CLI's unconditional scan)."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            path = f"{prefix}.{k}" if prefix else str(k)
+            if ("overflow" in str(k) and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                out.append((path, v))
+            else:
+                find_overflows(v, path, out)
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
+    """Check rows ``(name, value, band, ok)`` — the health CLI's row
+    shape — applying ``spec`` to a ledger document."""
+    snap = doc.get("snapshot") or {}
+    bench = doc.get("bench") or {}
+    rows: List[tuple] = []
+
+    ceiling = _num(spec.get("watermark_lag_p99_ms"))
+    if ceiling is not None:
+        p99 = _num(snap.get("watermark_lag_p99_ms"))
+        if p99 is None:
+            # Upper-bound fallback: stricter than the live check, never
+            # laxer.
+            p99 = _num(snap.get("max_watermark_lag_ms")) or 0.0
+        rows.append(("slo:watermark_lag_p99_ms", p99,
+                     f"<= {float(ceiling):g}", p99 <= ceiling))
+
+    floor = _num(spec.get("eps_floor"))
+    if floor is not None:
+        eps = _num(bench.get("points_per_sec"))
+        if eps is None:
+            eps = _num(bench.get("value"))
+        if eps is None:
+            slo_block = doc.get("slo") or {}
+            for row in slo_block.get("checks") or []:
+                if row.get("check") == "eps_floor":
+                    eps = _num(row.get("value"))
+        rows.append((
+            "slo:eps_floor",
+            eps,
+            f">= {float(floor):g}",
+            eps is not None and eps >= floor,  # silence fails the gate
+        ))
+
+    budget = _num(spec.get("late_drop_budget"))
+    if budget is not None:
+        late = _num(snap.get("late_dropped")) or 0.0
+        rows.append(("slo:late_drop_budget", late,
+                     f"<= {int(budget)}", late <= budget))
+
+    ceiling = _num(spec.get("recompile_ceiling"))
+    if ceiling is not None:
+        compiles = _num(snap.get("compiles")) or 0.0
+        rows.append(("slo:recompile_ceiling", compiles,
+                     f"<= {int(ceiling)}", compiles <= ceiling))
+
+    budget = _num(spec.get("overflow_budget"))
+    if budget is not None:
+        overflows: List[Tuple[str, float]] = []
+        find_overflows(bench, "bench", overflows)
+        find_overflows(snap, "snapshot", overflows)
+        total = sum(v for _, v in overflows)
+        rows.append(("slo:overflow_budget", total,
+                     f"<= {int(budget)}", total <= budget))
+
+    live = doc.get("slo")
+    if isinstance(live, dict) and "ok" in live:
+        n_viol = len(live.get("violations") or [])
+        rows.append(("slo:live_verdict", n_viol, "0 violations",
+                     bool(live["ok"])))
+    return rows
