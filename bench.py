@@ -155,16 +155,18 @@ def _load_last_good():
         return None
 
 
-def _seal_stream_supervisor(reason: str) -> None:
-    """Failure-path ledger-stream seal from the SUPERVISOR process.
+def _seal_stream_supervisor(reason: str, sealed_by: str = "supervisor") -> None:
+    """Failure-path ledger-stream seal WITHOUT telemetry/jax.
 
-    The child owns the stream (telemetry writes it), but on the
-    deadline/SIGTERM/child-crash paths the child died without its
-    epilogue. The stream is plain JSONL, so the supervisor — which never
-    imports jax — can append the sealing epilogue itself, turning an
-    abandoned stream into an attributable artifact (``sfprof recover``
-    reports the termination reason instead of guessing). Skips cleanly
-    when no stream was configured/created or the child already sealed."""
+    The child's telemetry owns the stream, but on the deadline/SIGTERM/
+    child-crash paths the child died without its epilogue — and on the
+    child's own dial-timeout path (below) jax may be wedged in an
+    unkillable C call, so even in-process the seal must not touch it.
+    The stream is plain JSONL, so anyone can append the sealing epilogue
+    directly, turning an abandoned stream into an attributable artifact
+    (``sfprof recover`` reports the termination reason instead of
+    guessing). Skips cleanly when no stream was configured/created or
+    the child already sealed."""
     import os
     import time
 
@@ -201,7 +203,7 @@ def _seal_stream_supervisor(reason: str) -> None:
             # the corrupt fragment and still honors this seal).
             f.write(lead + json.dumps({
                 "t": "epilogue", "unix": time.time(),
-                "reason": str(reason), "sealed_by": "supervisor",
+                "reason": str(reason), "sealed_by": str(sealed_by),
             }).encode() + b"\n")
     except OSError as e:  # pragma: no cover - fs trouble is non-fatal
         sys.stderr.write(f"ledger stream not sealed: {e}\n")
@@ -398,29 +400,46 @@ def main() -> None:
         print(fake)
         return
 
-    # Device-init watchdog: the tunnel's site hook dials the device while
-    # jax initializes; a down tunnel hangs that C call forever (observed
-    # outage 2026-07-30). Emit an honest one-line record and exit instead
-    # of hanging the driver — the supervisor above retries the dial in a
-    # fresh process with backoff.
-    _init_ok = threading.Event()
+    # Dial watchdogs: the tunnel's site hook dials the device while jax
+    # initializes, and a down/half-open tunnel can hang EITHER that C
+    # call (the r3–r5 "hang at interpreter boot" mode) OR the first real
+    # device op after a seemingly healthy init. TWO bounded phases, each
+    # under SFT_DIAL_DEADLINE_S (default 180 s ≈ 6× a cold plugin
+    # start): phase 1 covers import jax → device discovery; phase 2
+    # re-arms just before the warm-up step and covers the first
+    # ship + compile + true-sync fetch (the only ops that can wedge on a
+    # half-open tunnel). Host-side work in between — stream generation,
+    # packing — is deliberately OUTSIDE both windows: it cannot hang on
+    # the tunnel and must not eat the dial budget. On timeout the
+    # watchdog seals the ledger stream with reason ``dial_timeout``
+    # (plain JSONL append — jax is wedged, telemetry must not be asked
+    # to flush through it), prints the honest one-line record, and
+    # exits so the supervisor can retry the dial in a fresh process
+    # instead of riding out its full deadline.
+    _dial_deadline = float(_os.environ.get("SFT_DIAL_DEADLINE_S", "180"))
 
-    def _watchdog():
-        # 180 s is ~6× a cold plugin start — past any healthy init (first
-        # compiles happen later and are not under this timer); short
-        # enough that the supervisor's 3 dials fit where one 600 s dial
-        # sat before.
-        if not _init_ok.wait(180):
-            if _init_ok.is_set():  # lost the race at the boundary
-                return
-            print(json.dumps({
-                **_ERROR_RECORD,
-                "error": "device tunnel unreachable (init hang > 180 s)",
-            }))
-            sys.stdout.flush()
-            _os._exit(3)
+    def _arm_dial_watchdog(label: str) -> threading.Event:
+        ok = threading.Event()
 
-    threading.Thread(target=_watchdog, daemon=True).start()
+        def _watchdog():
+            if not ok.wait(_dial_deadline):
+                if ok.is_set():  # lost the race at the boundary
+                    return
+                _seal_stream_supervisor("dial_timeout",
+                                        sealed_by="watchdog")
+                print(json.dumps({
+                    **_ERROR_RECORD,
+                    "error": f"device tunnel unreachable ({label} hang "
+                             f"> {float(_dial_deadline):.0f} s; "
+                             "SFT_DIAL_DEADLINE_S)",
+                }))
+                sys.stdout.flush()
+                _os._exit(3)
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+        return ok
+
+    _init_ok = _arm_dial_watchdog("interpreter/device dial")
 
     import jax
     import jax.numpy as jnp
@@ -431,7 +450,9 @@ def main() -> None:
     from __graft_entry__ import BEIJING_GRID_ARGS, QUERY_POINT
 
     dev = jax.devices()[0]
-    _init_ok.set()  # device reachable — disarm the watchdog
+    _init_ok.set()  # phase 1 done: the dial answered. Device DISCOVERY
+    # succeeding does not prove the tunnel can move bytes (the half-open
+    # mode) — phase 2 below re-arms around the first real device op.
 
     smoke = bool(_os.environ.get("SFT_BENCH_SMOKE"))
     if smoke:
@@ -520,10 +541,23 @@ def main() -> None:
         telemetry.account_h2d(host.nbytes)
         return jax.device_put(host, dev)
 
+    # Phase 2: the first device op (ship + compile + true-sync fetch)
+    # under its own fresh dial deadline — host data generation above is
+    # excluded, it cannot hang on the tunnel.
+    _first_op_ok = _arm_dial_watchdog("first device op")
+    _dial_hang = _os.environ.get("SFT_BENCH_DIAL_HANG")
+    if _dial_hang:
+        # Contract-test hook: simulate the first device op hanging on a
+        # half-open tunnel (device discovery succeeded, bytes don't
+        # move) so the dial watchdog's seal/record path can be pinned
+        # without a device (tests/test_bench_contract.py).
+        time.sleep(float(_dial_hang))
+
     # Warm-up (compile) + slide-0 digest (its ingest precedes window 0).
     seg0, rep0, warm = jstep(empty_seg, empty_rep, slide_wire(0), q_d)
     jax.device_get(warm.num_valid)  # true sync (block_until_ready is a
     # no-op on the axon tunnel)
+    _first_op_ok.set()  # bytes moved through the tunnel — disarmed
 
     # Link-health probe: tiny fixed-shape round trips at PHASE BOUNDARIES
     # only (never inside a window span), so "chip slow" and "tunnel

@@ -275,17 +275,130 @@ def restore_kafka_source_offsets(state: Dict[str, Any],
     return dict(state["offsets"])
 
 
+#: Framed-checkpoint magic. Format (big-endian):
+#: ``MAGIC(8) | version u32 | crc32 u32 | payload_len u64 | payload`` —
+#: the payload is the pickled component dict. The header turns the two
+#: silent corruption modes a raw pickle has (truncation → EOFError deep
+#: inside the unpickler; bit rot → an arbitrary exception or, worse,
+#: garbage state) into explicit :class:`CheckpointCorruptError`\ s naming
+#: the path and what was expected.
+CHECKPOINT_MAGIC = b"SFTCKPT\x01"
+CHECKPOINT_VERSION = 2
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed its integrity check (magic / version /
+    length / CRC / unpickle). Carries the path and what was expected so
+    the operator sees an actionable error, never a raw pickle traceback.
+    """
+
+    def __init__(self, path: str, expected: str, found: str = ""):
+        msg = f"corrupt checkpoint {path!r}: expected {expected}"
+        if found:
+            msg += f", found {found}"
+        super().__init__(msg)
+        self.path = path
+
+
 def save_checkpoint(path: str, **components) -> None:
     """Persist named component states, e.g.
     ``save_checkpoint(p, assembler=assembler_state(asm), op=operator_state(o))``.
+
+    Durable publish: framed payload (magic + version + CRC32 + length)
+    written to a sibling temp file, fsync'd, then atomically renamed over
+    ``path`` — a crash at ANY instant leaves either the old checkpoint or
+    the new one, never a torn file. The containing directory is fsync'd
+    too so the rename itself survives power loss.
     """
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    import struct
+    import zlib
+
+    dirname = os.path.dirname(os.path.abspath(path))
+    os.makedirs(dirname, exist_ok=True)
+    payload = pickle.dumps(components, protocol=pickle.HIGHEST_PROTOCOL)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(components, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(CHECKPOINT_MAGIC)
+        f.write(struct.pack(">IIQ", CHECKPOINT_VERSION,
+                            zlib.crc32(payload) & 0xFFFFFFFF, len(payload)))
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic publish
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - platform without dir fsync
+        pass
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Load + verify a checkpoint.
+
+    Framed (v2+) files are validated magic → version → length → CRC →
+    unpickle, each failure raising :class:`CheckpointCorruptError` with
+    the path and the expectation that failed. Round-1 checkpoints (raw
+    pickle, no header) still load — restore code already handles their
+    in-payload format drift — but their corruption is wrapped into the
+    same error type instead of surfacing as a pickle traceback.
+    """
+    import struct
+    import zlib
+
     with open(path, "rb") as f:
-        return pickle.load(f)
+        data = f.read()
+    if not data.startswith(CHECKPOINT_MAGIC):
+        if data[:1] == b"\x80":  # legacy raw-pickle checkpoint (pre-v2)
+            try:
+                legacy = pickle.loads(data)
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    path, "a loadable legacy (headerless) checkpoint",
+                    f"unpickling failed: {e!r}",
+                ) from e
+            if not isinstance(legacy, dict):
+                raise CheckpointCorruptError(
+                    path, "a component dict",
+                    type(legacy).__name__,
+                )
+            return legacy
+        raise CheckpointCorruptError(
+            path, f"magic {CHECKPOINT_MAGIC!r}",
+            f"{data[:8]!r} ({len(data)} bytes)",
+        )
+    header = data[len(CHECKPOINT_MAGIC):len(CHECKPOINT_MAGIC) + 16]
+    if len(header) < 16:
+        raise CheckpointCorruptError(
+            path, "a 16-byte header after the magic",
+            f"{len(header)} bytes (truncated)",
+        )
+    version, crc, length = struct.unpack(">IIQ", header)
+    if version > CHECKPOINT_VERSION:
+        raise CheckpointCorruptError(
+            path,
+            f"checkpoint version <= {CHECKPOINT_VERSION} (this build)",
+            f"version {version} — written by a newer build; upgrade or "
+            "re-checkpoint",
+        )
+    payload = data[len(CHECKPOINT_MAGIC) + 16:]
+    if len(payload) != length:
+        raise CheckpointCorruptError(
+            path, f"{length} payload bytes",
+            f"{len(payload)} (truncated or trailing garbage)",
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CheckpointCorruptError(
+            path, f"payload CRC32 {crc:#010x}",
+            f"{zlib.crc32(payload) & 0xFFFFFFFF:#010x} (bit rot or a "
+            "partial overwrite)",
+        )
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # CRC passed but unpickle failed: version skew
+        raise CheckpointCorruptError(
+            path, "a loadable pickle payload",
+            f"unpickling failed: {e!r}",
+        ) from e

@@ -30,6 +30,7 @@ from spatialflink_tpu.streams.windows import (
     WindowAssembler,
     WindowBatch,
 )
+from spatialflink_tpu.faults import faults
 from spatialflink_tpu.telemetry import instrument_jit, telemetry
 from spatialflink_tpu.utils.interning import Interner
 
@@ -98,9 +99,34 @@ class SpatialOperator:
         else:
             yield from self._assembler().stream(stream)
 
+    def _adopt_assembler(self, asm) -> "WindowAssembler":
+        """THE home of the restore-and-expose assembler protocol (also
+        used by the dataflow driver, spatialflink_tpu/driver.py): consume
+        a state restored by checkpoint.restore_operator before the first
+        event, and expose the assembler as ``self.checkpoint_assembler``
+        for checkpoint.operator_state to snapshot."""
+        if getattr(self, "_restored_assembler", None):
+            from spatialflink_tpu.checkpoint import restore_assembler
+
+            restore_assembler(asm, self._restored_assembler)
+            self._restored_assembler = None
+        self.checkpoint_assembler = asm
+        return asm
+
+    def _adopt_soa_assembler(self, asm):
+        """SoA twin of ``_adopt_assembler`` (point and ragged assemblers
+        both snapshot through checkpoint.soa_assembler_state)."""
+        if getattr(self, "_restored_soa_assembler", None):
+            from spatialflink_tpu.checkpoint import restore_soa_assembler
+
+            restore_soa_assembler(asm, self._restored_soa_assembler)
+            self._restored_soa_assembler = None
+        self.checkpoint_soa_assembler = asm
+        return asm
+
     def _checkpointable_windows(self, stream, flush_at_end: bool = True):
-        """Event-time windows with checkpoint hooks — the single home of
-        the pane-carry assembler plumbing (kNN/join query_panes):
+        """Event-time windows with checkpoint hooks — the pane-carry
+        assembler plumbing (kNN/join query_panes):
 
         - the assembler is exposed as ``self.checkpoint_assembler``
           (snapshotted by checkpoint.operator_state);
@@ -110,13 +136,7 @@ class SpatialOperator:
           (open windows stay buffered for the resumed run) instead of
           end-of-stream.
         """
-        asm = self._assembler()
-        if getattr(self, "_restored_assembler", None):
-            from spatialflink_tpu.checkpoint import restore_assembler
-
-            restore_assembler(asm, self._restored_assembler)
-            self._restored_assembler = None
-        self.checkpoint_assembler = asm
+        asm = self._adopt_assembler(self._assembler())
         for ev in stream:
             yield from asm.feed(ev)
         if flush_at_end:
@@ -125,14 +145,8 @@ class SpatialOperator:
     def _checkpointable_soa_windows(self, asm, chunks,
                                     flush_at_end: bool = True):
         """SoA twin of ``_checkpointable_windows`` (caller supplies the
-        soa.py assembler; point and ragged both snapshot through
-        checkpoint.soa_assembler_state)."""
-        if getattr(self, "_restored_soa_assembler", None):
-            from spatialflink_tpu.checkpoint import restore_soa_assembler
-
-            restore_soa_assembler(asm, self._restored_soa_assembler)
-            self._restored_soa_assembler = None
-        self.checkpoint_soa_assembler = asm
+        soa.py assembler)."""
+        self._adopt_soa_assembler(asm)
         for chunk in chunks:
             yield from asm.feed(chunk)
         if flush_at_end:
@@ -275,6 +289,8 @@ def ship(*arrays):
     """
     import jax.numpy as jnp
 
+    if faults.armed:  # chaos injection point (faults.py)
+        faults.hit("device.ship")
     if telemetry.enabled:
         telemetry.account_h2d(
             sum(np.asarray(a).nbytes for a in arrays if a is not None)
@@ -347,6 +363,9 @@ def jitted(fn: Callable, *static: str):
     compile-inclusive latency, lazily captured XLA cost analysis —
     tools/sfprof reports it). Free when telemetry is disabled (one
     attribute check)."""
+    # instrument_jit is also the `device.dispatch` chaos injection point
+    # (faults.py) — placed there, not here, so mesh window programs and
+    # bench steps that skip this cache are injectable too.
     jfn = jax.jit(fn, static_argnames=static) if static else jax.jit(fn)
     return instrument_jit(jfn, name=getattr(fn, "__name__", str(fn)))
 

@@ -28,6 +28,7 @@ from spatialflink_tpu.models.batch import GeometryBatch, PointBatch
 from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
 from spatialflink_tpu.operators.base import (
     SpatialOperator,
+    count_window_batches,
     flags_for_queries,
     jitted,
     pack_query_geometries,
@@ -150,17 +151,35 @@ class _PointStreamRangeQuery(SpatialOperator):
         radius: float,
         dtype=np.float64,
         mesh=None,
+        driver=None,
     ) -> Iterator[RangeResult]:
+        """Window loop lifted into the shared dataflow driver
+        (spatialflink_tpu/driver.py): pass ``driver=`` to OPT INTO
+        auto-checkpointing, retry-with-backoff, and device→numpy
+        failover. Without one, a strict driver reproduces the old plain
+        loop exactly — errors propagate immediately, nothing degrades.
+        """
         mesh = mesh if mesh is not None else self.mesh
         if not isinstance(query_set, (list, tuple)):
             query_set = [query_set]
         flags = flags_for_queries(self.grid, radius, query_set)
-        flags_d = jnp.asarray(flags)
-        evaluate = self._window_evaluator(query_set, flags, radius, dtype, mesh)
 
+        from spatialflink_tpu.driver import strict_driver
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
-        for win in self.windows(stream):
+        # Attach (= load any checkpoint) BEFORE touching the device: a
+        # run resumed after failover (backend "fallback") means the
+        # device path already died — often a dead tunnel, where even the
+        # setup transfers below would hang the resume at a device_put.
+        drv = driver if driver is not None else strict_driver()
+        drv.attach(self)
+        evaluate = flags_d = None
+        if drv.backend == "device":
+            flags_d = jnp.asarray(flags)
+            evaluate = self._window_evaluator(query_set, flags, radius,
+                                              dtype, mesh)
+
+        def process(win) -> RangeResult:
             # assemble → ship → compute → fetch phase spans (see
             # knn_query.run); yield outside the window span.
             with telemetry.span(
@@ -191,10 +210,55 @@ class _PointStreamRangeQuery(SpatialOperator):
                     keep, dist = telemetry.fetch((keep, dist))
                 idx = np.nonzero(keep)[0]
                 objs = [win.events[i] for i in idx]
-                out = RangeResult(
+                return RangeResult(
                     win.start, win.end, objs, dist[idx], len(win.events)
                 )
-            yield out
+
+        fallback = None
+        if self.query_kind == "point":
+            fallback = self._numpy_window_process(query_set, flags, radius,
+                                                  dtype)
+        drv.bind(self, process if drv.backend == "device" else None,
+                 fallback=fallback)
+        from spatialflink_tpu.operators.query_config import QueryType
+
+        if self.conf.query_type == QueryType.CountBased:
+            yield from drv.run_windows(count_window_batches(
+                stream, self.conf.count_window_size,
+                self.conf.count_window_size,
+            ))
+        else:
+            yield from drv.run(stream)
+
+    def _numpy_window_process(self, query_set, flags, radius, dtype):
+        """The numpy twin of the point-kind device path — the driver's
+        failover route. Same math as ops/range.py:range_points_fused on
+        the SAME centered/cast coordinates (operators/base.center_coords)
+        so a mid-stream backend switch changes no results
+        (tests/test_driver.py pins parity)."""
+        from spatialflink_tpu.operators.base import center_coords
+
+        q_host = center_coords(
+            self.grid, pack_query_points(query_set, np.float64), dtype
+        )
+        approx = self.conf.approximate_query
+
+        def process(win) -> RangeResult:
+            batch = self.point_batch(win.events)
+            n = len(win.events)
+            xy = center_coords(self.grid, batch.xy[:n], dtype)
+            d = xy[:, None, :] - q_host[None, :, :]
+            min_dist = np.sqrt(np.sum(d * d, axis=-1)).min(axis=1)
+            f = flags[batch.cell[:n]]
+            hit = (f == 1) if approx else ((f == 1) & (min_dist <= radius))
+            keep = batch.valid[:n] & ((f == 2) | hit)
+            idx = np.nonzero(keep)[0]
+            return RangeResult(
+                win.start, win.end, [win.events[i] for i in idx],
+                min_dist[idx], n,
+            )
+
+        return process
 
 
     def run_soa(

@@ -1001,19 +1001,25 @@ class TStatsQuery(SpatialOperator):
         # oid → (spatial, temporal, last_ts, last_x, last_y)
 
     def run(self, stream: Iterable[Point], dtype=np.float64,
-            mesh=None) -> Iterator[TStatsResult]:
+            mesh=None, driver=None) -> Iterator[TStatsResult]:
+        """Window loop lifted into the shared dataflow driver
+        (spatialflink_tpu/driver.py): pass ``driver=`` to OPT INTO
+        auto-checkpointing, retry-with-backoff, and device→numpy
+        failover. Without one, a strict driver reproduces the old plain
+        loop exactly — errors propagate immediately, nothing degrades.
+        """
+        from spatialflink_tpu.driver import strict_driver
         from spatialflink_tpu.operators.query_config import QueryType
 
         mesh = mesh if mesh is not None else self.mesh
         realtime = self.conf.query_type in (QueryType.RealTime, QueryType.RealTimeNaive)
         kern = jax.jit(traj_stats_kernel, static_argnames=("num_segments",))
 
-        for win in self.windows(stream):
+        def process(win) -> TStatsResult:
             if realtime:
                 # Arrival order matters: the ValueState flatmap drops
                 # out-of-order tuples as they arrive (TStatsQuery.java:118).
-                yield self._realtime_update(win, win.events)
-                continue
+                return self._realtime_update(win, win.events)
             with telemetry.span(
                 "window.tstats", start=win.start, events=len(win.events)
             ):
@@ -1050,9 +1056,61 @@ class TStatsQuery(SpatialOperator):
                     spatial, temporal, count = telemetry.fetch(
                         (res.spatial_length, res.temporal_length, res.count)
                     )
-                out = self._decode_window(win, events, spatial, temporal,
-                                          count)
-            yield out
+                return self._decode_window(win, events, spatial, temporal,
+                                           count)
+
+        if realtime:
+            # The ValueState flatmap mutates per-oid running state as it
+            # walks events — re-running a half-applied window would
+            # double-count. Mark it so a configured driver never retries
+            # it (driver.py honors `idempotent = False`); there is no
+            # fallback either, for the same reason.
+            process.idempotent = False
+        fallback = None if realtime else self._numpy_window_process(dtype)
+        drv = driver if driver is not None else strict_driver()
+        drv.bind(self, process, fallback=fallback)
+        if self.conf.query_type == QueryType.CountBased:
+            from spatialflink_tpu.operators.base import count_window_batches
+
+            yield from drv.run_windows(count_window_batches(
+                stream, self.conf.count_window_size,
+                self.conf.count_window_size,
+            ))
+        else:
+            yield from drv.run(stream)
+
+    def _numpy_window_process(self, dtype):
+        """Numpy twin of the windowed device path — the driver's failover
+        route. Same (oid, ts) sort, same centered/cast coordinates
+        (operators/base.center_coords), same segment sums, so a
+        mid-stream backend switch changes no results
+        (tests/test_driver.py pins parity)."""
+        from spatialflink_tpu.operators.base import center_coords
+
+        def process(win) -> TStatsResult:
+            events = sorted(win.events, key=lambda p: (p.obj_id, p.timestamp))
+            batch = PointBatch.from_points(events, interner=self.interner,
+                                           dtype=np.float64)
+            nseg = next_bucket(max(self.interner.num_segments, 1), minimum=64)
+            n = len(events)
+            xy = center_coords(self.grid, batch.xy[:n], dtype)
+            oid = np.asarray(batch.oid[:n], np.int64)
+            ts = np.asarray(batch.ts[:n], np.int64)
+            spatial = np.zeros(nseg, xy.dtype)
+            temporal = np.zeros(nseg, xy.dtype)
+            count = np.bincount(oid, minlength=nseg) if n else \
+                np.zeros(nseg, np.int64)
+            if n > 1:
+                same = oid[1:] == oid[:-1]
+                d = xy[1:] - xy[:-1]
+                seg_d = np.sqrt(np.sum(d * d, axis=-1))
+                np.add.at(spatial, oid[1:], np.where(same, seg_d, 0))
+                np.add.at(temporal, oid[1:],
+                          np.where(same, (ts[1:] - ts[:-1]).astype(xy.dtype),
+                                   0))
+            return self._decode_window(win, events, spatial, temporal, count)
+
+        return process
 
     def _decode_window(self, win, events, spatial, temporal, count) -> TStatsResult:
         stats = {}

@@ -54,6 +54,12 @@ class SloSpec:
       excess violates);
     - ``recompile_ceiling``: total distinct-signature compiles — bucket
       ladders are bounded, churn is not;
+    - ``retry_budget`` / ``failover_budget``: ceilings on the dataflow
+      driver's self-healing actions (driver.py) — a run that survived on
+      retries or finished on the numpy fallback is a DEGRADED run, and
+      these budgets let a spec say how much degradation still counts as
+      meeting the objective (``failover_budget: 0`` = any failover
+      violates);
     - ``eval_interval_s``: pacing of the incremental evaluation (the
       per-window cost between evaluations is counter updates only).
     """
@@ -64,6 +70,8 @@ class SloSpec:
     late_drop_budget: Optional[int] = None
     overflow_budget: Optional[int] = None
     recompile_ceiling: Optional[int] = None
+    retry_budget: Optional[int] = None
+    failover_budget: Optional[int] = None
     eval_interval_s: float = 1.0
     warmup_windows: int = 8
 
@@ -192,6 +200,14 @@ class SloEngine:
             check("recompile_ceiling", compiles,
                   f"<= {int(sp.recompile_ceiling)}",
                   compiles <= sp.recompile_ceiling)
+        if sp.retry_budget is not None:
+            retries = self.tel.driver_retries
+            check("retry_budget", retries, f"<= {int(sp.retry_budget)}",
+                  retries <= sp.retry_budget)
+        if sp.failover_budget is not None:
+            fo = self.tel.driver_failovers
+            check("failover_budget", fo, f"<= {int(sp.failover_budget)}",
+                  fo <= sp.failover_budget)
         if sp.overflow_budget is not None:
             counts: List[int] = []
             _find_overflows(self.tel.snapshot(), counts)

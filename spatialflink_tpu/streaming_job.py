@@ -134,7 +134,13 @@ def build_source(params: Params, source_arg: str) -> Iterator[Point]:
     raise ValueError(f"unknown source spec {source_arg!r}")
 
 
-def run_job(params: Params, source: Iterable[Point], sink) -> int:
+def run_job(params: Params, source: Iterable[Point], sink,
+            driver=None) -> int:
+    """Dispatch on ``query.option``. ``driver=`` (a configured
+    spatialflink_tpu.driver.WindowedDataflowDriver) routes the windowed
+    query options through the self-healing dataflow driver —
+    auto-checkpoint + exactly-once egress + retry/failover; supported
+    for the driver-wired operators (options 1 and 6)."""
     grid = params.input_stream1.make_grid()
     q = params.query
     window_conf = QueryConfiguration(
@@ -173,18 +179,34 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
         % max(window_conf.slide_step_ms, 1) == 0
     )
 
+    if driver is not None and option not in (1, 6):
+        raise SystemExit(
+            f"--checkpoint (the dataflow driver) supports query options "
+            f"1 and 6, not {option} — the remaining operators keep their "
+            "own loops until they are driver-wired"
+        )
+
     if option in (1, 2):
         conf = window_conf if option == 1 else realtime_conf
         op = PointPointRangeQuery(conf, grid, mesh=mesh)
         if option == 1 and incremental and len(q_points) == 1:
+            if driver is not None:
+                raise SystemExit(
+                    "--checkpoint is incompatible with query.incremental "
+                    "(the carry protocol is not driver-wired)"
+                )
             # The carry protocol is single-query (like the reference's
             # one incremental variant); query sets take the full path.
             results = op.query_incremental(source, q_points[0], q.radius)
         else:
-            results = op.run(source, q_points, q.radius)
+            results = op.run(source, q_points, q.radius, driver=driver)
+        # ONE home for the option-1 line format (driver.render_range_result
+        # — the same renderer the per-commit chaos gate byte-compares):
+        from spatialflink_tpu.driver import render_range_result
+
         for res in results:
-            for p, d in zip(res.objects, res.dists):
-                sink(f"{res.start},{res.end},{p.obj_id},{float(p.x)!r},{float(p.y)!r},{float(d)!r}")
+            for line in render_range_result(res):
+                sink(line)
                 n += 1
     elif option in (3, 4):
         conf = window_conf if option == 3 else realtime_conf
@@ -219,7 +241,7 @@ def run_job(params: Params, source: Iterable[Point], sink) -> int:
                     n += 1
     elif option == 6:
         op = TStatsQuery(window_conf, grid, mesh=mesh)
-        for res in op.run(source):
+        for res in op.run(source, driver=driver):
             for oid, (sp, tp, ratio) in sorted(res.stats.items()):
                 sink(f"{res.start},{res.end},{oid},{float(sp)!r},{tp},{float(ratio)!r}")
                 n += 1
@@ -256,6 +278,18 @@ def main(argv=None) -> int:
         help="stop after N input records (unbounded sources like kafka/"
              "socket run forever otherwise)",
     )
+    ap.add_argument(
+        "--checkpoint", default=None, metavar="PATH",
+        help="pipeline checkpoint file: runs through the self-healing "
+             "dataflow driver with exactly-once checkpointed egress "
+             "(requires a file --output and a REPLAYABLE --source — "
+             "csv/geojson; a run killed at any instant resumes from "
+             "PATH with byte-identical concatenated output)",
+    )
+    ap.add_argument(
+        "--checkpoint-every", type=int, default=8, metavar="N",
+        help="auto-checkpoint cadence in fired windows (default 8)",
+    )
     args = ap.parse_args(argv)
 
     params = Params.load(args.config)
@@ -264,6 +298,36 @@ def main(argv=None) -> int:
         import itertools
 
         source = itertools.islice(source, args.max_records)
+    if args.checkpoint:
+        # Exactly-once pipeline: records stage in the transactional sink
+        # and publish atomically with each driver checkpoint; on restart
+        # the driver restores operator/assembler state, truncates any
+        # uncommitted egress tail, and skips the already-consumed prefix
+        # of the (replayed) source.
+        if not args.output or args.output == "kafka" \
+                or args.output.startswith("kafka:"):
+            raise SystemExit(
+                "--checkpoint requires a file --output (the exactly-once "
+                "egress protocol is file-based)"
+            )
+        if args.source.partition(":")[0] not in ("csv", "geojson"):
+            raise SystemExit(
+                "--checkpoint requires a replayable --source "
+                "(csv:<path> or geojson:<path>) — resume replays the "
+                "consumed prefix"
+            )
+        from spatialflink_tpu.driver import WindowedDataflowDriver
+        from spatialflink_tpu.streams.sinks import TransactionalFileSink
+
+        sink = TransactionalFileSink(args.output)
+        driver = WindowedDataflowDriver(
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            sink=sink,
+        )
+        n = run_job(params, source, sink, driver=driver)
+        print(f"StreamingJob done: {n} result records", file=sys.stderr)
+        return 0
     if args.output and (args.output == "kafka"
                         or args.output.startswith("kafka:")):
         from spatialflink_tpu.streams.kafka import KafkaSink

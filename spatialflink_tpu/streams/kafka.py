@@ -27,6 +27,8 @@ from __future__ import annotations
 
 from typing import Callable, Iterator, Optional, TypeVar
 
+from spatialflink_tpu.faults import faults
+
 T = TypeVar("T")
 
 
@@ -225,6 +227,8 @@ class WireKafkaSource:
             round_msgs: list = []
             succ: dict = {}  # partition → offset → next fetch position
             for p in parts:
+                if faults.armed:  # chaos injection point (faults.py)
+                    faults.hit("kafka.fetch")
                 msgs, _hw = client.fetch(topic, p, offsets[p])
                 if msgs and msgs[0][0] > offsets[p]:
                     # The batch STARTS past our position: a log hole

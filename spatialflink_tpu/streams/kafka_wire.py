@@ -39,6 +39,8 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from spatialflink_tpu.faults import faults
+
 API_PRODUCE = 0
 API_FETCH = 1
 API_LIST_OFFSETS = 2
@@ -757,6 +759,8 @@ class KafkaWireClient:
         last: Optional[Exception] = None
         for attempt in range(3):
             try:
+                if faults.armed:  # chaos injection point (faults.py)
+                    faults.hit("kafka.leader")
                 return fn(self._leader_addr(topic, partition))
             except KafkaError as e:
                 if e.code not in _RETRIABLE:

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from spatialflink_tpu.faults import InjectedFault, faults
 
 
 class CollectSink:
@@ -72,6 +74,160 @@ class CsvFileSink:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class TransactionalFileSink:
+    """Exactly-once epoch egress — the sink half of the pipeline
+    checkpoint (driver.py).
+
+    The reference inherits Flink's two-phase-commit sinks but never
+    enables checkpointing (SURVEY §5), so its egress is effectively
+    fire-and-forget. Here records **stage in memory** per window epoch
+    (``stage``/``__call__``) and become durable only at ``commit()``:
+    one append + flush + fsync, after which the committed byte/record
+    marker is returned for the driver to embed in the SAME checkpoint as
+    the operator/ingest snapshot. The recovery invariant that makes this
+    exactly-once rather than at-least-once:
+
+    - a crash BEFORE commit loses only staged records — the resumed run
+      replays their windows and regenerates them;
+    - a crash DURING/AFTER the append but BEFORE the checkpoint publish
+      leaves a tail past the last checkpointed marker — ``restore()``
+      truncates it, and the replay regenerates those records too;
+
+    so the concatenated egress of any kill/resume sequence is
+    byte-identical to an uninterrupted run: no gap, no duplicate, at the
+    sink and not just the source (tests/test_chaos_matrix.py asserts
+    this for every registered injection point).
+
+    ``reset()`` starts a fresh file (+ optional header); ``restore()``
+    resumes from a checkpointed marker. One of them must run before the
+    first commit — the driver picks based on whether a checkpoint was
+    loaded; standalone users get an implicit ``reset()``.
+    """
+
+    def __init__(self, path: str, formatter: Callable[[Any], str] = str,
+                 header: Optional[str] = None):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.formatter = formatter
+        self.header = header
+        self._pending: List[str] = []
+        self.committed_bytes = 0
+        self.committed_records = 0
+        self.commits = 0
+        self._initialized = False
+
+    # -- staging ---------------------------------------------------------------
+
+    def stage(self, record: Any) -> None:
+        """Buffer one record for the NEXT commit (nothing touches disk)."""
+        self._pending.append(self.formatter(record))
+
+    __call__ = stage  # drop-in for the repo's callable-sink convention
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    # -- epoch lifecycle -------------------------------------------------------
+
+    def reset(self) -> None:
+        """Fresh run: truncate to empty, write the header, fsync."""
+        with open(self.path, "wb") as f:
+            if self.header:
+                f.write(self.header.rstrip("\n").encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+            self.committed_bytes = f.tell()
+        self.committed_records = 0
+        self._pending = []
+        self._initialized = True
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Resume from a checkpointed marker: any bytes past it are an
+        uncommitted tail from a crashed epoch — truncate them (the replay
+        regenerates those records). A file SHORTER than the marker means
+        committed egress was lost out-of-band: corrupt, fail loudly."""
+        from spatialflink_tpu.checkpoint import CheckpointCorruptError
+
+        committed = int(state["bytes"])
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = -1
+        if size == -1 and committed == 0:
+            # Nothing was ever committed and the file is gone — an empty
+            # epoch crashed before its first commit. Recreate empty.
+            open(self.path, "wb").close()
+            size = 0
+        if size < committed:
+            raise CheckpointCorruptError(
+                self.path,
+                f"egress file with >= {committed} committed bytes",
+                f"{size if size >= 0 else 'no file'} — committed sink "
+                "output was deleted or truncated out-of-band",
+            )
+        if size > committed:
+            with open(self.path, "r+b") as f:
+                f.truncate(committed)
+                f.flush()
+                os.fsync(f.fileno())
+        self.committed_bytes = committed
+        self.committed_records = int(state.get("records", 0))
+        self._pending = []
+        self._initialized = True
+
+    def commit(self) -> Dict[str, int]:
+        """Durably append every staged record; return the new committed
+        marker (for the driver's checkpoint). Crash-safe at any instant:
+        the marker only advances after the fsync returns, and a torn
+        append past an OLD marker is exactly what ``restore()`` repairs.
+        """
+        if not self._initialized:
+            self.reset()
+        data = b"".join(line.encode() + b"\n" for line in self._pending)
+        with open(self.path, "r+b") as f:
+            f.seek(self.committed_bytes)
+            if faults.armed:  # chaos injection point (faults.py)
+                action = faults.hit("sink.write")
+                if action == "partial_write":
+                    # Cooperative torn append: half the bytes land (and
+                    # are even fsync'd — durably torn), then the crash.
+                    f.write(data[: max(len(data) // 2, 1)])
+                    f.truncate()
+                    f.flush()
+                    os.fsync(f.fileno())
+                    raise InjectedFault("sink.write", "partial_write")
+            f.write(data)
+            f.truncate()  # clear any stale tail from a repaired crash
+            f.flush()
+            os.fsync(f.fileno())
+        self.committed_bytes += len(data)
+        self.committed_records += len(self._pending)
+        self.commits += 1
+        self._pending = []
+        return self.state()
+
+    def state(self) -> Dict[str, int]:
+        """The committed marker embedded in pipeline checkpoints."""
+        return {"bytes": self.committed_bytes,
+                "records": self.committed_records}
+
+    def close(self) -> None:
+        """Commit any staged tail (a convenience for non-checkpointed
+        use; checkpointed drivers commit through their own cadence)."""
+        if self._pending:
+            self.commit()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # Deliberately NOT committing on an exception path: staged
+        # records of a failed epoch must be lost, not published.
+        if exc[0] is None:
+            self.close()
 
 
 class LatencySink:

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from spatialflink_tpu import slo
+from spatialflink_tpu.faults import faults
 from spatialflink_tpu.telemetry import telemetry
 
 
@@ -70,6 +71,8 @@ class _SlidingAssemblerBase:
 
     def feed(self, chunk):
         """Add one chunk; return the windows that fire."""
+        if faults.armed:  # chaos injection point (faults.py)
+            faults.hit("soa.feed")
         ts = self._ingest(chunk)
         if ts is None or len(ts) == 0:
             return []

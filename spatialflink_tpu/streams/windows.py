@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Iterable, Iterator, List, Optional, Tuple, TypeVar
 
 from spatialflink_tpu import slo
+from spatialflink_tpu.faults import faults
 from spatialflink_tpu.telemetry import telemetry
 
 T = TypeVar("T")
@@ -133,6 +134,8 @@ class WindowAssembler(Generic[T]):
 
     def feed(self, event: T) -> List[WindowBatch[T]]:
         """Add one event; return any windows that fire as a result."""
+        if faults.armed:  # chaos injection point (faults.py)
+            faults.hit("window.feed")
         ts = int(self.timestamp_fn(event))
         if self._max_ts is None or ts > self._max_ts:
             self._max_ts = ts

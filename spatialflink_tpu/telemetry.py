@@ -50,6 +50,7 @@ import time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
+from spatialflink_tpu.faults import faults
 from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 
 
@@ -231,6 +232,14 @@ class Telemetry:
         self._stream_seq = 0
         self._stream_last_flush = time.monotonic()
         self.nonfinite_values = 0
+        # Fault-tolerance counters: injected-fault firings per point
+        # (faults.py) and the driver's self-healing actions (driver.py) —
+        # retries of a failed window and device→fallback failovers. All
+        # land in snapshot()["driver"]/["faults"] so sfprof health and
+        # the SLO engine's budgets can see them in any ledger.
+        self.fault_fires: Dict[str, int] = {}
+        self.driver_retries = 0
+        self.driver_failovers = 0
         # engine → {capacity bucket → {"picks", "max_live"}} — the
         # compaction control plane's pick log (ops/compaction.py).
         self._compaction: Dict[str, Dict[int, Dict[str, int]]] = {}
@@ -304,6 +313,16 @@ class Telemetry:
                              + os.path.basename(sys.argv[0] or "python")},
                 })
             self.enabled = True
+        # A plan armed BEFORE telemetry came up (the SFT_FAULT_PLAN
+        # import-time path every chaos subprocess uses) would otherwise
+        # never record its fault_armed event — emit it now so any
+        # telemetry-enabled chaos run carries the armed schedule, not
+        # just the firings (faults.arm() covers the arm-after-enable
+        # order).
+        if faults.armed:
+            self.emit_instant(
+                "fault_armed", plan=[r.to_dict() for r in faults.rules]
+            )
 
     def disable(self):
         """Stop recording and SEAL both sinks: the ledger stream gets its
@@ -537,6 +556,8 @@ class Telemetry:
         """
         import jax
 
+        if faults.armed:  # chaos injection point (faults.py)
+            faults.hit("device.fetch")
         if not self.enabled:
             return jax.device_get(x)
         t0 = time.perf_counter_ns()
@@ -844,6 +865,44 @@ class Telemetry:
         with self._lock:
             self.late_drops += int(n)
 
+    # -- fault tolerance (faults.py / driver.py) -------------------------------
+
+    def record_fault(self, point: str, kind: str = "raise", hit: int = 0):
+        """One injected fault fired. NB the telemetry↔faults cycle runs
+        ONE way: this module imports ``faults`` at module scope (for the
+        armed checks), so faults.py must reach telemetry only through
+        its lazy per-call imports — never at import time. The instant
+        event is force-flushed: a fault is exactly the record that must
+        survive the crash it is about to cause."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.fault_fires[point] = self.fault_fires.get(point, 0) + 1
+        self.emit_instant(f"fault_fired:{point}", kind=kind, hit=int(hit))
+        self.maybe_flush_stream(force=True)
+
+    def record_driver_retry(self, window_start: int, attempt: int,
+                            error: str):
+        """The driver retried a failed window on the same backend."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.driver_retries += 1
+        self.emit_instant("driver_retry", window_start=int(window_start),
+                          attempt=int(attempt), error=str(error)[:200])
+
+    def record_driver_failover(self, window_start: int, error: str):
+        """The driver switched device → fallback backend mid-stream.
+        Force-flushed for the same reason as faults: the failover marker
+        must survive whatever killed the device path."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.driver_failovers += 1
+        self.emit_instant("failover", window_start=int(window_start),
+                          to="fallback", error=str(error)[:200])
+        self.maybe_flush_stream(force=True)
+
     # -- export ---------------------------------------------------------------
 
     def register_metrics(self, registry):
@@ -895,7 +954,15 @@ class Telemetry:
                     eng: {str(cap): dict(st) for cap, st in caps.items()}
                     for eng, caps in self._compaction.items()
                 },
+                # Self-healing visibility: always present so sfprof
+                # health / SLO budgets can gate on zero, not on absence.
+                driver={
+                    "retries": self.driver_retries,
+                    "failovers": self.driver_failovers,
+                },
             )
+            if self.fault_fires:
+                out["faults"] = dict(self.fault_fires)
         link = self.link_gauges()
         if link is not None:
             out["link_probe"] = link
@@ -1082,6 +1149,10 @@ def instrument_jit(fn, name: Optional[str] = None):
     so ``telemetry.capture_costs()`` can lower/compile host-side later —
     nothing device-facing happens on the call path. Attributes of the
     underlying jit object (``lower``, …) pass through.
+
+    This is also the ``device.dispatch`` chaos injection point
+    (faults.py): it lives HERE — not in ``jitted`` — so the mesh window
+    programs and bench steps that skip ``jitted`` are injectable too.
     """
     label = name or getattr(fn, "__name__", repr(fn))
 
@@ -1089,6 +1160,8 @@ def instrument_jit(fn, name: Optional[str] = None):
         __slots__ = ()
 
         def __call__(self, *args, **kwargs):
+            if faults.armed:  # chaos injection point (faults.py)
+                faults.hit("device.dispatch")
             if not telemetry.enabled:
                 return fn(*args, **kwargs)
             sig = abstract_signature(args, kwargs)

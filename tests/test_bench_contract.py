@@ -582,3 +582,56 @@ class TestTelemetryBlock:
                             "--gate"]) != 0
         # The post-bench health check (CLAUDE.md) passes on a clean run.
         assert sfprof_main(["health", str(ledger)]) == 0
+
+
+class TestDialDeadline:
+    """ISSUE 8 satellite: the r3–r5 "hang at the dial" mode is bounded by
+    SFT_DIAL_DEADLINE_S — the child prints the one-line failure record
+    AND seals the ledger stream with reason ``dial_timeout`` instead of
+    hanging until the supervisor's full deadline."""
+
+    def test_dial_timeout_prints_record_and_seals_stream(self, tmp_path):
+        stream = tmp_path / "dial_stream.jsonl"
+        env = {
+            **os.environ,
+            "SFT_BENCH_CHILD": "1",  # direct child: the watchdog's path
+            "SFT_BENCH_SMOKE": "1",
+            "SFT_BENCH_LAST_GOOD": str(tmp_path / "lg.json"),
+            "SFT_LEDGER_STREAM": str(stream),
+            "SFT_DIAL_DEADLINE_S": "8",
+            # Simulated half-open tunnel: device discovery succeeds,
+            # the first device op never completes.
+            "SFT_BENCH_DIAL_HANG": "300",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        p = subprocess.run(
+            [sys.executable, BENCH], env=env, capture_output=True,
+            text=True, timeout=100,
+        )
+        assert p.returncode == 3
+        lines = [ln for ln in p.stdout.strip().splitlines()
+                 if ln.startswith("{")]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["value"] == 0
+        assert "SFT_DIAL_DEADLINE_S" in rec["error"]
+        # The stream is sealed with the dial_timeout reason, so `sfprof
+        # recover` attributes the loss instead of guessing.
+        from tools.sfprof import stream as stream_mod
+
+        doc, info = stream_mod.recover(str(stream))
+        assert info["sealed"] is True
+        assert info["reason"] == "dial_timeout"
+
+    def test_healthy_smoke_run_unaffected_by_deadline(self, tmp_path):
+        """With no hang, the watchdog disarms at the warm-up fetch and a
+        tight-but-sane deadline changes nothing (the acceptance
+        criterion: the SFT_BENCH_SMOKE contract run is unchanged)."""
+        p, lines, _ = _run(
+            tmp_path,
+            {"SFT_BENCH_SMOKE": "1", "SFT_DIAL_DEADLINE_S": "90"},
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        rec = json.loads(lines[-1])
+        assert rec["smoke"] is True and rec["value"] > 0

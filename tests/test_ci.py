@@ -22,12 +22,15 @@ def test_dry_run_lists_all_stages(capsys):
     assert "[sfcheck]" in out
     assert "[pytest-quick]" in out
     assert "[bench-smoke+health]" in out
+    assert "[chaos-smoke]" in out
     plain = out.replace(sys.executable, "py")
     assert "tools.sfprof health" in plain
     # The crash-recovery round trip: recover the stream the smoke run
     # wrote, then health-gate the recovered ledger.
     assert "tools.sfprof recover" in plain
     assert plain.count("tools.sfprof health") == 2
+    # The kill/resume chaos round trip rides every commit too.
+    assert "spatialflink_tpu.driver --chaos-smoke" in plain
 
 
 def test_skip_flags_trim_stages(capsys):
@@ -35,6 +38,12 @@ def test_skip_flags_trim_stages(capsys):
     out = capsys.readouterr().out
     assert "[sfcheck]" in out
     assert "pytest" not in out and "bench" not in out
+    # --skip-bench does NOT drop the chaos smoke (CPU-only, independent
+    # of the bench stage); only --skip-chaos does.
+    assert "[chaos-smoke]" in out
+    assert ci.main(["--dry-run", "--skip-tests", "--skip-bench",
+                    "--skip-chaos"]) == 0
+    assert "chaos" not in capsys.readouterr().out
 
 
 def test_changed_flag_passes_through(capsys):
@@ -79,11 +88,14 @@ def test_all_green_runs_every_stage(monkeypatch):
     assert any("bench.py" in c for c in calls)
     assert any("tools.sfprof health" in c for c in calls)
     assert any("tools.sfprof recover" in c for c in calls)
+    assert any("spatialflink_tpu.driver --chaos-smoke" in c for c in calls)
     # recover targets the stream the bench env configured, and the
     # recovered ledger is health-gated too (2 health invocations).
     assert sum("tools.sfprof health" in c for c in calls) == 2
-    # every stage env disarms the axon dial
+    # every stage env disarms the axon dial AND any ambient fault plan
+    # (an armed abort plan would kill healthy stages like a real kill -9)
     assert all(e["PALLAS_AXON_POOL_IPS"] == "" for e in envs)
+    assert all("SFT_FAULT_PLAN" not in e for e in envs)
     bench_env = envs[[i for i, c in enumerate(calls)
                       if "bench.py" in c][0]]
     assert bench_env["SFT_BENCH_SMOKE"] == "1"

@@ -378,6 +378,16 @@ class FakeBroker:
         # (topic, partition) → offsets DELETED by log compaction: they
         # stay in the offset sequence but never appear in a fetch.
         self.holes: dict = {}
+        # Fault hooks (leader-retry regression tests): ``kill_after_bytes``
+        # sends only that many bytes of the NEXT fetch response frame and
+        # then kills the connection (a broker dying mid-fetch);
+        # ``fetch_errors`` pops one error code per fetch and returns it in
+        # the partition response (e.g. 6 = NOT_LEADER — a leader change).
+        # Both one-shot-per-entry so the client's retry can succeed.
+        self.kill_after_bytes: int = 0
+        self.fetch_errors: list = []
+        self.metadata_requests = 0
+        self.fetch_requests = 0
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("127.0.0.1", 0))
@@ -419,7 +429,15 @@ class FakeBroker:
                 r.string()  # client_id
                 body = self._dispatch(api, ver, r)
                 resp = struct.pack(">i", corr) + body
-                conn.sendall(struct.pack(">i", len(resp)) + resp)
+                frame = struct.pack(">i", len(resp)) + resp
+                if api == kw.API_FETCH and self.kill_after_bytes:
+                    # Die mid-response: N bytes of the frame land, then
+                    # the socket closes under the client's recv.
+                    n, self.kill_after_bytes = self.kill_after_bytes, 0
+                    conn.sendall(frame[:n])
+                    conn.close()
+                    return
+                conn.sendall(frame)
         except OSError:
             pass
         finally:
@@ -447,6 +465,7 @@ class FakeBroker:
 
     def _dispatch(self, api, ver, r):
         if api == kw.API_METADATA:
+            self.metadata_requests += 1
             topics = [r.string() for _ in range(r.int32())]
             parts = [
                 struct.pack(">hiii", 0, p, 0, 1) + struct.pack(">i", 0)
@@ -483,6 +502,8 @@ class FakeBroker:
                     )
             return kw.enc_array(out_topics) + struct.pack(">i", 0)
         if api == kw.API_FETCH:
+            self.fetch_requests += 1
+            err_code = self.fetch_errors.pop(0) if self.fetch_errors else 0
             r.int32(), r.int32(), r.int32()  # replica, max_wait, min_bytes
             out_topics = []
             for _ in range(r.int32()):
@@ -491,6 +512,15 @@ class FakeBroker:
                     pid = r.int32()
                     off = r.int64()
                     r.int32()  # max_bytes
+                    if err_code:
+                        out_topics.append(
+                            kw.enc_string(topic)
+                            + kw.enc_array([
+                                struct.pack(">ihq", pid, err_code, -1)
+                                + kw.enc_bytes(b"")
+                            ])
+                        )
+                        continue
                     log = self.log(topic, pid)
                     holes = self.holes.get((topic, pid), ())
                     msgs = []
@@ -981,3 +1011,96 @@ def test_wire_source_skips_malformed(broker, monkeypatch):
                      parser=parse_csv_point), 2,
     ))
     assert [p.obj_id for p in got] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# _with_leader_retry under injected transport faults (ISSUE 8 satellite):
+# a broker dying mid-fetch and a leader change must both retry and
+# resume at the correct offset — every record delivered exactly once.
+
+
+def test_mid_fetch_socket_drop_retries_at_same_offset_no_dup(broker):
+    """The broker kills the connection after 7 bytes of the fetch
+    response frame: the client sees a short read (OSError), drops the
+    socket, and _with_leader_retry refetches the SAME offset on a fresh
+    connection — no record lost, none duplicated."""
+    client = kw.KafkaWireClient(f"127.0.0.1:{broker.port}")
+    client.produce("drop", 0, [(f"r{i}".encode(), None, i) for i in range(8)])
+    broker.kill_after_bytes = 7  # dies inside the first fetch response
+    msgs, hw = client.fetch("drop", 0, 0)
+    assert hw == 8
+    assert [m[0] for m in msgs] == list(range(8))
+    assert [m[3] for m in msgs] == [f"r{i}".encode() for i in range(8)]
+    client.close()
+
+
+def test_mid_fetch_drop_through_source_yields_each_record_once(broker):
+    """End to end through WireKafkaSource: the drop lands between two
+    consumed batches, and the stream still yields every record exactly
+    once in order (the checkpointed-offsets contract survives transport
+    faults, not just clean runs)."""
+    from spatialflink_tpu.streams.kafka import WireKafkaSource
+
+    client = kw.KafkaWireClient(f"127.0.0.1:{broker.port}")
+    client.produce("dropsrc", 0,
+                   [(f"a{i}".encode(), None, i) for i in range(5)])
+    src = WireKafkaSource("dropsrc", f"127.0.0.1:{broker.port}",
+                          parser=str)
+    it = iter(src)
+    got = [next(it) for _ in range(5)]
+    # Arm the mid-frame kill for the NEXT fetch, then extend the log.
+    broker.kill_after_bytes = 5
+    client.produce("dropsrc", 0,
+                   [(f"b{i}".encode(), None, 5 + i) for i in range(5)])
+    got += [next(it) for _ in range(5)]
+    assert got == [f"a{i}" for i in range(5)] + [f"b{i}" for i in range(5)]
+    assert src.offsets == {0: 10}  # resumed at the correct position
+    client.close()
+    src.close()
+
+
+def test_leader_change_refreshes_metadata_and_resumes(broker):
+    """Error 6 (NOT_LEADER) on a fetch: the client must drop its cached
+    leader, re-query metadata, and refetch the same offset — the
+    reference gets this from the Flink Kafka connector; the built-in
+    client must match it."""
+    client = kw.KafkaWireClient(f"127.0.0.1:{broker.port}")
+    client.produce("lead", 0, [(f"x{i}".encode(), None, i) for i in range(6)])
+    before = broker.metadata_requests
+    broker.fetch_errors = [6]  # one leader change
+    msgs, _hw = client.fetch("lead", 0, 2)
+    assert [m[0] for m in msgs] == [2, 3, 4, 5]
+    assert [m[3] for m in msgs] == [f"x{i}".encode() for i in range(2, 6)]
+    assert broker.metadata_requests > before  # leader table was refreshed
+    assert broker.fetch_requests >= 2  # the failed try + the retry
+    client.close()
+
+
+def test_leader_retry_budget_exhausts_loudly(broker):
+    """A leader that NEVER comes back must surface the KafkaError after
+    the bounded retries — not spin forever (the r3–r5 lesson: bounded
+    beats hung)."""
+    client = kw.KafkaWireClient(f"127.0.0.1:{broker.port}")
+    client.produce("dead", 0, [(b"v", None, 0)])
+    broker.fetch_errors = [6, 6, 6, 6, 6]  # outlives the 3-attempt budget
+    with pytest.raises(kw.KafkaError):
+        client.fetch("dead", 0, 0)
+    client.close()
+
+
+def test_injected_kafka_leader_fault_is_not_retried(broker):
+    """faults.py chaos contract: an InjectedFault at kafka.leader is
+    NOT a retriable transport error — it must propagate immediately so
+    chaos runs crash deterministically at the armed hit."""
+    from spatialflink_tpu.faults import InjectedFault, faults
+
+    client = kw.KafkaWireClient(f"127.0.0.1:{broker.port}")
+    client.produce("chaos", 0, [(b"v", None, 0)])
+    faults.arm([{"point": "kafka.leader", "at": 1}])
+    try:
+        with pytest.raises(InjectedFault):
+            client.fetch("chaos", 0, 0)
+        assert broker.fetch_requests == 0  # died before any attempt
+    finally:
+        faults.disarm()
+        client.close()

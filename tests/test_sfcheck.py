@@ -609,3 +609,56 @@ def test_reporter_line_numpy_safe(tmp_path):
     assert line.startswith("METRICS ts=")
     assert "np." not in line
     assert "eps_in_avg=5.00" in line
+
+
+def test_fault_tolerance_modules_are_in_pass_scope():
+    """ISSUE 8 satellite pin: the fault-tolerance layer joined the
+    sfcheck scopes — fstring-numpy (driver/faults render egress lines
+    and fault events), sync-discipline (tree-wide already, pinned
+    explicitly), and hotpath's import-purity rule (module-scope eager
+    jnp would be an import-time tunnel dial — the one thing faults.py
+    exists to survive). The wall-clock rule stays ops/-only: retry
+    backoff and the hang kind legitimately read the clock."""
+    fstr = get_pass("fstring-numpy")
+    assert fstr.applies_to("spatialflink_tpu/driver.py")
+    assert fstr.applies_to("spatialflink_tpu/faults.py")
+    sync = get_pass("sync-discipline")
+    assert sync.applies_to("spatialflink_tpu/driver.py")
+    assert sync.applies_to("spatialflink_tpu/faults.py")
+    hp = get_pass("hotpath")
+    assert hp.applies_to("spatialflink_tpu/driver.py")
+    assert hp.applies_to("spatialflink_tpu/faults.py")
+    assert not hp.applies_to("spatialflink_tpu/streaming_job.py")
+
+    # Import-purity finding fires in the fault-tolerance modules...
+    src = """
+        import jax.numpy as jnp
+        BAD = jnp.zeros(4)
+    """
+    findings = _check(src, "hotpath", name="spatialflink_tpu/driver.py")
+    assert len(findings) == 1 and "module-level" in findings[0].message
+    # ...but the wall-clock rule does not (host control plane).
+    src = """
+        import time
+
+        def backoff():
+            return time.monotonic()
+    """
+    assert _check(src, "hotpath",
+                  name="spatialflink_tpu/driver.py") == []
+    assert len(_check(src, "hotpath",
+                      name="spatialflink_tpu/ops/k.py")) == 1
+
+
+def test_fault_tolerance_modules_are_clean():
+    """The new modules pass their own scopes with zero findings."""
+    report = core.run_paths(
+        [os.path.join(REPO, "spatialflink_tpu", "driver.py"),
+         os.path.join(REPO, "spatialflink_tpu", "faults.py")],
+        [get_pass("hotpath"), get_pass("fstring-numpy"),
+         get_pass("sync-discipline")],
+        force_files=True,
+    )
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )

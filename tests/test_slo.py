@@ -255,3 +255,36 @@ def test_posthoc_evaluate_matches_live_semantics(tmp_path):
     doc = {"snapshot": {"max_watermark_lag_ms": 50}, "bench": None}
     rows = {r[0]: r for r in sfprof_slo.evaluate(spec, doc)}
     assert rows["slo:watermark_lag_p99_ms"][3] is False
+
+
+def test_driver_budgets_live_and_posthoc_twin():
+    """ISSUE 8: retry_budget/failover_budget — the live engine reads the
+    telemetry driver counters; the post-hoc twin reads the ledger's
+    snapshot.driver block; a spec naming them against a pre-driver
+    ledger fails on silence (the eps_floor rule)."""
+    from spatialflink_tpu.telemetry import telemetry
+    from tools.sfprof import slo as sfprof_slo
+
+    telemetry.enable()
+    try:
+        telemetry.record_driver_retry(0, 1, "err")
+        telemetry.record_driver_failover(0, "err")
+        eng = slo.SloEngine(slo.SloSpec(retry_budget=1, failover_budget=0,
+                                        eval_interval_s=0.0))
+        rows = {r["check"]: r for r in eng.evaluate()}
+        assert rows["retry_budget"]["ok"] is True
+        assert rows["failover_budget"]["ok"] is False
+
+        doc = {"snapshot": telemetry.snapshot(), "bench": {}}
+        prows = dict(
+            (name, ok) for name, _v, _b, ok in sfprof_slo.evaluate(
+                {"retry_budget": 1, "failover_budget": 0}, doc)
+        )
+        assert prows["slo:retry_budget"] is True
+        assert prows["slo:failover_budget"] is False
+        # silence fails: a ledger without the driver block cannot pass
+        srows = sfprof_slo.evaluate({"failover_budget": 5},
+                                    {"snapshot": {}, "bench": {}})
+        assert srows[0][3] is False
+    finally:
+        telemetry.disable()

@@ -1,6 +1,6 @@
 """THE pre-commit gate: ``python -m tools.ci`` (repo root).
 
-One shot, three stages, fail-fast, distinct banners:
+One shot, four stages, fail-fast, distinct banners:
 
 1. **sfcheck** — the whole-program static analyzer (all ten passes;
    ``--changed`` passes the incremental flag through for the sub-second
@@ -15,12 +15,19 @@ One shot, three stages, fail-fast, distinct banners:
    drops, watermark lag), then the crash-recovery round trip:
    ``sfprof recover <stream>`` → ``sfprof health <recovered>`` — every
    commit proves the durable capture path still reconstructs a
-   gateable ledger.
+   gateable ledger;
+4. **chaos smoke** — ``python -m spatialflink_tpu.driver
+   --chaos-smoke``: a toy driver pipeline killed mid-run by an armed
+   ``abort`` fault (``os._exit(137)``, the SIGKILL analog) and resumed
+   from its checkpoint — the concatenated exactly-once egress must be
+   byte-identical to a clean run.
 
 Exit code: the first failing stage's (sfcheck keeps its 0/1/2/3
 contract; pytest and sfprof theirs). ``--skip-tests`` / ``--skip-bench``
-trim stages for quick iteration; ``--dry-run`` prints the stage commands
-without running anything (pinned by tests/test_ci.py).
+/ ``--skip-chaos`` trim stages for quick iteration (the chaos smoke is
+CPU-only and independent of the bench stage, so ``--skip-bench`` keeps
+it); ``--dry-run`` prints the stage commands without running anything
+(pinned by tests/test_ci.py).
 """
 
 from __future__ import annotations
@@ -42,10 +49,15 @@ def _cpu_env() -> Dict[str, str]:
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("SFT_BENCH_CHILD", None)
+    # An ambient fault plan (left over from chaos-test iteration) would
+    # arm EVERY stage's subprocesses at import (faults.arm_from_env) and
+    # fail a healthy tree with injected faults — the gate runs disarmed.
+    env.pop("SFT_FAULT_PLAN", None)
     return env
 
 
 def stages(changed: bool, skip_tests: bool, skip_bench: bool,
+           skip_chaos: bool = False,
            ledger_path: Optional[str] = None,
            stream_path: Optional[str] = None) \
         -> List[Tuple[str, List[List[str]]]]:
@@ -77,6 +89,14 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
              "-o", recovered],
             [py, "-m", "tools.sfprof", "health", recovered],
         ]))
+    if not skip_chaos:
+        # Chaos smoke: one kill (armed abort fault = SIGKILL analog) →
+        # resume round trip on toy shapes, asserting byte-identical
+        # exactly-once egress (spatialflink_tpu/driver.py). CPU-only and
+        # independent of the bench stage, so --skip-bench keeps it.
+        out.append(("chaos-smoke", [
+            [py, "-m", "spatialflink_tpu.driver", "--chaos-smoke"],
+        ]))
     return out
 
 
@@ -97,7 +117,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.ci",
         description="pre-commit gate: sfcheck → quick pytest → "
-                    "bench smoke + sfprof health",
+                    "bench smoke + sfprof health → chaos smoke",
     )
     ap.add_argument("--changed", action="store_true",
                     help="incremental sfcheck (--changed cache mode)")
@@ -105,6 +125,8 @@ def main(argv=None) -> int:
                     help="skip the quick-tier pytest stage")
     ap.add_argument("--skip-bench", action="store_true",
                     help="skip the bench-smoke + sfprof health stage")
+    ap.add_argument("--skip-chaos", action="store_true",
+                    help="skip the kill/resume chaos-smoke stage")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the stage commands and exit 0")
     args = ap.parse_args(argv)
@@ -113,6 +135,7 @@ def main(argv=None) -> int:
         ledger = os.path.join(tmpdir, "ledger.json")
         stream = os.path.join(tmpdir, "ledger_stream.jsonl")
         plan = stages(args.changed, args.skip_tests, args.skip_bench,
+                      args.skip_chaos,
                       ledger_path=ledger, stream_path=stream)
         if args.dry_run:
             for name, cmds in plan:

@@ -22,9 +22,10 @@ from tools.sfcheck.passes._shared import Bindings, ScopedVisitor, dotted
 
 
 class _Visitor(ScopedVisitor):
-    def __init__(self, bindings: Bindings):
+    def __init__(self, bindings: Bindings, check_wall_clock: bool = True):
         super().__init__()
         self.b = bindings
+        self.check_wall_clock = check_wall_clock
 
     def visit_Call(self, node):
         if self.fn_depth == 0 and self.b.jnp_call(node.func) is not None:
@@ -34,7 +35,8 @@ class _Visitor(ScopedVisitor):
                 "runs eagerly at import (un-jitted XLA dispatch; use "
                 "numpy for host constants, jit for device code)",
             ))
-        if self.fn_depth > 0 and self.b.wall_clock_call(node.func) is not None:
+        if self.check_wall_clock and self.fn_depth > 0 \
+                and self.b.wall_clock_call(node.func) is not None:
             self.out.append((
                 node,
                 f"wall-clock call `{dotted(node.func)}(…)` inside an "
@@ -53,10 +55,23 @@ class HotpathPass(Pass):
     allow_basenames = frozenset({"counters.py"})
     legacy_pragma = re.compile(r"#\s*hotpath:\s*ok\b")
 
+    #: Host-side fault-tolerance modules: module-scope eager jnp would be
+    #: an import-time XLA dispatch (and an import-time TUNNEL DIAL — the
+    #: one thing the fault layer exists to survive), so the import-purity
+    #: rule covers them too. The wall-clock rule stays ops/-only: the
+    #: driver's retry backoff and the injector's hang kind legitimately
+    #: read the clock (they are host control plane, never traced).
+    _HOST_FT_MODULES = ("spatialflink_tpu/driver.py",
+                        "spatialflink_tpu/faults.py")
+
     def applies_to(self, relpath: str) -> bool:
-        return relpath.startswith("spatialflink_tpu/ops/")
+        return (relpath.startswith("spatialflink_tpu/ops/")
+                or relpath in self._HOST_FT_MODULES)
 
     def run(self, ctx):
-        v = _Visitor(ctx.bindings)
+        v = _Visitor(
+            ctx.bindings,
+            check_wall_clock=ctx.relpath not in self._HOST_FT_MODULES,
+        )
         v.visit(ctx.tree)
         return v.out

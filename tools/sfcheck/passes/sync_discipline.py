@@ -7,8 +7,12 @@ The only true synchronization is a real device→host fetch
 that doesn't fetch measures nothing and pushes its cost into the NEXT
 measurement (the bogus 106M pts/s bug). The ban covers everything —
 bench.py, the driver entry, the tests, the SLO engine
-(``spatialflink_tpu/slo.py``), and the sfprof stream/recover modules —
-except ``spatialflink_tpu/telemetry.py``, the ONE module allowed to
+(``spatialflink_tpu/slo.py``), the sfprof stream/recover modules, and
+the fault-tolerance layer (``spatialflink_tpu/driver.py``'s retry/
+failover paths and ``spatialflink_tpu/faults.py`` — a "sync" before a
+checkpoint commit that doesn't fetch would checkpoint un-finished
+state) — except ``spatialflink_tpu/telemetry.py``, the ONE module
+allowed to
 talk about sync primitives directly (which is also why the link-health
 probe, whose fetch IS its measurement, lives there and nowhere else).
 """

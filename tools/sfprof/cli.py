@@ -413,6 +413,18 @@ def cmd_health(args) -> int:
         failed += 0 if ok else 1
         print(f"{'ok  ' if ok else 'FAIL'} {name:<34} "
               f"{_fmt_num(value):<12} [{band}]")
+    # Self-healing visibility (informational — a run that SURVIVED on
+    # retries/fallback is degraded, not failed; budget it via an --slo
+    # spec's retry_budget/failover_budget to make it gate):
+    drv = snap.get("driver") or {}
+    if drv.get("retries") or drv.get("failovers"):
+        print(f"note driver self-healing: "
+              f"retries={int(drv.get('retries') or 0)} "
+              f"failovers={int(drv.get('failovers') or 0)}")
+    if snap.get("faults"):
+        fired = ", ".join(f"{k}×{int(v)}"
+                          for k, v in sorted(snap["faults"].items()))
+        print(f"note injected faults fired (chaos run): {fired}")
     print(f"{len(checks)} checks, {int(failed)} failed")
     return 1 if failed else 0
 

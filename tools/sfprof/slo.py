@@ -17,6 +17,10 @@ Metric sources in the ledger document:
   pass on silence — the ``diff`` lost-metric rule);
 - ``late_drop_budget`` → snapshot ``late_dropped``;
 - ``recompile_ceiling`` → snapshot ``compiles``;
+- ``retry_budget`` / ``failover_budget`` → snapshot ``driver`` block
+  (``retries``/``failovers`` — the dataflow driver's self-healing
+  counters); a spec budgeting them against a pre-driver ledger FAILS on
+  silence, same rule as ``eps_floor``;
 - ``overflow_budget`` → every ``*overflow*`` counter in the bench block
   and snapshot, summed.
 
@@ -37,8 +41,8 @@ SLO_VERSION = 1
 #: post-hoc pass accepts and ignores.
 SPEC_KEYS = (
     "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
-    "overflow_budget", "recompile_ceiling", "eval_interval_s",
-    "warmup_windows",
+    "overflow_budget", "recompile_ceiling", "retry_budget",
+    "failover_budget", "eval_interval_s", "warmup_windows",
 )
 
 
@@ -123,6 +127,25 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
         compiles = _num(snap.get("compiles")) or 0.0
         rows.append(("slo:recompile_ceiling", compiles,
                      f"<= {int(ceiling)}", compiles <= ceiling))
+
+    drv = snap.get("driver") or {}
+    budget = _num(spec.get("retry_budget"))
+    if budget is not None:
+        retries = _num(drv.get("retries"))
+        rows.append((
+            "slo:retry_budget", retries, f"<= {int(budget)}",
+            # A spec budgeting retries against a ledger that predates the
+            # driver block fails on silence (the eps_floor rule).
+            retries is not None and retries <= budget,
+        ))
+
+    budget = _num(spec.get("failover_budget"))
+    if budget is not None:
+        fo = _num(drv.get("failovers"))
+        rows.append((
+            "slo:failover_budget", fo, f"<= {int(budget)}",
+            fo is not None and fo <= budget,
+        ))
 
     budget = _num(spec.get("overflow_budget"))
     if budget is not None:
