@@ -497,6 +497,22 @@ def main() -> None:
             slo_mod.SloEngine(slo_mod.SloSpec.from_file(_spec_path))
         )
 
+    # Overload control (SFT_OVERLOAD_POLICY=<inline JSON | policy.json>):
+    # installs the process-global controller so chip captures get the
+    # degradation ladder (SLO violations step it down), the counters
+    # ride snapshot()["overload"] into the record/ledger/stream, and a
+    # shed_budget/degraded_window_budget spec can gate the run.
+    overload_ctrl = None
+    _ov_spec = _os.environ.get("SFT_OVERLOAD_POLICY")
+    if _ov_spec:
+        from spatialflink_tpu import overload as overload_mod
+
+        overload_ctrl = overload_mod.install(
+            overload_mod.OverloadController(
+                overload_mod.OverloadPolicy.from_env(_ov_spec)
+            )
+        )
+
     grid = UniformGrid(**BEIJING_GRID_ARGS)
     wf = WireFormat.for_grid(grid)
     q = np.asarray(QUERY_POINT, np.float32)
@@ -801,6 +817,8 @@ def main() -> None:
         out["link_probe"] = link
     if slo_engine is not None:
         out["slo"] = slo_engine.verdict()
+    if overload_ctrl is not None:
+        out["overload"] = overload_ctrl.snapshot()
     if smoke:
         out["smoke"] = True
     # Measured CPU-backend throughput of the same fused program on this

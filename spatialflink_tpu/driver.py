@@ -22,12 +22,20 @@ owns:
 - **graceful degradation**: when retries exhaust and a ``fallback``
   window processor exists (the numpy/native route that
   ``traj_stats_sliding``/``panes.py`` already expose for the pane
-  engines, and the numpy twins the range/tstats operators provide), the
-  driver fails over for the rest of the run — emitting a ``failover``
-  instant event and counting in ``snapshot()["driver"]`` so `sfprof
-  health` and the SLO engine (``failover_budget``/``retry_budget``) can
-  budget it. Results must be identical across the switch
-  (tests/test_driver.py asserts parity).
+  engines, and the numpy twins the range/tstats/knn operators provide),
+  the driver fails over for the rest of the run — emitting a
+  ``failover`` instant event and counting in ``snapshot()["driver"]``
+  so `sfprof health` and the SLO engine
+  (``failover_budget``/``retry_budget``) can budget it. Results must be
+  identical across the switch (tests/test_driver.py asserts parity);
+- **overload control** (``overload=`` — an
+  :class:`spatialflink_tpu.overload.OverloadController`): bounded
+  admission with backpressure/shedding on every pulled item, the
+  device-path circuit breaker (whole windows to the twin while open, a
+  half-open probe re-dials on a bounded schedule — the temporary
+  generalization of the permanent failover above), and overload state
+  published with each checkpoint so a resume replays the exact shed
+  schedule. ``None`` (the default) changes nothing.
 
 Resume contract: the driver records ``events_consumed`` in each
 checkpoint; on resume with a REPLAYABLE source (file/collection — the
@@ -47,6 +55,7 @@ egress equality — tools/ci runs it as the chaos smoke stage.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from dataclasses import dataclass
@@ -118,7 +127,9 @@ class WindowedDataflowDriver:
                  extra_state: Optional[Callable[[], Dict[str, Any]]] = None,
                  skip_on_resume: bool = True,
                  flush_at_end: bool = True,
-                 failover: bool = True):
+                 failover: bool = True,
+                 overload=None,
+                 source_pausable: Optional[bool] = None):
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.sink = sink
@@ -131,6 +142,20 @@ class WindowedDataflowDriver:
         #: what a parity-critical capture wants, and what the chaos
         #: matrix uses to force crash semantics at every point.
         self.failover = failover
+        #: Optional :class:`spatialflink_tpu.overload.OverloadController`
+        #: — bounded admission (shed/backpressure) on every item this
+        #: driver pulls, the device-path circuit breaker in
+        #: ``_process_window``, and overload state published with each
+        #: checkpoint (so a resumed run replays the exact shed
+        #: schedule). ``None`` (the default, incl. ``strict_driver``)
+        #: changes nothing.
+        self.overload = overload
+        #: Whether the source can absorb backpressure (data safe at the
+        #: source). ``None`` defaults to ``skip_on_resume`` — replayable
+        #: sources pause, non-replayable ones shed.
+        self.source_pausable = (bool(skip_on_resume)
+                                if source_pausable is None
+                                else bool(source_pausable))
         self.op = None
         self.process: Optional[Callable] = None
         self.fallback: Optional[Callable] = None
@@ -206,6 +231,11 @@ class WindowedDataflowDriver:
                 self.sink.restore(ck["egress"])
             else:
                 self.sink.reset()
+        if self.overload is not None and "overload" in ck:
+            # Shed decisions are a function of controller state + the
+            # stream — restoring the state replays the exact shed
+            # schedule of an uninterrupted run past the skip point.
+            self.overload.restore(ck["overload"])
         self.stats["resumed"] = True
         self.loaded_checkpoint = ck
 
@@ -240,9 +270,10 @@ class WindowedDataflowDriver:
                 "to record) — use run()/run_soa() for resumable pipelines"
             )
         self._reset_fresh_sink()
-        for win in windows:
-            yield self._process_window(win)
-        self._commit_sink_only()
+        with self._installed_controller():
+            for win in windows:
+                yield self._process_window(win)
+            self._commit_sink_only()
 
     def _reset_fresh_sink(self) -> None:
         if getattr(self, "_sink_fresh", False):
@@ -250,39 +281,109 @@ class WindowedDataflowDriver:
             if self.sink is not None and hasattr(self.sink, "reset"):
                 self.sink.reset()
 
-    def _drive(self, source, feed, flush) -> Iterator:
+    def run_precomputed(self, windows: Iterable) -> Iterator:
+        """Deterministically re-computable window batches (the pane-scan
+        engines, e.g. ``TJoinQuery.run_soa_panes``): the checkpointed
+        position counts WINDOWS, and a resume — after the caller re-runs
+        the upstream recompute over the replayed bounded stream — skips
+        the already-committed prefix. Retry/failover apply per window
+        like everywhere else. Admission control does NOT apply —
+        these items are fired WINDOWS, not ingest; shedding one would
+        silently drop results rather than load."""
+        yield from self._drive(windows, lambda w: [w], None, admit=False)
+
+    @contextlib.contextmanager
+    def _installed_controller(self):
+        """The driver's controller becomes the process-global one for
+        the run (the fire-site hooks and rung-effect getters read the
+        module slot). A controller installed BEFORE the run (e.g.
+        bench's SFT_OVERLOAD_POLICY global) is restored when the loop
+        ends; otherwise the driver's stays installed — the ledger
+        seal and the post-run SLO verdict read the module slot, and
+        uninstalling to None would turn the run's real shed counters
+        into a silence-fails budget violation (tests clean the slot
+        via overload.uninstall())."""
+        from spatialflink_tpu import overload as overload_mod
+
+        prev = overload_mod.controller()
+        if self.overload is not None and prev is not self.overload:
+            overload_mod.install(self.overload)
+        try:
+            yield
+        finally:
+            if (self.overload is not None and prev is not None
+                    and prev is not self.overload):
+                overload_mod.install(prev)
+
+    def _drive(self, source, feed, flush, admit: bool = True) -> Iterator:
         self._reset_fresh_sink()
-        it = iter(source)
-        if self._skip:
-            # Resume: the first `events_consumed` records are already
-            # reflected in the restored assembler/operator state.
-            next(itertools.islice(it, self._skip - 1, self._skip), None)
-            self._skip = 0
-        for item in it:
-            self._consumed += 1
-            self.stats["events"] += 1
-            fired = feed(item)
-            for win in fired:
-                yield self._process_window(win)
-            if fired and self._since_ckpt >= self.checkpoint_every:
-                self._commit()
-        if flush is not None:
-            for win in flush():
-                yield self._process_window(win)
-        self._commit(final=True)
+        with self._installed_controller():
+            # A source may declare its own backpressure capability
+            # (WireKafkaSource.pausable — a consumer absorbs pressure by
+            # not fetching; a socket cannot); the driver's setting is
+            # the fallback.
+            pausable = getattr(source, "pausable", None)
+            if pausable is None:
+                pausable = self.source_pausable
+            it = iter(source)
+            if self._skip:
+                # Resume: the first `events_consumed` records are already
+                # reflected in the restored assembler/operator state.
+                next(itertools.islice(it, self._skip - 1, self._skip), None)
+                self._skip = 0
+            for item in it:
+                if faults.armed:  # chaos injection point (faults.py)
+                    faults.hit("source.stall")
+                self._consumed += 1
+                self.stats["events"] += 1
+                if admit and self.overload is not None and not \
+                        self.overload.admit_item(item, pausable=pausable):
+                    # Shed: the item never reaches the assembler, but it
+                    # still counts as consumed — resume determinism (the
+                    # same stream prefix sheds the same items).
+                    self.stats["shed"] = self.stats.get("shed", 0) + 1
+                    continue
+                fired = feed(item)
+                for win in fired:
+                    yield self._process_window(win)
+                if fired and self._since_ckpt >= self.checkpoint_every:
+                    self._commit()
+            if flush is not None:
+                for win in flush():
+                    yield self._process_window(win)
+            self._commit(final=True)
 
     # -- per-window processing (retry → failover → crash) ----------------------
 
     def _process_window(self, win):
+        ctrl = self.overload
+        breaker = ctrl.breaker if ctrl is not None else None
+        # The circuit breaker generalizes the permanent failover below:
+        # with one configured (and a fallback bound), whole windows route
+        # to the twin while the circuit is open — no per-window
+        # retry/timeout — and a half-open probe re-dials the device path
+        # on a bounded schedule. Without one, PR 8 semantics unchanged.
+        use_breaker = (breaker is not None and self.backend == "device"
+                       and self.fallback is not None)
+        single_attempt = False
+        if use_breaker:
+            route = breaker.route()
+            if route == "fallback":
+                return self._finish_window(self.fallback(win),
+                                           degraded=True)
+            single_attempt = route == "probe"
         policy = self.retry
         attempt = 0
         delay = policy.backoff_s
         proc = self.process if self.backend == "device" else self.fallback
         while True:
             try:
-                if self.backend == "device" and faults.armed:
+                if self.backend == "device" and proc is self.process \
+                        and faults.armed:
                     faults.hit("driver.window")  # chaos injection point
                 result = proc(win)
+                if use_breaker and proc is self.process:
+                    breaker.record_success()
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -296,13 +397,21 @@ class WindowedDataflowDriver:
                     # the only safe recovery for it.
                     raise
                 start = getattr(win, "start", 0)
-                if attempt < policy.max_retries:
+                if not single_attempt and attempt < policy.max_retries:
                     attempt += 1
                     self.stats["retries"] += 1
                     telemetry.record_driver_retry(start, attempt, repr(e))
                     time.sleep(delay)
                     delay *= policy.multiplier
                     continue
+                if use_breaker and proc is self.process:
+                    # Breaker mode: count the failed window (opening the
+                    # circuit at the configured threshold) and run THIS
+                    # window on the twin — no permanent backend switch,
+                    # the next probe may win the device path back.
+                    breaker.record_failure(start, repr(e))
+                    return self._finish_window(self.fallback(win),
+                                               degraded=True)
                 if self.backend == "device" and self.fallback is not None:
                     # Graceful degradation: the device path is gone (a
                     # dead tunnel outlives any retry budget) — switch to
@@ -315,8 +424,16 @@ class WindowedDataflowDriver:
                     delay = policy.backoff_s
                     continue
                 raise
+        return self._finish_window(result,
+                                   degraded=self.backend != "device")
+
+    def _finish_window(self, result, degraded: bool = False):
         self.stats["windows"] += 1
         self._since_ckpt += 1
+        if degraded and self.overload is not None:
+            # A window answered by a non-device path is a DEGRADED
+            # window — the SLO ``degraded_window_budget`` counts these.
+            self.overload.count_degraded_window()
         return result
 
     # -- checkpoint commit -----------------------------------------------------
@@ -345,6 +462,8 @@ class WindowedDataflowDriver:
         }
         if egress is not None:
             components["egress"] = egress
+        if self.overload is not None:
+            components["overload"] = self.overload.state()
         if self.extra_state is not None:
             components.update(self.extra_state())
         save_checkpoint(self.checkpoint_path, **components)

@@ -78,6 +78,10 @@ INJECTION_POINTS: Dict[str, str] = {
     "sink.write": "streams/sinks.py:TransactionalFileSink.commit — "
                   "egress append (supports partial_write)",
     "driver.window": "driver.py — device-path window processing",
+    "overload.admit": "overload.py:OverloadController.admit_item — "
+                      "source→assembler admission decision",
+    "source.stall": "driver.py:_drive — per-item source pull (the "
+                    "slow-consumer / wedged-upstream hang point)",
 }
 
 #: Points whose callers implement the cooperative ``partial_write`` kind.

@@ -242,75 +242,149 @@ class PointPointJoinQuery(SpatialOperator):
         radius: float,
         dtype=np.float64,
         mesh=None,
+        driver=None,
     ) -> Iterator[JoinWindowResult]:
+        """Window loop lifted into the shared dataflow driver
+        (spatialflink_tpu/driver.py): pass ``driver=`` to OPT INTO
+        auto-checkpointing, retry-with-backoff, and device→numpy
+        failover (RealTimeNaive mode — the bucketed mode's pair order is
+        device compaction order, so it has no twin). Without one, a
+        strict driver reproduces the old plain loop exactly — errors
+        propagate immediately, nothing degrades. The driver consumes
+        the timestamp-merged two-stream sequence, so resume positions
+        count MERGED events (both sides must replay for a checkpointed
+        run)."""
         mesh = mesh if mesh is not None else self.mesh
         merged = (
             _TaggedEvent(ev.timestamp, tag, ev)
             for tag, ev in merge_by_timestamp(ordinary, query_stream)
         )
+        from spatialflink_tpu.driver import strict_driver
         from spatialflink_tpu.ops.counters import (
             count_join_candidates,
             counters as opcounters,
         )
 
-        ck = jitted(cross_join_kernel)
-        offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
         naive = self.conf.query_type == QueryType.RealTimeNaive
+        drv = driver if driver is not None else strict_driver()
+        drv.attach(self)
+        process = None
+        if drv.backend == "device":
+            ck = jitted(cross_join_kernel)
+            offsets = jnp.asarray(self.grid.neighbor_offsets(radius))
 
-        for win in self.windows(merged):
+            def process(win) -> JoinWindowResult:
+                left_ev = [t.event for t in win.events if t.tag == 0]
+                right_ev = [t.event for t in win.events if t.tag == 1]
+                if not left_ev or not right_ev:
+                    return JoinWindowResult(win.start, win.end, [], 0,
+                                            len(win.events))
+                with telemetry.span(
+                    "window.join", start=win.start, events=len(win.events)
+                ):
+                    lb = self.point_batch(left_ev)
+                    rb = self.point_batch(right_ev)
+                    if opcounters.enabled:
+                        if naive:
+                            cand = len(left_ev) * len(right_ev)
+                        else:
+                            cand = count_join_candidates(
+                                self.grid, lb.cell, len(left_ev), rb.cell,
+                                len(right_ev),
+                                self.grid.candidate_layers(radius),
+                            )
+                        opcounters.record_window(len(win.events), cand,
+                                                 cand)
+                    if naive:
+                        lv_d, rv_d = ship(lb.valid, rb.valid)
+                        res = ck(
+                            self.device_xy(lb, dtype), lv_d,
+                            self.device_xy(rb, dtype), rv_d,
+                            self._filter_radius(radius),
+                        )
+                        pm, ri, dd = telemetry.fetch(
+                            (res.pair_mask, res.right_index, res.dist)
+                        )
+                        pairs = []
+                        for i in np.nonzero(pm.any(axis=1))[0]:
+                            for s in np.nonzero(pm[i])[0]:
+                                pairs.append(
+                                    (left_ev[i], right_ev[int(ri[i, s])],
+                                     float(dd[i, s]))
+                                )
+                        overflow = int(res.overflow)
+                    else:
+                        # Device-compacted pairs with the persistent-
+                        # budget retry contract (_compact_block): a
+                        # window whose match count exceeds the budget
+                        # retries once with a doubled power-of-two
+                        # budget that persists across windows.
+                        li, ri, dd, overflow = self._compact_block(
+                            lb, rb, radius, offsets, dtype, mesh
+                        )
+                        pairs = [
+                            (left_ev[int(a)], right_ev[int(b)], float(d))
+                            for a, b, d in zip(li, ri, dd)
+                        ]
+                    return JoinWindowResult(
+                        win.start, win.end, pairs, overflow, len(win.events)
+                    )
+
+        fallback = self._numpy_window_process(radius, dtype) if naive \
+            else None
+        drv.bind(self, process, fallback=fallback)
+        if self.conf.query_type == QueryType.CountBased:
+            from spatialflink_tpu.operators.base import count_window_batches
+
+            yield from drv.run_windows(count_window_batches(
+                merged, self.conf.count_window_size,
+                self.conf.count_window_size,
+            ))
+        else:
+            yield from drv.run(merged)
+
+    def _numpy_window_process(self, radius, dtype):
+        """Numpy twin of the RealTimeNaive cross-join path — the
+        driver's failover route. Same centered/cast coordinates
+        (operators/base.center_coords) and the same pair order as the
+        device decode loop (ascending left index, then ascending right
+        index — cross_join_kernel's slots ARE right indices), so a
+        mid-stream backend switch changes no results
+        (tests/test_driver.py pins parity)."""
+        from spatialflink_tpu.operators.base import center_coords
+
+        fr = self._filter_radius(radius)
+
+        def process(win) -> JoinWindowResult:
             left_ev = [t.event for t in win.events if t.tag == 0]
             right_ev = [t.event for t in win.events if t.tag == 1]
             if not left_ev or not right_ev:
-                yield JoinWindowResult(win.start, win.end, [], 0, len(win.events))
-                continue
-            with telemetry.span(
-                "window.join", start=win.start, events=len(win.events)
-            ):
-                lb = self.point_batch(left_ev)
-                rb = self.point_batch(right_ev)
-                if opcounters.enabled:
-                    if naive:
-                        cand = len(left_ev) * len(right_ev)
-                    else:
-                        cand = count_join_candidates(
-                            self.grid, lb.cell, len(left_ev), rb.cell,
-                            len(right_ev), self.grid.candidate_layers(radius),
-                        )
-                    opcounters.record_window(len(win.events), cand, cand)
-                if naive:
-                    lv_d, rv_d = ship(lb.valid, rb.valid)
-                    res = ck(
-                        self.device_xy(lb, dtype), lv_d,
-                        self.device_xy(rb, dtype), rv_d,
-                        self._filter_radius(radius),
+                return JoinWindowResult(win.start, win.end, [], 0,
+                                        len(win.events))
+            lxy = center_coords(
+                self.grid,
+                np.asarray([[p.x, p.y] for p in left_ev], np.float64),
+                dtype,
+            )
+            rxy = center_coords(
+                self.grid,
+                np.asarray([[p.x, p.y] for p in right_ev], np.float64),
+                dtype,
+            )
+            d = lxy[:, None, :] - rxy[None, :, :]
+            dist = np.sqrt(np.sum(d * d, axis=-1))
+            pm = dist <= fr
+            pairs = []
+            for i in np.nonzero(pm.any(axis=1))[0]:
+                for s in np.nonzero(pm[i])[0]:
+                    pairs.append(
+                        (left_ev[int(i)], right_ev[int(s)],
+                         float(dist[i, s]))
                     )
-                    pm, ri, dd = telemetry.fetch(
-                        (res.pair_mask, res.right_index, res.dist)
-                    )
-                    pairs = []
-                    for i in np.nonzero(pm.any(axis=1))[0]:
-                        for s in np.nonzero(pm[i])[0]:
-                            pairs.append(
-                                (left_ev[i], right_ev[int(ri[i, s])],
-                                 float(dd[i, s]))
-                            )
-                    overflow = int(res.overflow)
-                else:
-                    # Device-compacted pairs with the persistent-budget retry
-                    # contract (_compact_block): a window whose match count
-                    # exceeds the budget retries once with a doubled
-                    # power-of-two budget that persists across windows.
-                    li, ri, dd, overflow = self._compact_block(
-                        lb, rb, radius, offsets, dtype, mesh
-                    )
-                    pairs = [
-                        (left_ev[int(a)], right_ev[int(b)], float(d))
-                        for a, b, d in zip(li, ri, dd)
-                    ]
-                out = JoinWindowResult(
-                    win.start, win.end, pairs, overflow, len(win.events)
-                )
-            yield out
+            return JoinWindowResult(win.start, win.end, pairs, 0,
+                                    len(win.events))
+
+        return process
 
 
     def _compact_block(self, lb, rb, radius, offsets, dtype, mesh):

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spatialflink_tpu import overload
 from spatialflink_tpu.models.objects import LineString, Point, Polygon, SpatialObject
 from spatialflink_tpu.operators.base import (
     SpatialOperator,
@@ -99,72 +100,155 @@ class _PointStreamKNNQuery(SpatialOperator):
         k: int,
         dtype=np.float64,
         mesh=None,
+        driver=None,
     ) -> Iterator[KnnWindowResult]:
+        """Window loop lifted into the shared dataflow driver
+        (spatialflink_tpu/driver.py): pass ``driver=`` to OPT INTO
+        auto-checkpointing, retry-with-backoff, and device→numpy
+        failover (point-query kind — the geometry kinds have no numpy
+        twin). Without one, a strict driver reproduces the old plain
+        loop exactly — errors propagate immediately, nothing degrades.
+        """
         mesh = mesh if mesh is not None else self.mesh
         flags = flags_for_queries(self.grid, radius, [query_obj])
-        flags_d = jnp.asarray(flags)
-        geom_kernel = (
-            knn_polygon_fused if self.query_kind == "polygon"
-            else knn_polyline_fused
-        )
 
-        def programs(nseg):
-            return (
-                window_program(
-                    mesh, knn_points_fused, (0, 1, 2, 4), 7,
-                    topk=True, k=k, num_segments=nseg,
-                ),
-                window_program(
-                    mesh, geom_kernel, (0, 1, 2, 4), 8,
-                    topk=True, k=k, num_segments=nseg,
-                ),
-            )
-
-        if self.query_kind == "point":
-            q = self.device_q([query_obj.x, query_obj.y], dtype)
-        else:
-            verts, ev = self._packed_query(query_obj)
-            qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
-
+        from spatialflink_tpu.driver import strict_driver
         from spatialflink_tpu.ops.counters import count_candidates, counters
 
-        for win in self.windows(stream):
-            # Telemetry phases per window: assemble (host batch build) →
-            # ship (host→device) → compute (kernel dispatch) → fetch
-            # (device→host decode). The yield stays OUTSIDE the window
-            # span so consumer time never pollutes window latency.
-            with telemetry.span(
-                "window.knn", start=win.start, events=len(win.events)
-            ):
-                with telemetry.span("assemble"):
-                    batch = self.point_batch(win.events)
-                    if counters.enabled:
-                        cand = count_candidates(
-                            flags, batch.cell, len(win.events)
+        # Attach (= load any checkpoint) BEFORE touching the device: a
+        # run resumed after failover means the device path already died
+        # — setup transfers would hang the resume at a device_put.
+        drv = driver if driver is not None else strict_driver()
+        drv.attach(self)
+        process = None
+        if drv.backend == "device":
+            flags_d = jnp.asarray(flags)
+            geom_kernel = (
+                knn_polygon_fused if self.query_kind == "polygon"
+                else knn_polyline_fused
+            )
+
+            def programs(nseg):
+                return (
+                    window_program(
+                        mesh, knn_points_fused, (0, 1, 2, 4), 7,
+                        topk=True, k=k, num_segments=nseg,
+                    ),
+                    window_program(
+                        mesh, geom_kernel, (0, 1, 2, 4), 8,
+                        topk=True, k=k, num_segments=nseg,
+                    ),
+                )
+
+            if self.query_kind == "point":
+                q = self.device_q([query_obj.x, query_obj.y], dtype)
+            else:
+                verts, ev = self._packed_query(query_obj)
+                qv, qe = self.device_q(verts, dtype), jnp.asarray(ev)
+
+            def process(win) -> KnnWindowResult:
+                # Telemetry phases per window: assemble (host batch
+                # build) → ship (host→device) → compute (kernel
+                # dispatch) → fetch (device→host decode). The yield
+                # stays OUTSIDE the window span so consumer time never
+                # pollutes window latency.
+                with telemetry.span(
+                    "window.knn", start=win.start, events=len(win.events)
+                ):
+                    with telemetry.span("assemble"):
+                        batch = self.point_batch(win.events)
+                        if counters.enabled:
+                            cand = count_candidates(
+                                flags, batch.cell, len(win.events)
+                            )
+                            counters.record_window(len(win.events), cand,
+                                                   cand)
+                        nseg = next_bucket(
+                            max(self.interner.num_segments, 1), minimum=64
                         )
-                        counters.record_window(len(win.events), cand, cand)
-                    nseg = next_bucket(
-                        max(self.interner.num_segments, 1), minimum=64
-                    )
-                    kp, kpoly = programs(nseg)
-                with telemetry.span("ship"):
-                    valid_d, cell_d, oid_d = ship(
-                        batch.valid, batch.cell, batch.oid
-                    )
-                    args = (
-                        self.device_xy(batch, dtype),
-                        valid_d,
-                        cell_d,
-                        flags_d,
-                        oid_d,
-                    )
-                with telemetry.span("compute"):
-                    if self.query_kind == "point":
-                        res = kp(*args, q, radius)
-                    else:
-                        res = kpoly(*args, qv, qe, radius)
-                out = self._decode(win, res, k)
-            yield out
+                        kp, kpoly = programs(nseg)
+                    with telemetry.span("ship"):
+                        valid_d, cell_d, oid_d = ship(
+                            batch.valid, batch.cell, batch.oid
+                        )
+                        args = (
+                            self.device_xy(batch, dtype),
+                            valid_d,
+                            cell_d,
+                            flags_d,
+                            oid_d,
+                        )
+                    with telemetry.span("compute"):
+                        if self.query_kind == "point":
+                            res = kp(*args, q, radius)
+                        else:
+                            res = kpoly(*args, qv, qe, radius)
+                    return self._decode(win, res, k)
+
+        fallback = None
+        if self.query_kind == "point":
+            fallback = self._numpy_window_process(query_obj, flags, radius,
+                                                  k, dtype)
+        drv.bind(self, process, fallback=fallback)
+        from spatialflink_tpu.operators.query_config import QueryType
+
+        if self.conf.query_type == QueryType.CountBased:
+            from spatialflink_tpu.operators.base import count_window_batches
+
+            yield from drv.run_windows(count_window_batches(
+                stream, self.conf.count_window_size,
+                self.conf.count_window_size,
+            ))
+        else:
+            yield from drv.run(stream)
+
+    def _numpy_window_process(self, query_obj, flags, radius, k, dtype):
+        """Numpy twin of the point-query device path — the driver's
+        failover route. Same centered/cast coordinates
+        (operators/base.center_coords), same masked segment-min and the
+        same top-k tie-break as ops/knn.py (``lax.top_k`` over
+        ``-seg_min`` puts equal distances in ascending segment-id order;
+        a stable argsort over ``seg_min`` does too), so a mid-stream
+        backend switch changes no results (tests/test_driver.py pins
+        parity)."""
+        from spatialflink_tpu.operators.base import center_coords
+
+        q_host = center_coords(
+            self.grid,
+            np.asarray([[query_obj.x, query_obj.y]], np.float64), dtype,
+        )[0]
+
+        def process(win) -> KnnWindowResult:
+            batch = self.point_batch(win.events)
+            n = len(win.events)
+            nseg = next_bucket(max(self.interner.num_segments, 1),
+                               minimum=64)
+            xy = center_coords(self.grid, batch.xy[:n], dtype)
+            d = xy - q_host[None, :]
+            dist = np.sqrt(np.sum(d * d, axis=-1))
+            f = flags[batch.cell[:n]]
+            mask = batch.valid[:n] & (f > 0) & (dist <= radius)
+            big = np.finfo(dist.dtype).max
+            masked = np.where(mask, dist, big).astype(dist.dtype)
+            oid = np.asarray(batch.oid[:n], np.int64)
+            seg_min = np.full(nseg, big, dist.dtype)
+            np.minimum.at(seg_min, oid, masked)
+            int_big = np.iinfo(np.int32).max
+            rep = np.full(nseg, int_big, np.int64)
+            winner = mask & (masked == seg_min[oid])
+            np.minimum.at(rep, oid[winner],
+                          np.arange(n, dtype=np.int64)[winner])
+            order = np.argsort(seg_min, kind="stable")
+            nv = min(int((seg_min < big).sum()), k)
+            neighbors = [
+                (self.interner.lookup(int(s)), float(seg_min[s]),
+                 win.events[int(rep[s])])
+                for s in order[:nv]
+            ]
+            return KnnWindowResult(win.start, win.end, neighbors,
+                                   len(win.events))
+
+        return process
 
     def _decode(self, win, res, k) -> KnnWindowResult:
         # telemetry.fetch is the SAME device_get the bare np.asarray would
@@ -720,7 +804,7 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             "counts": list(counts),
         }
 
-        def fire(pane_i):
+        def merge_window(pane_i):
             # Gap-window suppression: a window none of whose panes held
             # an event does not exist on the SoA path (the assembler
             # only builds windows containing events) — skip it here
@@ -732,12 +816,73 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
                 tuple(s for s, _ in digests),
                 tuple(r for _, r in digests), no_bases, k=k,
             )
+            return (start_ms + (pane_i - ppw + 1) * slide_ms, res)
+
+        def fetch_one(w_start, res):
             nv = int(telemetry.fetch(res.num_valid))
             segs, dists = telemetry.fetch((res.segment[:nv], res.dist[:nv]))
-            w_start = start_ms + (pane_i - ppw + 1) * slide_ms
             return (w_start, w_start + size, segs, dists, nv)
 
+        pending: list = []
+
+        def carry_now(next_pane):
+            return {
+                "next_pane": next_pane, "digests": list(digests),
+                "counts": list(counts),
+            }
+
+        def flush_pending():
+            # ONE device→host sync for the whole batch: full (k,) lanes
+            # fetched, host-sliced by num_valid — identical values to
+            # the per-window fetch, tunnel round trips ÷ batch width.
+            if not pending:
+                return
+            handles = [
+                (r.num_valid, r.segment, r.dist) for (_, r), _ in pending
+            ]
+            fetched = telemetry.fetch(handles)
+            for ((w_start, _), carry), (nv_a, seg_a, dist_a) in zip(
+                    pending, fetched):
+                # Publish the ring state as of this window's pane BEFORE
+                # yielding it: a checkpoint taken at any yield must
+                # never count a still-pending window as emitted (the
+                # carry would otherwise skip past unfetched windows on
+                # resume — lost egress).
+                self._wire_pane_carry = carry
+                nv = int(nv_a)
+                yield (w_start, w_start + size, np.asarray(seg_a)[:nv],
+                       np.asarray(dist_a)[:nv], nv)
+            del pending[:]
+
+        def emit(pane_i, carry):
+            """Yield-ready results for this pane's window (if any).
+
+            Under an active overload ``batch_slides`` degradation rung
+            (spatialflink_tpu/overload.py) the result handles of N
+            windows batch into one fetch via ``flush_pending`` — on
+            this path the per-window tunnel round trip IS the overload
+            cost. The default width of 1 keeps the original
+            fetch-per-window sequence bit-for-bit, including the
+            carry-advances-per-pane checkpoint behavior; while a batch
+            is open the carry stays at the last YIELDED window's pane
+            (flush_pending advances it per yield).
+            """
+            out = merge_window(pane_i)
+            if out is None:
+                if not pending:
+                    self._wire_pane_carry = carry
+                return
+            width = overload.batch_slides()
+            if width <= 1 and not pending:
+                self._wire_pane_carry = carry
+                yield fetch_one(*out)
+                return
+            pending.append((out, carry))
+            if len(pending) >= max(width, 1):
+                yield from flush_pending()
+
         i = pane0 - 1
+        last_carry = self._wire_pane_carry
         for i, wire_p in enumerate(slides, start=pane0):
             wire_p = np.asarray(wire_p)
             if (wire_p.ndim != 2 or wire_p.shape[0] != 3
@@ -767,27 +912,27 @@ class PointPointKNNQuery(_PointStreamKNNQuery):
             del digests[:-ppw]
             counts.append(n)
             del counts[:-ppw]
-            self._wire_pane_carry = {
-                "next_pane": i + 1, "digests": list(digests),
-                "counts": list(counts),
-            }
-            out = fire(i)
-            if out is not None:
-                yield out
+            last_carry = carry_now(i + 1)
+            yield from emit(i, last_carry)
         # Flush iff ≥1 REAL pane exists in the logical stream: consumed
         # this call (i advanced past pane0-1) or before the checkpoint
         # (pane0 > 0). A restore taken before any pane must NOT flush —
         # an uninterrupted empty run yields nothing.
         if flush_at_end and (i >= pane0 or pane0 > 0):
             # Trailing partial windows: panes shift out, empties in.
+            # Synthetic panes never advance the carry — entries keep the
+            # last REAL pane's ring.
             for j in range(1, ppw):
                 digests.append(empty)
                 del digests[:-ppw]
                 counts.append(0)
                 del counts[:-ppw]
-                out = fire(i + j)
-                if out is not None:
-                    yield out
+                yield from emit(i + j, last_carry)
+        yield from flush_pending()
+        # End-of-call invariant (what the call-boundary checkpoint
+        # callers pair with source offsets): every consumed REAL pane is
+        # in the carry, whether or not its window was emitted.
+        self._wire_pane_carry = last_carry
 
 
 class PointPolygonKNNQuery(_PointStreamKNNQuery):

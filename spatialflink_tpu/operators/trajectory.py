@@ -491,6 +491,7 @@ class TJoinQuery(SpatialOperator):
         mesh=None,
         backend: str = "auto",
         cap_c: Optional[int] = None,
+        driver=None,
     ):
         """Extreme-overlap sliding tJoin via the device pane-carry engine
         (ops/tjoin_panes.py): window state lives ON DEVICE in ring-buffer
@@ -536,6 +537,17 @@ class TJoinQuery(SpatialOperator):
         explicit positive value seeds the ladder but the cmp_overflow
         retry still climbs it if the pick was too small — exactness
         always wins over a forced bucket.
+
+        ``driver``: window emission routed through the shared dataflow
+        driver (spatialflink_tpu/driver.py:run_precomputed) — the
+        checkpointed position counts FIRED WINDOWS, and a resume (after
+        this method deterministically re-runs the scan over the
+        replayed bounded chunks) skips the already-committed prefix.
+        Without one, a strict driver reproduces the old plain loop
+        exactly. An active overload ``pane_backend`` degradation rung
+        (overload.py) biases ``backend="auto"`` toward the native
+        engine when it is available; forced backends are never
+        overridden.
         """
         from spatialflink_tpu.operators.base import check_oid_range, jitted
         from spatialflink_tpu.ops.tjoin_panes import (
@@ -684,7 +696,16 @@ class TJoinQuery(SpatialOperator):
                     )
                 use_native = True
             else:
-                use_native = native_ok and not _device_backend_preferred()
+                # An active overload ``pane_backend`` rung biases auto
+                # toward the native engine (frees the loaded device
+                # path); a missing library keeps the device engine — a
+                # degradation rung must never turn into a crash.
+                from spatialflink_tpu import overload as _overload
+
+                prefer_native = _overload.pane_backend() == "native"
+                use_native = native_ok and (
+                    prefer_native or not _device_backend_preferred()
+                )
 
         with_ranks = not use_native
         lfields, lcounts, locc_in = pane_fields(lt, lx, ly, lo)
@@ -795,20 +816,33 @@ class TJoinQuery(SpatialOperator):
 
         lwin = rolling_counts(lcounts)
         rwin = rolling_counts(rcounts)
-        for s in range(n_slides):
-            if lwin[s] == 0 and rwin[s] == 0:
-                continue
+
+        def decode(s) -> tuple:
             t_pane = p_first + s
             start = (t_pane - ppw + 1) * slide
             row = wmins[s]
             hit = np.nonzero(np.isfinite(row))[0]
-            yield (
+            return (
                 start, start + size,
                 (hit // num_segments).astype(np.int32),
                 (hit % num_segments).astype(np.int32),
                 row[hit].astype(np.float64),
                 int(len(hit)), 0,
             )
+
+        # Window emission through the shared dataflow driver: the scan
+        # above is deterministic over the (bounded, replayed) chunks, so
+        # a resumed run recomputes it and the driver skips the windows
+        # already committed — run_precomputed's contract. The default
+        # strict driver reproduces the old plain yield loop bit-for-bit.
+        from spatialflink_tpu.driver import strict_driver
+
+        drv = driver if driver is not None else strict_driver()
+        drv.attach(self)
+        drv.bind(self, decode)
+        fired = (s for s in range(n_slides)
+                 if lwin[s] != 0 or rwin[s] != 0)
+        yield from drv.run_precomputed(fired)
 
 
 class PointPointTJoinQuery(TJoinQuery):

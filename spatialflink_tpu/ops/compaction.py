@@ -74,7 +74,20 @@ def capacity_ladder(cap: int, minimum: int = CAP_LADDER_MIN) -> Tuple[int, ...]:
 def pick_capacity(live: int, cap: int, minimum: int = CAP_LADDER_MIN) -> int:
     """Smallest ladder rung ≥ ``live`` (the bucketed probe capacity).
     ``live`` beyond the ladder top clamps to ``cap`` — the ring capacity
-    bounds live occupancy anyway (the cap_overflow retry contract)."""
+    bounds live occupancy anyway (the cap_overflow retry contract).
+
+    Under an active overload ``clamp_compaction`` rung
+    (spatialflink_tpu/overload.py) the pick is FLOORED: occupancy churn
+    below the clamp stops changing rungs — each fresh rung is a ~1-2 s
+    XLA recompile, exactly the cost a loaded pipeline can't pay.
+    Result-preserving: the rung only ever grows (padding stays masked),
+    and a clamp of 0 pins the top rung (one program for the whole run).
+    """
+    from spatialflink_tpu import overload
+
+    clamp = overload.compaction_clamp()
+    if clamp is not None:
+        live = cap if clamp <= 0 else max(live, clamp)
     for b in capacity_ladder(cap, minimum):
         if b >= live:
             return b

@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass, fields
 from typing import Any, Dict, List, Optional
 
+from spatialflink_tpu import overload
 from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 from spatialflink_tpu.telemetry import telemetry
 
@@ -60,6 +61,12 @@ class SloSpec:
       these budgets let a spec say how much degradation still counts as
       meeting the objective (``failover_budget: 0`` = any failover
       violates);
+    - ``shed_budget`` / ``degraded_window_budget``: ceilings on the
+      overload controller's actions (overload.py) — total events shed
+      (admission + late + oldest) and windows answered by a non-device
+      path (circuit-open routing or post-failover). A spec naming these
+      against a run with NO controller installed VIOLATES — silence
+      must fail the gate, the ``eps_floor`` rule;
     - ``eval_interval_s``: pacing of the incremental evaluation (the
       per-window cost between evaluations is counter updates only).
     """
@@ -72,6 +79,8 @@ class SloSpec:
     recompile_ceiling: Optional[int] = None
     retry_budget: Optional[int] = None
     failover_budget: Optional[int] = None
+    shed_budget: Optional[int] = None
+    degraded_window_budget: Optional[int] = None
     eval_interval_s: float = 1.0
     warmup_windows: int = 8
 
@@ -208,6 +217,19 @@ class SloEngine:
             fo = self.tel.driver_failovers
             check("failover_budget", fo, f"<= {int(sp.failover_budget)}",
                   fo <= sp.failover_budget)
+        if sp.shed_budget is not None:
+            ctrl = overload.controller()
+            shed = None if ctrl is None else ctrl.shed_total
+            check("shed_budget", shed, f"<= {int(sp.shed_budget)}",
+                  # No controller installed = the budget is unanswerable
+                  # — silence fails (the eps_floor rule).
+                  shed is not None and shed <= sp.shed_budget)
+        if sp.degraded_window_budget is not None:
+            ctrl = overload.controller()
+            dw = None if ctrl is None else ctrl.degraded_windows
+            check("degraded_window_budget", dw,
+                  f"<= {int(sp.degraded_window_budget)}",
+                  dw is not None and dw <= sp.degraded_window_budget)
         if sp.overflow_budget is not None:
             counts: List[int] = []
             _find_overflows(self.tel.snapshot(), counts)
@@ -252,6 +274,11 @@ class SloEngine:
             # A violation is exactly the record that must survive the
             # run dying right after it — force the stream segment out.
             self.tel.maybe_flush_stream(force=True)
+        if rows:
+            # Live verdict → degradation ladder: a violating evaluation
+            # steps the overload controller's rung down (free when no
+            # controller is installed).
+            overload.on_slo_evaluation(all(r["ok"] for r in rows))
         return rows
 
     def verdict(self) -> Dict[str, Any]:

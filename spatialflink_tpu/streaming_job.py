@@ -140,7 +140,7 @@ def run_job(params: Params, source: Iterable[Point], sink,
     spatialflink_tpu.driver.WindowedDataflowDriver) routes the windowed
     query options through the self-healing dataflow driver —
     auto-checkpoint + exactly-once egress + retry/failover; supported
-    for the driver-wired operators (options 1 and 6)."""
+    for the driver-wired operators (options 1, 3, 5 and 6)."""
     grid = params.input_stream1.make_grid()
     q = params.query
     window_conf = QueryConfiguration(
@@ -179,11 +179,11 @@ def run_job(params: Params, source: Iterable[Point], sink,
         % max(window_conf.slide_step_ms, 1) == 0
     )
 
-    if driver is not None and option not in (1, 6):
+    if driver is not None and option not in (1, 3, 5, 6):
         raise SystemExit(
             f"--checkpoint (the dataflow driver) supports query options "
-            f"1 and 6, not {option} — the remaining operators keep their "
-            "own loops until they are driver-wired"
+            f"1, 3, 5 and 6, not {option} — the remaining operators keep "
+            "their own loops until they are driver-wired"
         )
 
     if option in (1, 2):
@@ -212,22 +212,36 @@ def run_job(params: Params, source: Iterable[Point], sink,
         conf = window_conf if option == 3 else realtime_conf
         op = PointPointKNNQuery(conf, grid, mesh=mesh)
         if option == 3 and incremental:
+            if driver is not None:
+                raise SystemExit(
+                    "--checkpoint is incompatible with query.incremental "
+                    "(the pane-carry protocol is not driver-wired)"
+                )
             results = op.query_panes(source, q_points[0], q.radius, q.k)
         else:
-            results = op.run(source, q_points[0], q.radius, q.k)
+            results = op.run(source, q_points[0], q.radius, q.k,
+                             driver=driver)
         for res in results:
             for oid, d, p in res.neighbors:
                 sink(f"{res.start},{res.end},{oid},{float(d)!r}")
                 n += 1
     elif option == 5:
         op = PointPointJoinQuery(window_conf, grid, mesh=mesh)
+        # Both halves re-materialize deterministically from the replayed
+        # source, so the merged two-stream sequence is itself replayable
+        # — what the driver's resume-skip needs.
         events = list(source)
         half = len(events) // 2
         left, right = iter(events[:half]), iter(events[half:])
         if incremental:
+            if driver is not None:
+                raise SystemExit(
+                    "--checkpoint is incompatible with query.incremental "
+                    "(the pane-carry protocol is not driver-wired)"
+                )
             results = op.query_panes(left, right, q.radius)
         else:
-            results = op.run(left, right, q.radius)
+            results = op.run(left, right, q.radius, driver=driver)
         for res in results:
             for a, b, d in res.pairs:
                 sink(f"{res.start},{res.end},{a.obj_id},{b.obj_id},{float(d)!r}")

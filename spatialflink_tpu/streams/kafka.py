@@ -158,6 +158,13 @@ class WireKafkaSource:
     consumed) without yielding.
     """
 
+    #: Backpressure capability flag read by the dataflow driver's
+    #: admission control (overload.py): a broker retains the log, so the
+    #: consumer absorbs pressure by simply not issuing the next fetch
+    #: round (the pull loop's natural pause) — it never needs the
+    #: non-replayable shed path a live socket does.
+    pausable = True
+
     def __init__(self, topic: str, bootstrap_servers: str,
                  parser: Callable[[str], T], group_id: str = "spatialflink-tpu",
                  from_earliest: bool = True,

@@ -323,8 +323,17 @@ def traj_stats_sliding(
         raise ValueError(
             f"mesh execution requires the device backend, not {backend!r}"
         )
+    # Active overload degradation rung (overload.py): bias "auto" away
+    # from the device path — the native/numpy engines below answer
+    # bit-identically (parity-oracle contract), freeing the loaded
+    # device/tunnel. Forced backends are never overridden.
+    from spatialflink_tpu import overload
+
+    prefer_host = (backend == "auto" and mesh is None
+                   and overload.pane_backend() in ("native", "numpy"))
     if mesh is not None or backend == "device" or (
-            backend == "auto" and _device_backend_preferred()):
+            backend == "auto" and not prefer_host
+            and _device_backend_preferred()):
         return _traj_stats_sliding_device(
             ts, xy, oid, num_oids, size_ms, slide_ms, mesh=mesh
         )

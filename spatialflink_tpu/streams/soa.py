@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from spatialflink_tpu import slo
+from spatialflink_tpu import overload, slo
 from spatialflink_tpu.faults import faults
 from spatialflink_tpu.telemetry import telemetry
 
@@ -123,6 +123,10 @@ class _SlidingAssemblerBase:
                     # site (free when no engine is installed).
                     telemetry.record_watermark_lag(wm - e)
                     slo.on_window_fired(hi - lo, lag_ms=wm - e)
+                    # Overload hook, same fire site (free when no
+                    # controller is installed).
+                    overload.on_window_fired(hi - lo, lag_ms=wm - e,
+                                             end=e)
                 self._next_start += self.slide
             elif lo < len(ts):
                 # Empty window: fast-forward to the earliest window holding

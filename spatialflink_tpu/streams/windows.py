@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Iterable, Iterator, List, Optional, Tuple, TypeVar
 
-from spatialflink_tpu import slo
+from spatialflink_tpu import overload, slo
 from spatialflink_tpu.faults import faults
 from spatialflink_tpu.telemetry import telemetry
 
@@ -175,6 +175,12 @@ class WindowAssembler(Generic[T]):
                 telemetry.record_watermark_lag(wm - spec.end)
                 slo.on_window_fired(len(self._buffers[spec]),
                                     lag_ms=wm - spec.end)
+                # Overload hook, same fire site: drains the admission
+                # burst and runs the lag shed-mode machine (free when no
+                # controller is installed).
+                overload.on_window_fired(len(self._buffers[spec]),
+                                         lag_ms=wm - spec.end,
+                                         end=spec.end)
         # Garbage-collect windows past the lateness horizon. The fired-flag
         # entry goes too: re-entry of a GC'd window is already blocked by the
         # spec.end + lateness <= wm check in feed(), and keeping the flags

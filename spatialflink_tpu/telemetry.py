@@ -201,6 +201,11 @@ class Telemetry:
         # Optional verdict callback installed by slo.install(): called at
         # ledger-write/seal time to embed the live SLO verdict block.
         self.slo_provider = None
+        # Optional overload-state callback installed by
+        # overload.install(): snapshot() embeds it as ["overload"], so
+        # shed/degradation/circuit counters ride every ledger-stream
+        # checkpoint and survive a mid-overload crash.
+        self.overload_provider = None
         self._lock = threading.RLock()
         self._reset_state()
 
@@ -963,6 +968,11 @@ class Telemetry:
             )
             if self.fault_fires:
                 out["faults"] = dict(self.fault_fires)
+        if self.overload_provider is not None:
+            try:
+                out["overload"] = json_safe(self.overload_provider())
+            except Exception:  # a broken provider must not break snapshots
+                pass
         link = self.link_gauges()
         if link is not None:
             out["link_probe"] = link

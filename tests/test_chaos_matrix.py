@@ -11,13 +11,18 @@ flushes). The real-process SIGKILL analog (``abort`` kind,
 ``os._exit(137)``) is pinned by the slow subprocess test below and runs
 on every commit as tools/ci's chaos-smoke stage.
 
-Three pipeline harnesses cover the nine points:
+Four pipeline harnesses cover the eleven points:
 
 - range-query driver pipeline (collection source): device.ship,
-  device.dispatch, device.fetch, window.feed, driver.window, sink.write;
+  device.dispatch, device.fetch, window.feed, driver.window, sink.write,
+  and — with an admission controller attached — overload.admit;
 - SoA driver pipeline (chunked source → run_soa): soa.feed;
 - Kafka driver pipeline (FakeBroker ingest, offsets checkpointed):
-  kafka.fetch, kafka.leader.
+  kafka.fetch, kafka.leader;
+- tJoin pane-engine pipeline (bounded SoA chunks → run_soa_panes →
+  driver.run_precomputed): source.stall — the scan recomputes
+  deterministically on resume and the driver skips the committed
+  window prefix.
 """
 
 import json
@@ -48,6 +53,7 @@ from spatialflink_tpu.faults import (  # noqa: E402
 from spatialflink_tpu.operators.range_query import (  # noqa: E402
     PointPointRangeQuery,
 )
+from spatialflink_tpu import overload  # noqa: E402
 from spatialflink_tpu.operators.trajectory import TStatsQuery  # noqa: E402
 from spatialflink_tpu.streams.sinks import (  # noqa: E402
     TransactionalFileSink,
@@ -60,6 +66,11 @@ def _disarm():
     yield
     faults.disarm()
     telemetry.disable()
+    # The overload.admit leg's driver deliberately leaves its controller
+    # in the module slot when no prior one was installed (the
+    # ledger-seal contract) — clean it so later tests in the process
+    # don't inherit a crashed leg's stale controller.
+    overload.uninstall()
 
 
 RETRY = RetryPolicy(max_retries=1, backoff_s=0.0)
@@ -69,12 +80,21 @@ RETRY = RetryPolicy(max_retries=1, backoff_s=0.0)
 # Harness 1: range-query pipeline (collection source)
 
 
-def run_range_leg(workdir, fault_plan=None):
+def run_range_leg(workdir, fault_plan=None, with_overload=False):
     grid, conf, source, query = _toy_pipeline()
     sink = TransactionalFileSink(os.path.join(workdir, "egress.csv"))
+    ctrl = None
+    if with_overload:
+        # Admission controller with no budgets: nothing sheds, but
+        # every event passes through admit_item — the overload.admit
+        # injection point's hit stream.
+        from spatialflink_tpu import overload
+
+        ctrl = overload.OverloadController(overload.OverloadPolicy())
     driver = WindowedDataflowDriver(
         checkpoint_path=os.path.join(workdir, "ckpt.bin"),
         checkpoint_every=2, sink=sink, retry=RETRY, failover=False,
+        overload=ctrl,
     )
     op = PointPointRangeQuery(conf, grid)
     if fault_plan:
@@ -88,19 +108,19 @@ def run_range_leg(workdir, fault_plan=None):
     return driver
 
 
-def chaos_range(tmp_path, point, kind="raise", at=5):
+def chaos_range(tmp_path, point, kind="raise", at=5, with_overload=False):
     clean = tmp_path / "clean"
     chaos = tmp_path / "chaos"
     clean.mkdir()
     chaos.mkdir()
-    run_range_leg(str(clean))
+    run_range_leg(str(clean), with_overload=with_overload)
     want = (clean / "egress.csv").read_bytes()
     assert want, "vacuous matrix entry: clean egress is empty"
     with pytest.raises(InjectedFault):
         run_range_leg(str(chaos), fault_plan=[
             {"point": point, "kind": kind, "at": at, "times": 10_000},
-        ])
-    drv = run_range_leg(str(chaos))  # resume
+        ], with_overload=with_overload)
+    drv = run_range_leg(str(chaos), with_overload=with_overload)  # resume
     assert drv.stats["resumed"] is True
     assert (chaos / "egress.csv").read_bytes() == want
 
@@ -163,6 +183,68 @@ def chaos_soa(tmp_path, point, kind="raise", at=6):
             {"point": point, "kind": kind, "at": at, "times": 10_000},
         ])
     drv = run_soa_leg(str(chaos))
+    assert drv.stats["resumed"] is True
+    assert (chaos / "egress.csv").read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
+# Harness 2b: tJoin pane-engine pipeline (run_soa_panes →
+# driver.run_precomputed). The device scan happens up front; the driver
+# owns WINDOW emission, so the checkpointed position counts windows and
+# a resume re-runs the (deterministic) scan and skips the committed
+# prefix. source.stall fires on the driver's per-window pull.
+
+
+def _tjoin_chunks(side, n_chunks=10, per=8):
+    rng = np.random.default_rng(21 + side)
+    out = []
+    for c in range(n_chunks):
+        base = c * per
+        out.append({
+            "ts": np.arange(base, base + per, dtype=np.int64) * 250,
+            "x": rng.uniform(0.0, 8.0, per),
+            "y": rng.uniform(0.0, 8.0, per),
+            "oid": (np.arange(base, base + per) % 5).astype(np.int32),
+        })
+    return out
+
+
+def run_tjoin_panes_leg(workdir, fault_plan=None):
+    from spatialflink_tpu.operators.trajectory import TJoinQuery
+
+    grid, conf, _, _ = _toy_pipeline()
+    op = TJoinQuery(conf, grid)
+    sink = TransactionalFileSink(os.path.join(workdir, "egress.csv"))
+    driver = WindowedDataflowDriver(
+        checkpoint_path=os.path.join(workdir, "ckpt.bin"),
+        checkpoint_every=1, sink=sink, retry=RETRY, failover=False,
+    )
+    if fault_plan:
+        faults.arm(fault_plan)
+    try:
+        for s, e, lo, ro, dd, cnt, over in op.run_soa_panes(
+            _tjoin_chunks(0), _tjoin_chunks(1), 1.5, 5, driver=driver,
+        ):
+            for a, b, d in zip(lo, ro, dd):
+                sink.stage(f"{s},{e},{int(a)},{int(b)},{float(d)!r}")
+    finally:
+        faults.disarm()
+    return driver
+
+
+def chaos_tjoin_panes(tmp_path, point, kind="raise", at=4):
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    run_tjoin_panes_leg(str(clean))
+    want = (clean / "egress.csv").read_bytes()
+    assert want, "vacuous matrix entry: clean egress is empty"
+    with pytest.raises(InjectedFault):
+        run_tjoin_panes_leg(str(chaos), fault_plan=[
+            {"point": point, "kind": kind, "at": at, "times": 10_000},
+        ])
+    drv = run_tjoin_panes_leg(str(chaos))  # resume: re-scan, skip prefix
     assert drv.stats["resumed"] is True
     assert (chaos / "egress.csv").read_bytes() == want
 
@@ -281,6 +363,11 @@ MATRIX = {
     "soa.feed": lambda tp: chaos_soa(tp, "soa.feed"),
     "kafka.fetch": lambda tp: chaos_kafka(tp, "kafka.fetch"),
     "kafka.leader": lambda tp: chaos_kafka(tp, "kafka.leader"),
+    # admit fires once per EVENT (like window.feed) — trigger late
+    # enough that a checkpoint exists to resume from.
+    "overload.admit": lambda tp: chaos_range(tp, "overload.admit", at=60,
+                                             with_overload=True),
+    "source.stall": lambda tp: chaos_tjoin_panes(tp, "source.stall"),
 }
 
 

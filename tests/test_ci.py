@@ -31,6 +31,9 @@ def test_dry_run_lists_all_stages(capsys):
     assert plain.count("tools.sfprof health") == 2
     # The kill/resume chaos round trip rides every commit too.
     assert "spatialflink_tpu.driver --chaos-smoke" in plain
+    # And the burst/shed/degrade/recover overload round trip.
+    assert "[overload-smoke]" in out
+    assert "spatialflink_tpu.overload --smoke" in plain
 
 
 def test_skip_flags_trim_stages(capsys):
@@ -38,12 +41,14 @@ def test_skip_flags_trim_stages(capsys):
     out = capsys.readouterr().out
     assert "[sfcheck]" in out
     assert "pytest" not in out and "bench" not in out
-    # --skip-bench does NOT drop the chaos smoke (CPU-only, independent
-    # of the bench stage); only --skip-chaos does.
+    # --skip-bench does NOT drop the chaos/overload smokes (CPU-only,
+    # independent of the bench stage); only their own flags do.
     assert "[chaos-smoke]" in out
+    assert "[overload-smoke]" in out
     assert ci.main(["--dry-run", "--skip-tests", "--skip-bench",
-                    "--skip-chaos"]) == 0
-    assert "chaos" not in capsys.readouterr().out
+                    "--skip-chaos", "--skip-overload"]) == 0
+    out = capsys.readouterr().out
+    assert "chaos" not in out and "overload" not in out
 
 
 def test_changed_flag_passes_through(capsys):

@@ -125,6 +125,110 @@ class TestPlainLoopEquivalence:
         assert drv.stats["failovers"] == 0
 
 
+class TestMigratedOperators:
+    """ISSUE 9: KnnQuery.run / JoinQuery.run / TJoinQuery.run_soa_panes
+    route through the driver — default-strict semantics pinned (single
+    attempt, errors propagate) plus failover parity for the new numpy
+    twins."""
+
+    def _knn(self, driver=None):
+        grid, conf, source, query = _toy_pipeline()
+        from spatialflink_tpu.operators.knn_query import PointPointKNNQuery
+
+        op = PointPointKNNQuery(conf, grid)
+        return list(op.run(source(), query, 2.5, 3, driver=driver))
+
+    def _join(self, driver=None, naive=False):
+        from spatialflink_tpu.operators.join_query import (
+            PointPointJoinQuery,
+        )
+        from spatialflink_tpu.operators.query_config import (
+            QueryConfiguration,
+            QueryType,
+        )
+
+        grid, conf, source, _ = _toy_pipeline()
+        if naive:
+            # Micro-batches wide enough that each holds BOTH sides of
+            # the interleaved stream (events are 100 ms apart).
+            conf = QueryConfiguration(QueryType.RealTimeNaive,
+                                      realtime_batch_ms=2000)
+        op = PointPointJoinQuery(conf, grid)
+        left = [e for i, e in enumerate(source()) if i % 2 == 0]
+        right = [e for i, e in enumerate(source()) if i % 2 == 1]
+        return list(op.run(iter(left), iter(right), 1.5, driver=driver))
+
+    def test_knn_no_driver_is_single_attempt(self):
+        faults.arm([{"point": "driver.window", "at": 1, "times": 1}])
+        with pytest.raises(InjectedFault):
+            self._knn()  # one transient fault; a retry WOULD recover
+        assert faults.counts.get("driver.window") == 1
+
+    def test_join_no_driver_is_single_attempt(self):
+        faults.arm([{"point": "driver.window", "at": 1, "times": 1}])
+        with pytest.raises(InjectedFault):
+            self._join()
+        assert faults.counts.get("driver.window") == 1
+
+    def test_knn_failover_parity(self):
+        base = self._knn()
+        faults.arm([{"point": "driver.window", "at": 2, "times": 10_000}])
+        drv = WindowedDataflowDriver(
+            retry=RetryPolicy(max_retries=0, backoff_s=0.0))
+        driven = self._knn(driver=drv)
+        faults.disarm()
+        assert drv.backend == "fallback"
+        assert len(driven) == len(base) > 4
+        assert any(r.neighbors for r in base), "degenerate: no neighbors"
+        for a, b in zip(base, driven):
+            assert (a.start, a.end) == (b.start, b.end)
+            # Same ordered (objID, representative) winners; distances
+            # agree to float ulps (FMA fusion freedom).
+            assert [(oid, ev.obj_id) for oid, _, ev in a.neighbors] == \
+                   [(oid, ev.obj_id) for oid, _, ev in b.neighbors]
+            np.testing.assert_allclose(
+                [d for _, d, _ in a.neighbors],
+                [d for _, d, _ in b.neighbors], rtol=3e-7)
+
+    def test_join_naive_failover_parity(self):
+        base = self._join(naive=True)
+        faults.arm([{"point": "driver.window", "at": 1, "times": 10_000}])
+        drv = WindowedDataflowDriver(
+            retry=RetryPolicy(max_retries=0, backoff_s=0.0))
+        driven = self._join(driver=drv, naive=True)
+        faults.disarm()
+        assert drv.backend == "fallback"
+        assert len(driven) == len(base) > 0
+        assert any(r.pairs for r in base), "degenerate: no pairs"
+        for a, b in zip(base, driven):
+            assert [(x.obj_id, y.obj_id) for x, y, _ in a.pairs] == \
+                   [(x.obj_id, y.obj_id) for x, y, _ in b.pairs]
+            np.testing.assert_allclose(
+                [d for _, _, d in a.pairs], [d for _, _, d in b.pairs],
+                rtol=3e-7)
+
+    def test_join_bucketed_has_no_twin_and_stays_strict(self):
+        """The window-based grid-hash mode's pair order is device
+        compaction order — no twin exists, so even a failover-enabled
+        driver crashes when the device path dies (honest, not silent)."""
+        faults.arm([{"point": "driver.window", "at": 1, "times": 10_000}])
+        drv = WindowedDataflowDriver(
+            retry=RetryPolicy(max_retries=0, backoff_s=0.0))
+        with pytest.raises(InjectedFault):
+            self._join(driver=drv)
+        assert drv.stats["failovers"] == 0
+
+    def test_tjoin_panes_checkpoint_resume_byte_identical(self, tmp_path):
+        """run_soa_panes through run_precomputed: the position counts
+        fired windows; a resume re-runs the deterministic scan and
+        skips the committed prefix. Reuses the chaos-matrix tjoin
+        harness at the driver.window point (the matrix leg itself
+        exercises source.stall)."""
+        from test_chaos_matrix import chaos_tjoin_panes
+
+        chaos_tjoin_panes(tmp_path, "driver.window", at=5)
+
+
 class TestRetry:
     def test_transient_fault_is_retried_and_recovers(self):
         """One injected failure + one retry budget → the run completes

@@ -71,6 +71,7 @@ class TestPlanParsing:
             "device.ship", "device.dispatch", "device.fetch",
             "window.feed", "soa.feed", "kafka.fetch", "kafka.leader",
             "sink.write", "driver.window",
+            "overload.admit", "source.stall",
         }
 
 

@@ -371,6 +371,67 @@ window:
     assert out.read_bytes() == want
 
 
+@pytest.mark.parametrize("option,fault_at", [(3, 40), (5, 40)])
+def test_streaming_job_knn_join_kill_and_resume(tmp_path, option,
+                                                fault_at):
+    """ISSUE 9: the newly driver-wired operators (option 3 = window
+    kNN, option 5 = window join) through --checkpoint — killed mid-run
+    by an armed fault, resumed to byte-identical output."""
+    from spatialflink_tpu.faults import InjectedFault, faults
+    from spatialflink_tpu.streaming_job import main
+
+    conf = tmp_path / "conf.yml"
+    conf.write_text(
+        """
+inputStream1:
+  topicName: t
+  format: CSV
+  csvTsvSchemaAttr: [0, 1, 2, 3]
+  gridBBox: [0.0, 0.0, 10.0, 10.0]
+  numGridCells: 20
+  delimiter: ","
+query:
+  option: %d
+  radius: 3.0
+  k: 3
+  queryPoints:
+    - [5.0, 5.0]
+window:
+  type: "TIME"
+  interval: 10
+  step: 10
+""" % option
+    )
+    csv = tmp_path / "in.csv"
+    csv.write_text("\n".join(
+        f"dev{i%5},{i*500},{4.0 + (i % 7) * 0.4},{4.0 + (i % 5) * 0.5}"
+        for i in range(100)
+    ))
+    clean = tmp_path / "clean.csv"
+    assert main(["--config", str(conf), "--source", f"csv:{csv}",
+                 "--output", str(clean),
+                 "--checkpoint", str(tmp_path / "ck_clean.bin"),
+                 "--checkpoint-every", "1"]) == 0
+    want = clean.read_bytes()
+    assert want, "vacuous: clean run produced no output"
+
+    out = tmp_path / "out.csv"
+    args = ["--config", str(conf), "--source", f"csv:{csv}",
+            "--output", str(out),
+            "--checkpoint", str(tmp_path / "ck.bin"),
+            "--checkpoint-every", "1"]
+    faults.arm([{"point": "window.feed", "at": fault_at,
+                 "times": 10_000}])
+    try:
+        with pytest.raises(InjectedFault):
+            main(args)
+    finally:
+        faults.disarm()
+    assert out.read_bytes() != want  # really interrupted
+    assert main(args) == 0  # resume from the checkpoint
+    assert out.read_bytes() == want
+
+
 def test_streaming_job_checkpoint_arg_validation(tmp_path):
     from spatialflink_tpu.streaming_job import main
 

@@ -568,6 +568,18 @@ def test_new_observability_modules_are_in_pass_scope():
     assert not sync.applies_to("spatialflink_tpu/telemetry.py")
 
 
+def test_overload_module_is_in_pass_scope():
+    """ISSUE 9 scope pin: overload.py joined the fstring-numpy egress
+    scope (transition events + smoke output) and the hotpath
+    import-purity scope (the fire-site hooks import it from every
+    assembler — an import-time dispatch there would dial the tunnel)."""
+    fstr = get_pass("fstring-numpy")
+    assert fstr.applies_to("spatialflink_tpu/overload.py")
+    hot = get_pass("hotpath")
+    assert hot.applies_to("spatialflink_tpu/overload.py")
+    assert hot.applies_to("spatialflink_tpu/driver.py")
+
+
 def test_trajectory_wkt_formats_numpy_scalars_clean():
     from spatialflink_tpu.sncb.common import GpsEvent
     from spatialflink_tpu.sncb.ops import trajectory_wkt

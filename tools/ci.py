@@ -1,6 +1,6 @@
 """THE pre-commit gate: ``python -m tools.ci`` (repo root).
 
-One shot, four stages, fail-fast, distinct banners:
+One shot, five stages, fail-fast, distinct banners:
 
 1. **sfcheck** — the whole-program static analyzer (all ten passes;
    ``--changed`` passes the incremental flag through for the sub-second
@@ -20,14 +20,20 @@ One shot, four stages, fail-fast, distinct banners:
    --chaos-smoke``: a toy driver pipeline killed mid-run by an armed
    ``abort`` fault (``os._exit(137)``, the SIGKILL analog) and resumed
    from its checkpoint — the concatenated exactly-once egress must be
-   byte-identical to a clean run.
+   byte-identical to a clean run;
+5. **overload smoke** — ``python -m spatialflink_tpu.overload
+   --smoke``: a toy burst past a tiny admission budget must shed
+   deterministically, step the degradation ladder down AND back up,
+   carry the shed/degradation budgets through the SLO verdict, and
+   seal every overload transition in the ledger stream.
 
 Exit code: the first failing stage's (sfcheck keeps its 0/1/2/3
 contract; pytest and sfprof theirs). ``--skip-tests`` / ``--skip-bench``
-/ ``--skip-chaos`` trim stages for quick iteration (the chaos smoke is
-CPU-only and independent of the bench stage, so ``--skip-bench`` keeps
-it); ``--dry-run`` prints the stage commands without running anything
-(pinned by tests/test_ci.py).
+/ ``--skip-chaos`` / ``--skip-overload`` trim stages for quick
+iteration (the chaos and overload smokes are CPU-only and independent
+of the bench stage, so ``--skip-bench`` keeps them); ``--dry-run``
+prints the stage commands without running anything (pinned by
+tests/test_ci.py).
 """
 
 from __future__ import annotations
@@ -53,11 +59,15 @@ def _cpu_env() -> Dict[str, str]:
     # arm EVERY stage's subprocesses at import (faults.arm_from_env) and
     # fail a healthy tree with injected faults — the gate runs disarmed.
     env.pop("SFT_FAULT_PLAN", None)
+    # Same rule for a leftover overload policy: the gate's stages must
+    # measure the tree, not an ambient degradation ladder.
+    env.pop("SFT_OVERLOAD_POLICY", None)
     return env
 
 
 def stages(changed: bool, skip_tests: bool, skip_bench: bool,
            skip_chaos: bool = False,
+           skip_overload: bool = False,
            ledger_path: Optional[str] = None,
            stream_path: Optional[str] = None) \
         -> List[Tuple[str, List[List[str]]]]:
@@ -97,6 +107,14 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
         out.append(("chaos-smoke", [
             [py, "-m", "spatialflink_tpu.driver", "--chaos-smoke"],
         ]))
+    if not skip_overload:
+        # Overload smoke: burst → shed → degrade → recover round trip
+        # on toy shapes (spatialflink_tpu/overload.py) — sheds counted,
+        # ladder stepped both ways, budgets in the SLO verdict, every
+        # transition sealed in the ledger stream. CPU-only too.
+        out.append(("overload-smoke", [
+            [py, "-m", "spatialflink_tpu.overload", "--smoke"],
+        ]))
     return out
 
 
@@ -127,6 +145,8 @@ def main(argv=None) -> int:
                     help="skip the bench-smoke + sfprof health stage")
     ap.add_argument("--skip-chaos", action="store_true",
                     help="skip the kill/resume chaos-smoke stage")
+    ap.add_argument("--skip-overload", action="store_true",
+                    help="skip the burst/shed/degrade overload-smoke stage")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the stage commands and exit 0")
     args = ap.parse_args(argv)
@@ -135,7 +155,7 @@ def main(argv=None) -> int:
         ledger = os.path.join(tmpdir, "ledger.json")
         stream = os.path.join(tmpdir, "ledger_stream.jsonl")
         plan = stages(args.changed, args.skip_tests, args.skip_bench,
-                      args.skip_chaos,
+                      args.skip_chaos, args.skip_overload,
                       ledger_path=ledger, stream_path=stream)
         if args.dry_run:
             for name, cmds in plan:

@@ -131,11 +131,13 @@ class FstringNumpyPass(Pass):
         # the scope with the fault-tolerance work: the driver's egress
         # helpers render the exactly-once sink lines (the chaos matrix
         # byte-compares them), and fault events land in the ledger
-        # stream.
+        # stream. overload.py joined with the overload work — its
+        # transition events and smoke output are egress surfaces too.
         return (relpath in ("bench.py", "spatialflink_tpu/telemetry.py",
                             "spatialflink_tpu/slo.py",
                             "spatialflink_tpu/driver.py",
-                            "spatialflink_tpu/faults.py")
+                            "spatialflink_tpu/faults.py",
+                            "spatialflink_tpu/overload.py")
                 or relpath.startswith("spatialflink_tpu/sncb/")
                 or relpath.startswith("spatialflink_tpu/mn/")
                 or relpath.startswith("tools/sfprof/"))

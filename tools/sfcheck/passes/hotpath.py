@@ -61,8 +61,12 @@ class HotpathPass(Pass):
     #: rule covers them too. The wall-clock rule stays ops/-only: the
     #: driver's retry backoff and the injector's hang kind legitimately
     #: read the clock (they are host control plane, never traced).
+    #: overload.py joined with the overload work — the fire-site hooks
+    #: import it from every assembler, so an import-time dispatch there
+    #: would dial the tunnel from the host control plane.
     _HOST_FT_MODULES = ("spatialflink_tpu/driver.py",
-                        "spatialflink_tpu/faults.py")
+                        "spatialflink_tpu/faults.py",
+                        "spatialflink_tpu/overload.py")
 
     def applies_to(self, relpath: str) -> bool:
         return (relpath.startswith("spatialflink_tpu/ops/")

@@ -421,6 +421,27 @@ def cmd_health(args) -> int:
         print(f"note driver self-healing: "
               f"retries={int(drv.get('retries') or 0)} "
               f"failovers={int(drv.get('failovers') or 0)}")
+    # Overload visibility (informational, like self-healing — budget it
+    # via an --slo spec's shed_budget/degraded_window_budget to gate):
+    ov = snap.get("overload") or {}
+    if ov.get("shed_total") or ov.get("degraded_windows") \
+            or ov.get("rung_transitions") or ov.get("backpressure_engaged"):
+        shed = ", ".join(
+            f"{k}={int((v or {}).get('events', 0))}"
+            for k, v in sorted((ov.get("shed") or {}).items())
+        ) or "none"
+        print(f"note overload sheds: total={int(ov.get('shed_total') or 0)}"
+              f" ({shed}); backpressure engaged "
+              f"{int(ov.get('backpressure_engaged') or 0)}x")
+        print(f"note overload degradation: rung={int(ov.get('rung') or 0)}"
+              f"/{int(ov.get('ladder_depth') or 0)} after "
+              f"{int(ov.get('rung_transitions') or 0)} transitions; "
+              f"degraded_windows={int(ov.get('degraded_windows') or 0)}")
+        br = ov.get("breaker") or {}
+        if br:
+            print(f"note overload circuit: state={br.get('state')} "
+                  f"opens={int(br.get('opens') or 0)} "
+                  f"probes={int(br.get('probes') or 0)}")
     if snap.get("faults"):
         fired = ", ".join(f"{k}×{int(v)}"
                           for k, v in sorted(snap["faults"].items()))

@@ -21,6 +21,11 @@ Metric sources in the ledger document:
   (``retries``/``failovers`` — the dataflow driver's self-healing
   counters); a spec budgeting them against a pre-driver ledger FAILS on
   silence, same rule as ``eps_floor``;
+- ``shed_budget`` / ``degraded_window_budget`` → snapshot ``overload``
+  block (``shed_total``/``degraded_windows`` — the overload
+  controller's counters, spatialflink_tpu/overload.py); a spec
+  budgeting them against a ledger with no overload block fails on
+  silence too;
 - ``overflow_budget`` → every ``*overflow*`` counter in the bench block
   and snapshot, summed.
 
@@ -42,7 +47,8 @@ SLO_VERSION = 1
 SPEC_KEYS = (
     "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
     "overflow_budget", "recompile_ceiling", "retry_budget",
-    "failover_budget", "eval_interval_s", "warmup_windows",
+    "failover_budget", "shed_budget", "degraded_window_budget",
+    "eval_interval_s", "warmup_windows",
 )
 
 
@@ -145,6 +151,25 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
         rows.append((
             "slo:failover_budget", fo, f"<= {int(budget)}",
             fo is not None and fo <= budget,
+        ))
+
+    ov = snap.get("overload") or {}
+    budget = _num(spec.get("shed_budget"))
+    if budget is not None:
+        shed = _num(ov.get("shed_total"))
+        rows.append((
+            "slo:shed_budget", shed, f"<= {int(budget)}",
+            # A spec budgeting sheds against a ledger with no overload
+            # block fails on silence (the eps_floor rule).
+            shed is not None and shed <= budget,
+        ))
+
+    budget = _num(spec.get("degraded_window_budget"))
+    if budget is not None:
+        dw = _num(ov.get("degraded_windows"))
+        rows.append((
+            "slo:degraded_window_budget", dw, f"<= {int(budget)}",
+            dw is not None and dw <= budget,
         ))
 
     budget = _num(spec.get("overflow_budget"))
