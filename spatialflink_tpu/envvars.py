@@ -1,0 +1,150 @@
+"""The SFT_* environment-variable registry — one owner and hazard class
+per var.
+
+Every ``SFT_*`` variable the codebase reads is declared here;
+``tools/sfcheck``'s ``env-registry`` pass fails the tree on any
+unregistered ``os.environ``/``getenv`` read site, and on any registered
+var nothing reads (drift cuts both ways). ``tools/ci.py`` derives its
+gate-stage ambient-environment scrub from :func:`gate_scrub_vars`, so a
+new armed-plan var registered here is scrubbed automatically — it can
+never leak an injected fault into a healthy gate run the way an ambient
+``SFT_FAULT_PLAN`` once could.
+
+Hazard classes:
+
+- ``armed`` — arms faults/policies or forces failures; ambient values
+  SABOTAGE any run that did not set them (the chaos/overload plans, the
+  bench failure-forcing test knobs). The CI gate scrubs these from
+  every stage.
+- ``capture`` — selects artifact outputs (ledgers, streams, traces);
+  ambient values redirect captures but never change verdicts, and gate
+  stages that capture set their own.
+- ``tuning`` — behavior knobs with safe defaults (deadlines, smoke
+  sizing, cache dirs); gate stages pin the ones they depend on.
+- ``internal`` — process-internal markers set by a parent for its own
+  children; never user-facing.
+
+This module is deliberately **stdlib-only and import-free** so the CI
+gate can load it by file path without importing the package (whose
+``__init__`` configures jax — the sfprof no-cross-import rule).
+"""
+
+from __future__ import annotations
+
+HAZARD_CLASSES = ("armed", "capture", "tuning", "internal")
+
+#: name → {"owner": reading module, "hazard": class, "doc": one line}
+ENV_VARS = {
+    "SFT_FAULT_PLAN": {
+        "owner": "spatialflink_tpu/faults.py", "hazard": "armed",
+        "doc": "fault plan (inline JSON or path), armed at import",
+    },
+    "SFT_OVERLOAD_POLICY": {
+        "owner": "spatialflink_tpu/overload.py", "hazard": "armed",
+        "doc": "overload policy (inline JSON or path) the driver installs",
+    },
+    "SFT_SLO_SPEC": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "SLO spec evaluated LIVE during a bench run",
+    },
+    "SFT_BENCH_FORCE_FAIL": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "forces the bench child to fail (contract tests)",
+    },
+    "SFT_BENCH_HANG": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "wedges the bench child (supervisor-deadline tests)",
+    },
+    "SFT_BENCH_DIAL_HANG": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "wedges the axon dial (dial-deadline tests)",
+    },
+    "SFT_BENCH_FAKE_RECORD": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "substitutes a canned bench record (contract tests)",
+    },
+    "SFT_BENCH_CHILD": {
+        "owner": "bench.py", "hazard": "armed",
+        "doc": "marks the supervised bench child; ambient value would "
+               "make a fresh bench run skip its own supervisor",
+    },
+    "SFT_LEDGER_PATH": {
+        "owner": "bench.py", "hazard": "capture",
+        "doc": "run-ledger output path",
+    },
+    "SFT_LEDGER_STREAM": {
+        "owner": "spatialflink_tpu/telemetry.py", "hazard": "capture",
+        "doc": "append-only JSONL ledger stream path",
+    },
+    "SFT_LEDGER_STREAM_INTERVAL_S": {
+        "owner": "spatialflink_tpu/telemetry.py", "hazard": "capture",
+        "doc": "stream flush pacing (seconds)",
+    },
+    "SFT_LEDGER_DIR": {
+        "owner": "bench_suite.py", "hazard": "capture",
+        "doc": "per-config ledger directory for suite runs",
+    },
+    "SFT_TRACE_PATH": {
+        "owner": "bench.py", "hazard": "capture",
+        "doc": "Chrome-trace JSONL output path",
+    },
+    "SFT_PROFILE_DIR": {
+        "owner": "bench.py", "hazard": "capture",
+        "doc": "jax profiler trace directory",
+    },
+    "SFT_BENCH_LAST_GOOD": {
+        "owner": "bench.py", "hazard": "capture",
+        "doc": "last-good bench record store (gate uses a toy copy)",
+    },
+    "SFT_BENCH_SMOKE": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "toy-size smoke mode for the CI gate",
+    },
+    "SFT_BENCH_BACKOFFS": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "supervisor retry backoff schedule (seconds, comma-sep)",
+    },
+    "SFT_BENCH_DEADLINE": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "per-attempt bench supervisor deadline (seconds)",
+    },
+    "SFT_DIAL_DEADLINE_S": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "axon dial deadline; timeout seals the stream",
+    },
+    "SFT_NO_LINK_PROBE": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "disables the tunnel link-health probe",
+    },
+    "SFT_NO_PALLAS_DIGEST": {
+        "owner": "bench.py", "hazard": "tuning",
+        "doc": "disables the pallas digest path on TPU",
+    },
+    "SFT_JAX_CACHE_DIR": {
+        "owner": "spatialflink_tpu/runtime.py", "hazard": "tuning",
+        "doc": "persistent XLA compile cache dir ('off' disables)",
+    },
+    "_SFT_DRYRUN_CLEAN": {
+        "owner": "__graft_entry__.py", "hazard": "internal",
+        "doc": "marks the re-execed CPU-clean multichip dryrun child",
+    },
+}
+
+
+def gate_scrub_vars() -> list:
+    """The vars the CI gate must remove from every stage's ambient
+    environment: everything hazard-class ``armed``."""
+    return sorted(n for n, meta in ENV_VARS.items()
+                  if meta["hazard"] == "armed")
+
+
+def _selfcheck() -> None:
+    for name, meta in ENV_VARS.items():
+        if meta["hazard"] not in HAZARD_CLASSES:
+            raise ValueError(
+                f"ENV_VARS[{name!r}]: unknown hazard class "
+                f"{meta['hazard']!r} (classes: {HAZARD_CLASSES})"
+            )
+
+
+_selfcheck()

@@ -267,9 +267,20 @@ class SloEngine:
                         "window_seq": self.windows,
                     }))
         for kind, rec in transitions:
-            self.tel.emit_instant(f"{kind}:{rec['check']}",
-                                  value=rec["value"], bound=rec["bound"],
-                                  window_seq=rec["window_seq"])
+            # Two literal branches, not one f"{kind}:…": the event-name
+            # HEAD must be a static literal so sfcheck's contract-twin
+            # pass can hold it against the sfprof consumer registry —
+            # a dynamic head is statically uncheckable.
+            if kind == "slo_violation":
+                self.tel.emit_instant(f"slo_violation:{rec['check']}",
+                                      value=rec["value"],
+                                      bound=rec["bound"],
+                                      window_seq=rec["window_seq"])
+            else:
+                self.tel.emit_instant(f"slo_recovered:{rec['check']}",
+                                      value=rec["value"],
+                                      bound=rec["bound"],
+                                      window_seq=rec["window_seq"])
         if any(kind == "slo_violation" for kind, _ in transitions):
             # A violation is exactly the record that must survive the
             # run dying right after it — force the stream segment out.

@@ -424,7 +424,7 @@ class Telemetry:
             self._flush_stream_locked()
             if slo is None and self.slo_provider is not None:
                 try:
-                    slo = self.slo_provider()
+                    slo = self.slo_provider()  # sfcheck: ok=lock-discipline -- documented one-way lock order: the SLO engine re-enters this RLock on the same thread (safe) and the overload controller queues its emits (overload._emit_locked) instead of ever taking this lock
                 except Exception:  # a broken verdict must not block the seal
                     slo = None
             ep = {
@@ -970,7 +970,7 @@ class Telemetry:
                 out["faults"] = dict(self.fault_fires)
         if self.overload_provider is not None:
             try:
-                out["overload"] = json_safe(self.overload_provider())
+                out["overload"] = json_safe(self.overload_provider())  # sfcheck: ok=lock-discipline -- stream-flush checkpoints call this under Telemetry._lock by design; the provider contract (documented at overload.OverloadController._lock) forbids providers from taking telemetry's lock — overload queues transition emits for after release
             except Exception:  # a broken provider must not break snapshots
                 pass
         link = self.link_gauges()

@@ -1,0 +1,7 @@
+"""Deliberate violation corpus (contract-twin): an injection point with
+no chaos-matrix leg."""
+
+INJECTION_POINTS = {
+    "p.one": "covered point",
+    "p.two": "registered but unmatrixed — unrehearsed failure mode",
+}

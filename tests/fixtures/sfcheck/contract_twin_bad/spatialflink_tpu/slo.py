@@ -1,0 +1,10 @@
+"""Deliberate violation corpus (contract-twin): the live SLO spec —
+one field its mirror lacks, and a drifted version pin."""
+
+SLO_VERSION = 2
+
+
+class SloSpec:
+    name: str = "default"
+    lag_ms: float = 0.0
+    extra_live_only: int = 0

@@ -1,0 +1,15 @@
+"""Deliberate violation corpus (contract-twin): an unregistered event
+name and a dynamic (uncheckable) event-name head among good emits."""
+
+
+class Tel:
+    def emit_instant(self, name, **args):
+        return name
+
+
+def produce(tel, point):
+    tel.emit_instant("good_event")
+    tel.emit_instant("typo_event")  # absent from the consumer registry
+    tel.emit_instant(f"used_prefix:{point}")
+    kind = "x"
+    tel.emit_instant(f"{kind}:{point}")  # no literal head: uncheckable

@@ -1,0 +1,7 @@
+"""Deliberate violation corpus (contract-twin): the matrix misses a
+registered point and carries a dead leg."""
+
+MATRIX = {
+    "p.one": None,
+    "p.ghost": None,  # matches no registered injection point
+}

@@ -1,0 +1,6 @@
+"""Deliberate violation corpus (contract-twin): the consumer registry —
+one entry nothing emits."""
+
+INSTANT_EVENTS = frozenset({"good_event", "never_emitted"})
+
+INSTANT_EVENT_PREFIXES = ("used_prefix:",)

@@ -1,0 +1,6 @@
+"""Deliberate violation corpus (contract-twin): the post-hoc mirror —
+stale version, one missing field, one field the live side never had."""
+
+SLO_VERSION = 1
+
+SPEC_KEYS = ("name", "lag_ms", "mirror_only")

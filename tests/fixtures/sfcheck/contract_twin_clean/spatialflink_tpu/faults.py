@@ -1,0 +1,6 @@
+"""Clean twin (contract-twin): every point has a matrix leg."""
+
+INJECTION_POINTS = {
+    "p.one": "covered point",
+    "p.two": "also covered",
+}

@@ -1,0 +1,8 @@
+"""Clean twin (contract-twin): live SLO spec aligned with its mirror."""
+
+SLO_VERSION = 1
+
+
+class SloSpec:
+    name: str = "default"
+    lag_ms: float = 0.0

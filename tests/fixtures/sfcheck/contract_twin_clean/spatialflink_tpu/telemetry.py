@@ -1,0 +1,12 @@
+"""Clean twin (contract-twin): every emitted name/prefix registered,
+every registered entry emitted, all heads literal."""
+
+
+class Tel:
+    def emit_instant(self, name, **args):
+        return name
+
+
+def produce(tel, point):
+    tel.emit_instant("good_event")
+    tel.emit_instant(f"used_prefix:{point}")

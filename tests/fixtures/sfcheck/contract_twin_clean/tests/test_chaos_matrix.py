@@ -1,0 +1,6 @@
+"""Clean twin (contract-twin): matrix == registry, both ways."""
+
+MATRIX = {
+    "p.one": None,
+    "p.two": None,
+}

@@ -1,0 +1,5 @@
+"""Clean twin (contract-twin): the mirror matches field-for-field."""
+
+SLO_VERSION = 1
+
+SPEC_KEYS = ("name", "lag_ms")

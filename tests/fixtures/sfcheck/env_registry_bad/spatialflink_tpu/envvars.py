@@ -1,0 +1,28 @@
+"""Deliberate violation corpus (env-registry): registry for the bad
+mini-repo — one dead entry, and one armed var the gate never scrubs."""
+
+HAZARD_CLASSES = ("armed", "capture", "tuning", "internal")
+
+ENV_VARS = {
+    "SFT_KNOWN": {
+        "owner": "spatialflink_tpu/mod.py", "hazard": "tuning",
+        "doc": "a registered knob",
+    },
+    "SFT_ARMED_PLAN": {
+        "owner": "spatialflink_tpu/mod.py", "hazard": "armed",
+        "doc": "an armed plan the gate scrubs by hand",
+    },
+    "SFT_ARMED_UNSCRUBBED": {
+        "owner": "spatialflink_tpu/mod.py", "hazard": "armed",
+        "doc": "an armed plan the hand-listed scrub misses",
+    },
+    "SFT_DEAD": {
+        "owner": "nobody", "hazard": "capture",
+        "doc": "registered but read nowhere — drift",
+    },
+}
+
+
+def gate_scrub_vars() -> list:
+    return sorted(n for n, meta in ENV_VARS.items()
+                  if meta["hazard"] == "armed")

@@ -1,0 +1,12 @@
+"""Deliberate violation corpus (env-registry): one unregistered SFT_*
+read among registered ones."""
+
+import os
+
+
+def read_config():
+    a = os.environ.get("SFT_KNOWN")
+    b = os.environ.get("SFT_UNREGISTERED")  # not in ENV_VARS
+    c = os.environ.get("SFT_ARMED_PLAN")
+    d = os.environ.get("SFT_ARMED_UNSCRUBBED")
+    return a, b, c, d

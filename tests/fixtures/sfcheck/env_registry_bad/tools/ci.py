@@ -1,0 +1,10 @@
+"""Deliberate violation corpus (env-registry): the gate hand-lists its
+scrub, so the second armed var leaks into the stages."""
+
+import os
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env.pop("SFT_ARMED_PLAN", None)  # hand-listed: misses the other one
+    return env

@@ -1,0 +1,20 @@
+"""Clean twin (env-registry): every read registered, every entry read,
+and the gate derives its scrub from the registry."""
+
+HAZARD_CLASSES = ("armed", "capture", "tuning", "internal")
+
+ENV_VARS = {
+    "SFT_KNOWN": {
+        "owner": "spatialflink_tpu/mod.py", "hazard": "tuning",
+        "doc": "a registered knob",
+    },
+    "SFT_ARMED_PLAN": {
+        "owner": "spatialflink_tpu/mod.py", "hazard": "armed",
+        "doc": "an armed plan the gate scrubs via gate_scrub_vars",
+    },
+}
+
+
+def gate_scrub_vars() -> list:
+    return sorted(n for n, meta in ENV_VARS.items()
+                  if meta["hazard"] == "armed")

@@ -1,0 +1,9 @@
+"""Clean twin (env-registry): registered reads only."""
+
+import os
+
+
+def read_config():
+    a = os.environ.get("SFT_KNOWN")
+    b = os.environ.get("SFT_ARMED_PLAN")
+    return a, b

@@ -1,0 +1,22 @@
+"""Clean twin (env-registry): the gate scrub is DERIVED from the
+registry's hazard classes — new armed vars are scrubbed automatically."""
+
+import os
+
+
+def _registry():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "spatialflink_tpu", "envvars.py")
+    spec = importlib.util.spec_from_file_location("_envvars", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    for var in _registry().gate_scrub_vars():
+        env.pop(var, None)
+    return env

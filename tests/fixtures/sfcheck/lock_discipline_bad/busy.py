@@ -1,0 +1,25 @@
+"""Deliberate violation corpus (lock-discipline): the three
+hazard-under-lock classes — a cross-module telemetry emit, blocking
+work, and a user callback, each inside a `with self._lock:` region."""
+
+import threading
+import time
+
+
+class Busy:
+    def __init__(self, tel):
+        self._lock = threading.Lock()
+        self.tel = tel
+        self.done_callback = None
+
+    def flush(self):
+        with self._lock:
+            self.tel.emit_instant("busy_flush")  # emit under lock
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.01)  # blocking under lock
+
+    def snap(self):
+        with self._lock:
+            self.done_callback()  # arbitrary user code under lock

@@ -1,0 +1,19 @@
+"""Deliberate violation corpus (lock-discipline): half A of a seeded
+two-module lock-order cycle — `ping` acquires modb's lock while holding
+`_LOCK_A`; modb.pong acquires this one while holding `_LOCK_B`."""
+
+import threading
+
+import modb
+
+_LOCK_A = threading.Lock()
+
+
+def ping():
+    with _LOCK_A:
+        modb.bump()  # A → B
+
+
+def ding():
+    with _LOCK_A:
+        return 1

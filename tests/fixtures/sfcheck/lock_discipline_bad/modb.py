@@ -1,0 +1,18 @@
+"""Deliberate violation corpus (lock-discipline): half B of the seeded
+lock-order cycle (see moda.py). Never imported — parsed only."""
+
+import threading
+
+import moda
+
+_LOCK_B = threading.Lock()
+
+
+def bump():
+    with _LOCK_B:
+        return 2
+
+
+def pong():
+    with _LOCK_B:
+        moda.ding()  # B → A: the opposite order — cycle

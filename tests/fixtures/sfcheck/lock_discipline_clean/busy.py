@@ -1,0 +1,38 @@
+"""Clean twin: emits queued under the lock and drained after release
+(the overload._emit_locked idiom); blocking work and callbacks outside
+the critical section."""
+
+import threading
+import time
+
+
+class Busy:
+    def __init__(self, tel):
+        self._lock = threading.Lock()
+        self.tel = tel
+        self.done_callback = None
+        self._pending = []
+
+    def flush(self):
+        with self._lock:
+            self._pending.append("busy_flush")  # queue, don't emit
+        self._drain()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                name = self._pending.pop(0)
+            self.tel.emit_instant(name)  # emitted lock-free
+
+    def wait(self):
+        with self._lock:
+            deadline = 0.01
+        time.sleep(deadline)  # blocking work outside the lock
+
+    def snap(self):
+        with self._lock:
+            cb = self.done_callback
+        if cb is not None:
+            cb()  # user code runs lock-free

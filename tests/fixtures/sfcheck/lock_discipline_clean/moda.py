@@ -1,0 +1,18 @@
+"""Clean twin of lock_discipline_bad: one global acquisition order
+(A before B, never the reverse)."""
+
+import threading
+
+import modb
+
+_LOCK_A = threading.Lock()
+
+
+def ping():
+    with _LOCK_A:
+        modb.bump()  # A → B is the one sanctioned order
+
+
+def ding():
+    with _LOCK_A:
+        return 1

@@ -1,0 +1,19 @@
+"""Clean twin: modb calls back into moda only OUTSIDE its lock."""
+
+import threading
+
+import moda
+
+_LOCK_B = threading.Lock()
+
+
+def bump():
+    with _LOCK_B:
+        return 2
+
+
+def pong():
+    with _LOCK_B:
+        staged = 3
+    moda.ding()  # lock released first: no B → A edge
+    return staged

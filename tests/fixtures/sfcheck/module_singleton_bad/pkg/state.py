@@ -1,0 +1,33 @@
+"""Deliberate violation corpus (module-singleton): a module holding an
+install-slot global AND a module-level singleton, runnable via
+``python -m pkg.state`` — with a __main__ guard that does NOT delegate
+to the canonical import. Running it would create a second module
+instance whose `install()` is invisible to canonically-importing hooks
+(the overload --smoke dual-instance trap)."""
+
+import sys
+
+
+class Registry:
+    def __init__(self):
+        self.items = []
+
+
+registry = Registry()
+
+_slot = None
+
+
+def install(ctrl):
+    global _slot
+    _slot = ctrl
+    return ctrl
+
+
+def main():
+    install(object())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

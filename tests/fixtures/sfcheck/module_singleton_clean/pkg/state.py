@@ -1,0 +1,32 @@
+"""Clean twin of module_singleton_bad: the __main__ guard delegates to
+the canonical import, so the entry point and every canonically-importing
+hook share ONE module instance (the overload.py idiom)."""
+
+import sys
+
+
+class Registry:
+    def __init__(self):
+        self.items = []
+
+
+registry = Registry()
+
+_slot = None
+
+
+def install(ctrl):
+    global _slot
+    _slot = ctrl
+    return ctrl
+
+
+def main():
+    install(object())
+    return 0
+
+
+if __name__ == "__main__":
+    from pkg.state import main as _canonical_main
+
+    sys.exit(_canonical_main())

@@ -88,6 +88,14 @@ def test_all_green_runs_every_stage(monkeypatch):
         envs.append(env)
         return P()
 
+    # Seed EVERY hazard-class-`armed` registry var ambient: the scrub
+    # is derived from spatialflink_tpu/envvars.py, so all of them —
+    # not just the historical FAULT_PLAN/OVERLOAD_POLICY pair — must
+    # vanish from every stage env.
+    armed = ci._envvars_registry().gate_scrub_vars()
+    assert "SFT_FAULT_PLAN" in armed and "SFT_SLO_SPEC" in armed
+    for var in armed:
+        monkeypatch.setenv(var, "ambient-sabotage")
     monkeypatch.setattr(ci.subprocess, "run", fake_run)
     assert ci.main([]) == 0
     assert any("bench.py" in c for c in calls)
@@ -101,6 +109,8 @@ def test_all_green_runs_every_stage(monkeypatch):
     # (an armed abort plan would kill healthy stages like a real kill -9)
     assert all(e["PALLAS_AXON_POOL_IPS"] == "" for e in envs)
     assert all("SFT_FAULT_PLAN" not in e for e in envs)
+    # the derived scrub: no armed var survives into ANY stage
+    assert all(v not in e for e in envs for v in armed)
     bench_env = envs[[i for i, c in enumerate(calls)
                       if "bench.py" in c][0]]
     assert bench_env["SFT_BENCH_SMOKE"] == "1"

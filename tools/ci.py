@@ -2,9 +2,9 @@
 
 One shot, five stages, fail-fast, distinct banners:
 
-1. **sfcheck** — the whole-program static analyzer (all ten passes;
-   ``--changed`` passes the incremental flag through for the sub-second
-   path);
+1. **sfcheck** — the whole-program static analyzer (all fourteen
+   passes; ``--changed`` passes the incremental flag through for the
+   sub-second path);
 2. **quick-tier pytest** — ``pytest tests/ -m 'not slow'`` on CPU
    (PALLAS_AXON_POOL_IPS emptied so nothing dials the axon tunnel at
    interpreter boot — the CLAUDE.md outage rule);
@@ -39,6 +39,7 @@ tests/test_ci.py).
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import subprocess
 import sys
@@ -48,20 +49,37 @@ from typing import Dict, List, Optional, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@functools.lru_cache(maxsize=1)
+def _envvars_registry():
+    """Load spatialflink_tpu/envvars.py by FILE PATH, never by package
+    import: the package __init__ configures jax (and with ambient pool
+    IPs any interpreter-level jax touch can dial the tunnel). The
+    registry module is deliberately stdlib-only for exactly this
+    loader."""
+    import importlib.util
+
+    path = os.path.join(REPO_ROOT, "spatialflink_tpu", "envvars.py")
+    spec = importlib.util.spec_from_file_location("_sft_envvars", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _cpu_env() -> Dict[str, str]:
     env = dict(os.environ)
     # Never dial the axon tunnel from a pre-commit run (a down/half-open
     # tunnel hangs ANY python start when the pool IPs are set).
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("SFT_BENCH_CHILD", None)
-    # An ambient fault plan (left over from chaos-test iteration) would
-    # arm EVERY stage's subprocesses at import (faults.arm_from_env) and
-    # fail a healthy tree with injected faults — the gate runs disarmed.
-    env.pop("SFT_FAULT_PLAN", None)
-    # Same rule for a leftover overload policy: the gate's stages must
-    # measure the tree, not an ambient degradation ladder.
-    env.pop("SFT_OVERLOAD_POLICY", None)
+    # Scrub every hazard-class-`armed` var (ambient fault plans,
+    # overload policies, live SLO specs, bench failure-forcing knobs):
+    # any of them left over from test iteration would sabotage a
+    # healthy gate run with injected behavior. The list is DERIVED from
+    # the registry (spatialflink_tpu/envvars.py), so the next armed
+    # var registered there is scrubbed here automatically — sfcheck's
+    # env-registry pass pins this derivation.
+    for var in _envvars_registry().gate_scrub_vars():
+        env.pop(var, None)
     return env
 
 

@@ -23,7 +23,9 @@ from typing import Dict, Optional, Tuple
 from tools.sfcheck.core import Finding
 from tools.sfcheck.project import FileFacts, facts_from_dict
 
-SCHEMA_VERSION = 1
+#: v2: FileFacts gained the v3 concurrency/contract fact kinds (lock
+#: spans, env reads, emit sites, constants, main guard).
+SCHEMA_VERSION = 2
 
 _SFCHECK_DIR = os.path.dirname(os.path.abspath(__file__))
 

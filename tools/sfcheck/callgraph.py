@@ -116,7 +116,8 @@ class CallGraph:
         return None
 
     def _resolve_method(self, cls_name: Optional[str], method: str,
-                        facts: FileFacts) -> List[Tuple[str, str]]:
+                        facts: FileFacts,
+                        strict: bool = False) -> List[Tuple[str, str]]:
         seen: Set[str] = set()
         stack = [cls_name] if cls_name else []
         while stack:
@@ -129,20 +130,30 @@ class CallGraph:
                     return [(rel, c["methods"][method])]
                 for b in c["bases"]:
                     stack.append(b.split(".")[-1])
+        if strict:
+            # Strict callers (lock-discipline) reject the global
+            # unique-method-name guess: `file.flush()` resolving into an
+            # unrelated class's `flush` would fabricate lock edges.
+            return []
         hits = self._methods.get(method, [])
         if len(hits) == 1:
             return list(hits)
         return []
 
     def resolve(self, facts: FileFacts, caller: FunctionFacts,
-                target: str) -> List[Tuple[str, str]]:
+                target: str, strict: bool = False) -> List[Tuple[str, str]]:
         """Project functions a call-fact target may refer to ([] if the
-        call leaves the project or cannot be resolved)."""
+        call leaves the project or cannot be resolved). ``strict``
+        drops the unique-method-name last resorts — only edges grounded
+        in a def, an import, or a class walk survive."""
         if target.startswith("."):                 # method on expression
+            if strict:
+                return []
             return self._resolve_method(None, target[1:], facts)
         parts = target.split(".")
         if parts[0] == "self" and len(parts) == 2:
-            return self._resolve_method(caller.cls, parts[1], facts)
+            return self._resolve_method(caller.cls, parts[1], facts,
+                                        strict=strict)
         if len(parts) == 1:
             # nested defs of the caller / its enclosing chain first
             q = caller.qualname
@@ -174,13 +185,17 @@ class CallGraph:
                     if parts[1] in c["methods"]:
                         return [(target_facts.relpath,
                                  c["methods"][parts[1]])]
+            if strict:
+                return []
             return self._resolve_method(None, parts[1], facts)
         # ClassName.method / class instantiation chains: best effort
         if parts[0] in self._classes and len(parts) == 2:
-            return self._resolve_method(parts[0], parts[1], facts)
+            return self._resolve_method(parts[0], parts[1], facts,
+                                        strict=strict)
         # method on an unresolved receiver (local var, param): the
         # unique-method-name heuristic is the last resort
-        if len(parts) == 2 and parts[0] not in facts.functions:
+        if not strict and len(parts) == 2 \
+                and parts[0] not in facts.functions:
             return self._resolve_method(None, parts[1], facts)
         return []
 

@@ -124,6 +124,14 @@ def _run(args) -> int:
                      "evidence": list(f.evidence)}
                     for f in report.findings
                 ],
+                # analyzer-cost telemetry: per-pass wall seconds (cache
+                # hits contribute nothing) + cache effectiveness, so a
+                # pass that got slow or a cache that stopped hitting is
+                # visible in the gate logs
+                "timings": report.timings,
+                "cache": {"hits": report.cache_hits,
+                          "misses": report.cache_misses},
+                "elapsed_s": report.elapsed_s,
             }, indent=2))
         else:
             for f in report.findings:
@@ -131,6 +139,20 @@ def _run(args) -> int:
             if report.findings:
                 print(f"sfcheck: {len(report.findings)} finding(s) "
                       f"across {report.files} file(s)")
+            if report.default_mode:
+                # Whole-tree runs (the gate) always print the cost
+                # summary; targeted runs stay quiet-when-clean.
+                slowest = max(report.timings.items(),
+                              key=lambda kv: kv[1],
+                              default=(None, 0.0))
+                slow_txt = (f"; slowest pass {slowest[0]} "
+                            f"{float(slowest[1]):.2f}s"
+                            if slowest[0] else "")
+                print(f"sfcheck: {report.files} file(s), "
+                      f"{len(report.findings)} finding(s) in "
+                      f"{float(report.elapsed_s):.2f}s (cache "
+                      f"{report.cache_hits} hit / "
+                      f"{report.cache_misses} miss{slow_txt})")
     except BrokenPipeError:
         _detach_stdout()
     return code

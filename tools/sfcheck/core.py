@@ -110,6 +110,16 @@ class Report:
     findings: List[Finding]
     files: int
     pass_names: List[str]
+    #: analyzer-cost telemetry (driver runs fill these): per-pass wall
+    #: seconds of actual analysis (cache hits contribute nothing),
+    #: cache hit/miss counts, and the end-to-end wall time.
+    timings: dict = dataclasses.field(default_factory=dict)
+    cache_hits: int = 0
+    cache_misses: int = 0
+    elapsed_s: float = 0.0
+    #: True when the run covered the default whole-tree target set
+    #: (the CLI prints its cost summary only there).
+    default_mode: bool = False
 
     def counts(self) -> dict:
         out = {name: 0 for name in self.pass_names}

@@ -22,6 +22,7 @@ and a directory passed with ``--pass`` becomes its own project root
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tools.sfcheck import core
@@ -37,6 +38,28 @@ from tools.sfcheck.passes import (
 )
 
 DEFAULT_CACHE = os.path.join(core.REPO_ROOT, ".sfcheck_cache.json")
+
+
+class _TimedPass:
+    """Transparent proxy accumulating a file pass's ``run()`` wall time
+    into a shared book — analyzer-cost regressions must be visible in
+    the gate (`--json` carries the per-pass breakdown)."""
+
+    def __init__(self, p, book: Dict[str, float]):
+        self._p = p
+        self._book = book
+
+    def __getattr__(self, attr):
+        return getattr(self._p, attr)
+
+    def run(self, ctx):
+        t0 = time.perf_counter()
+        try:
+            return self._p.run(ctx)
+        finally:
+            name = self._p.name
+            self._book[name] = self._book.get(name, 0.0) \
+                + time.perf_counter() - t0
 
 
 def _collect_targets(paths: Optional[Sequence[str]],
@@ -110,6 +133,8 @@ def run(
     ``changed=True`` reuses valid cache entries instead of re-analyzing
     (the sub-second pre-commit mode); plain runs re-analyze everything
     and refresh the cache."""
+    t_run0 = time.perf_counter()
+    timings: Dict[str, float] = {}
     targets, default_mode = _collect_targets(paths, project_root)
 
     selected = set(pass_names) if pass_names else set(PASS_NAMES)
@@ -143,6 +168,10 @@ def run(
     display_path: Dict[str, str] = {}
     explicit_rels: set = set()
     files = 0
+    cache_hits = 0
+    cache_misses = 0
+    timed_file_passes = [_TimedPass(p, timings)
+                         for p in internal_file_passes]
     for path, relpath, explicit in targets:
         files += 1
         display_path[relpath] = path
@@ -151,10 +180,12 @@ def run(
         hit = cache.lookup(relpath, path) if (cache and cache.loaded) \
             else None
         if hit is not None:
+            cache_hits += 1
             findings, consumed, facts = hit
         else:
+            cache_misses += 1
             findings, consumed, facts, raw, mtime_ns = _analyze_file(
-                path, relpath, internal_file_passes,
+                path, relpath, timed_file_passes,
                 force=force and explicit)
             if cache is not None:
                 cache.store(relpath, path, raw, findings, consumed, facts,
@@ -164,8 +195,11 @@ def run(
         project.add(facts)
 
     if internal_project_passes:
+        t_graph0 = time.perf_counter()
         graph = CallGraph(project)
+        timings["call-graph"] = time.perf_counter() - t_graph0
         for p in internal_project_passes:
+            t_pass0 = time.perf_counter()
             # force-widening mirrors the file passes: explicit FILES are
             # force-checked, directory contents stay scope-filtered
             def in_scope(rel, _p=p):
@@ -182,8 +216,11 @@ def run(
                 all_findings.append(Finding(
                     display_path.get(f.path, f.path), f.lineno,
                     f.end_lineno, f.pass_name, f.message, f.evidence))
+            timings[p.name] = timings.get(p.name, 0.0) \
+                + time.perf_counter() - t_pass0
 
     if want_staleness:
+        t_stale0 = time.perf_counter()
         for relpath, facts in project.files.items():
             used = consumed_by_file.get(relpath, set())
             for pr in facts.pragmas:
@@ -198,6 +235,7 @@ def run(
                     f"findings for {what}) — delete it; dead "
                     "suppressions hide future regressions",
                 ))
+        timings[STALENESS.name] = time.perf_counter() - t_stale0
 
     if cache is not None:
         cache.save()
@@ -205,5 +243,9 @@ def run(
     emitted = [f for f in all_findings
                if f.pass_name in selected or f.pass_name == "syntax"]
     emitted.sort(key=lambda f: (f.path, f.lineno, f.pass_name))
-    report = Report(emitted, files, sorted(selected))
+    report = Report(emitted, files, sorted(selected),
+                    timings={k: round(v, 4) for k, v in timings.items()},
+                    cache_hits=cache_hits, cache_misses=cache_misses,
+                    elapsed_s=round(time.perf_counter() - t_run0, 4),
+                    default_mode=default_mode)
     return report

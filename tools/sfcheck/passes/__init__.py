@@ -9,12 +9,16 @@ descriptor so ``--list-passes``/``--pass`` see it.
 
 from __future__ import annotations
 
+from tools.sfcheck.passes.contract_twin import ContractTwinPass
 from tools.sfcheck.passes.donation_safety import DonationSafetyPass
+from tools.sfcheck.passes.env_registry import EnvRegistryPass
 from tools.sfcheck.passes.fixed_shape import FixedShapePass
 from tools.sfcheck.passes.fstring_numpy import FstringNumpyPass
 from tools.sfcheck.passes.hotpath import HotpathPass
 from tools.sfcheck.passes.hotpath_interproc import HotpathInterprocPass
+from tools.sfcheck.passes.lock_discipline import LockDisciplinePass
 from tools.sfcheck.passes.mesh_parity import MeshParityPass
+from tools.sfcheck.passes.module_singleton import ModuleSingletonPass
 from tools.sfcheck.passes.recompile_surface import RecompileSurfacePass
 from tools.sfcheck.passes.sync_discipline import SyncDisciplinePass
 from tools.sfcheck.passes.trace_hygiene import TraceHygienePass
@@ -47,6 +51,11 @@ PROJECT_PASSES = (
     MeshParityPass(),
     RecompileSurfacePass(),
     DonationSafetyPass(),
+    # v3: concurrency discipline + cross-module contract analysis
+    LockDisciplinePass(),
+    ModuleSingletonPass(),
+    EnvRegistryPass(),
+    ContractTwinPass(),
 )
 
 STALENESS = PragmaStalenessRule()

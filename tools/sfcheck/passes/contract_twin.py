@@ -1,0 +1,293 @@
+"""contract-twin pass — statically diff the twin contracts.
+
+Invariant: **twin modules stay field-identical without importing each
+other**. The observability stack deliberately keeps validator-side
+mirrors (the sfprof CLI never imports ``spatialflink_tpu``, whose import
+configures jax), which means the contracts hold by convention:
+
+- the live SLO spec (``spatialflink_tpu/slo.py:SloSpec`` dataclass
+  fields) ↔ the post-hoc evaluator's ``tools/sfprof/slo.py:SPEC_KEYS``;
+- the fault-injection registry (``faults.INJECTION_POINTS``) ↔ the
+  chaos matrix (``tests/test_chaos_matrix.py:MATRIX``) — a registered
+  point without a matrix entry is an unrehearsed failure mode;
+- the version pins (``LEDGER_VERSION``/``STREAM_VERSION``/
+  ``SLO_VERSION``) ↔ their sfprof mirrors;
+- every statically-resolvable ``emit_instant`` event name (or literal
+  f-string head) in ``spatialflink_tpu/`` ↔ the consumer registry
+  ``tools/sfprof/events.py`` (``INSTANT_EVENTS`` +
+  ``INSTANT_EVENT_PREFIXES``) — a typo'd event name breaks crash
+  recovery silently, because ``sfprof recover``/``health`` and the
+  smoke tests match events BY NAME on the reconstructed stream. A
+  dynamic name with no literal head is itself a finding: it cannot be
+  checked, so it cannot be trusted.
+
+Hand-written cross-pin tests existed for the version pins; this pass
+makes all four contracts machine-checked on every run, with the diff in
+the evidence chain. Twins whose files are outside the project view are
+skipped (partial-view safety).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import is_test_relpath
+
+#: (rel_a, const_a, rel_b, const_b) — int constants that must be equal.
+VERSION_TWINS = (
+    ("spatialflink_tpu/telemetry.py", "LEDGER_VERSION",
+     "tools/sfprof/ledger.py", "LEDGER_VERSION"),
+    ("spatialflink_tpu/telemetry.py", "STREAM_VERSION",
+     "tools/sfprof/stream.py", "STREAM_VERSION"),
+    ("spatialflink_tpu/slo.py", "SLO_VERSION",
+     "tools/sfprof/slo.py", "SLO_VERSION"),
+)
+
+#: (rel_a, class_a, rel_b, const_b) — dataclass fields ↔ key sequence.
+FIELD_TWINS = (
+    ("spatialflink_tpu/slo.py", "SloSpec",
+     "tools/sfprof/slo.py", "SPEC_KEYS"),
+)
+
+#: (rel_a, const_a, rel_b, const_b) — dict key sets that must be equal.
+KEY_TWINS = (
+    ("spatialflink_tpu/faults.py", "INJECTION_POINTS",
+     "tests/test_chaos_matrix.py", "MATRIX"),
+)
+
+EVENTS_RELPATH = "tools/sfprof/events.py"
+EVENTS_NAMES = "INSTANT_EVENTS"
+EVENTS_PREFIXES = "INSTANT_EVENT_PREFIXES"
+
+#: Producer scan root for emit sites.
+PRODUCER_PREFIX = "spatialflink_tpu/"
+
+
+def _const(project, rel: str, name: str):
+    facts = project.files.get(rel)
+    if facts is None:
+        return None
+    return facts.constants.get(name)
+
+
+def _keys_of(entry) -> Optional[list]:
+    if entry is None:
+        return None
+    c = entry["const"]
+    if isinstance(c, dict):
+        return c["keys"]
+    if isinstance(c, list):
+        return c
+    return None
+
+
+class ContractTwinPass(ProjectPass):
+    name = "contract-twin"
+    description = ("twin contracts stay in sync: SloSpec↔SPEC_KEYS, "
+                   "INJECTION_POINTS↔chaos MATRIX, version pins, and "
+                   "emitted instant-event names ↔ the sfprof consumer "
+                   "registry")
+    invariant = ("no-cross-import twins are machine-diffed: a drifted "
+                 "field, unmatrixed injection point, or typo'd event "
+                 "name is a finding, not a silent recovery gap")
+
+    def in_scope(self, relpath: str) -> bool:
+        return True  # findings anchor at whichever side drifted
+
+    # -- the pass -------------------------------------------------------------
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        findings: List[Finding] = []
+
+        for rel_a, const_a, rel_b, const_b in VERSION_TWINS:
+            a = _const(project, rel_a, const_a)
+            b = _const(project, rel_b, const_b)
+            if a is None or b is None:
+                continue
+            if a["const"] != b["const"]:
+                findings.append(Finding(
+                    rel_b, b["lineno"], b["end_lineno"], self.name,
+                    f"version twin drift: {const_b} = {b['const']!r} "
+                    f"but the live side pins {a['const']!r} — bump "
+                    "BOTH (the no-cross-import twin rule)",
+                    evidence=(
+                        f"{rel_a}:{a['lineno']}: {const_a} = "
+                        f"{a['const']!r}",
+                        f"{rel_b}:{b['lineno']}: {const_b} = "
+                        f"{b['const']!r}",
+                    ),
+                ))
+
+        for rel_a, cls_a, rel_b, const_b in FIELD_TWINS:
+            facts_a = project.files.get(rel_a)
+            b = _const(project, rel_b, const_b)
+            if facts_a is None or b is None \
+                    or cls_a not in facts_a.classes:
+                continue
+            fields = facts_a.classes[cls_a].get("fields") or []
+            twin = _keys_of(b)
+            if twin is None:
+                continue
+            cls_line = facts_a.classes[cls_a].get("lineno", 1)
+            for f in fields:
+                if f not in twin:
+                    findings.append(Finding(
+                        rel_b, b["lineno"], b["end_lineno"], self.name,
+                        f"spec-twin drift: `{cls_a}` declares field "
+                        f"`{f}` but {const_b} does not list it — the "
+                        "post-hoc evaluator would reject (or silently "
+                        "ignore) a spec the live engine accepts",
+                        evidence=(
+                            f"{rel_a}:{cls_line}: `{cls_a}` field "
+                            f"`{f}`",
+                            f"{rel_b}:{b['lineno']}: {const_b} = "
+                            f"({', '.join(twin[:6])}, …)",
+                        ),
+                    ))
+            for f in twin:
+                if f not in fields:
+                    findings.append(Finding(
+                        rel_b, b["lineno"], b["end_lineno"], self.name,
+                        f"spec-twin drift: {const_b} lists `{f}` but "
+                        f"`{cls_a}` has no such field — the mirror "
+                        "accepts specs the live engine rejects",
+                        evidence=(
+                            f"{rel_b}:{b['lineno']}: `{f}` in "
+                            f"{const_b}",
+                            f"{rel_a}:{cls_line}: `{cls_a}` fields: "
+                            f"{', '.join(fields[:8])}, …",
+                        ),
+                    ))
+
+        for rel_a, const_a, rel_b, const_b in KEY_TWINS:
+            a = _const(project, rel_a, const_a)
+            b = _const(project, rel_b, const_b)
+            keys_a, keys_b = _keys_of(a), _keys_of(b)
+            if keys_a is None or keys_b is None:
+                continue
+            for k in keys_a:
+                if k not in keys_b:
+                    findings.append(Finding(
+                        rel_b, b["lineno"], b["end_lineno"], self.name,
+                        f"`{k}` is registered in {const_a} but has no "
+                        f"{const_b} entry — an injection point "
+                        "without an inject→crash→resume leg is an "
+                        "unrehearsed failure mode",
+                        evidence=(
+                            f"{rel_a}:{a['lineno']}: `{k}` in "
+                            f"{const_a}",
+                            f"{rel_b}:{b['lineno']}: {const_b} covers "
+                            f"{len(keys_b)} point(s); `{k}` missing",
+                        ),
+                    ))
+            for k in keys_b:
+                if k not in keys_a:
+                    findings.append(Finding(
+                        rel_b, b["lineno"], b["end_lineno"], self.name,
+                        f"{const_b} entry `{k}` matches no registered "
+                        f"{const_a} point — a dead matrix leg",
+                        evidence=(
+                            f"{rel_b}:{b['lineno']}: `{k}` in "
+                            f"{const_b}",
+                            f"{rel_a}:{a['lineno']}: not registered",
+                        ),
+                    ))
+
+        findings.extend(self._check_emit_names(project))
+        findings.sort(key=lambda f: (f.path, f.lineno))
+        return findings
+
+    # -- emitted event names ↔ sfprof consumer registry -----------------------
+
+    def _check_emit_names(self, project) -> List[Finding]:
+        names_e = _const(project, EVENTS_RELPATH, EVENTS_NAMES)
+        prefixes_e = _const(project, EVENTS_RELPATH, EVENTS_PREFIXES)
+        if names_e is None or prefixes_e is None:
+            return []
+        names = set(_keys_of(names_e) or [])
+        prefixes = list(_keys_of(prefixes_e) or [])
+        findings: List[Finding] = []
+        matched_names = set()
+        matched_prefixes = set()
+
+        for rel, facts, fn in project.iter_functions():
+            if not rel.startswith(PRODUCER_PREFIX) \
+                    or is_test_relpath(rel):
+                continue
+            for site in fn.emit_sites:
+                name = site["name"]
+                if name is None:
+                    findings.append(Finding(
+                        rel, site["lineno"], site["end_lineno"],
+                        self.name,
+                        f"`{site['via']}(…)` event name has no "
+                        "literal head — it cannot be checked against "
+                        "the sfprof consumer registry; start the "
+                        "f-string with the literal event prefix",
+                        evidence=(
+                            f"{rel}:{site['lineno']}: dynamic event "
+                            "name",
+                            f"{EVENTS_RELPATH}:{names_e['lineno']}: "
+                            "the consumer registry matches by literal "
+                            "name/prefix",
+                        ),
+                    ))
+                    continue
+                if site["prefix"]:
+                    hit = [p for p in prefixes if name.startswith(p)]
+                    if hit:
+                        matched_prefixes.update(hit)
+                        continue
+                else:
+                    if name in names:
+                        matched_names.add(name)
+                        continue
+                    hit = [p for p in prefixes if name.startswith(p)]
+                    if hit:
+                        matched_prefixes.update(hit)
+                        continue
+                findings.append(Finding(
+                    rel, site["lineno"], site["end_lineno"], self.name,
+                    f"instant event `{name}`{'…' if site['prefix'] else ''} "
+                    "is emitted but absent from the sfprof consumer "
+                    f"registry ({EVENTS_RELPATH}) — recovery/health "
+                    "consumers match events by name, so a typo here "
+                    "breaks crash recovery silently",
+                    evidence=(
+                        f"{rel}:{site['lineno']}: emits `{name}`"
+                        + ("… (f-string head)" if site["prefix"]
+                           else ""),
+                        f"{EVENTS_RELPATH}:{names_e['lineno']}: "
+                        f"{len(names)} name(s) + {len(prefixes)} "
+                        "prefix(es) registered; no match",
+                    ),
+                ))
+
+        for name in sorted(names - matched_names):
+            findings.append(Finding(
+                EVENTS_RELPATH, names_e["lineno"],
+                names_e["end_lineno"], self.name,
+                f"consumer registry lists instant event `{name}` but "
+                "nothing emits it — drift; delete the entry or fix "
+                "the producer",
+                evidence=(
+                    f"{EVENTS_RELPATH}:{names_e['lineno']}: `{name}` "
+                    f"in {EVENTS_NAMES}",
+                    f"no emit site under {PRODUCER_PREFIX} produces it",
+                ),
+            ))
+        for p in sorted(set(prefixes) - matched_prefixes):
+            findings.append(Finding(
+                EVENTS_RELPATH, prefixes_e["lineno"],
+                prefixes_e["end_lineno"], self.name,
+                f"consumer registry lists event prefix `{p}` but "
+                "nothing emits under it — drift; delete the entry or "
+                "fix the producer",
+                evidence=(
+                    f"{EVENTS_RELPATH}:{prefixes_e['lineno']}: `{p}` "
+                    f"in {EVENTS_PREFIXES}",
+                    f"no emit site under {PRODUCER_PREFIX} matches it",
+                ),
+            ))
+        return findings

@@ -1,0 +1,163 @@
+"""env-registry pass — every ``SFT_*`` env var is registered, and the
+CI gate scrubs every hazardous one.
+
+Invariant: **configuration enters through the registry**
+(``spatialflink_tpu/envvars.py:ENV_VARS`` — owner + hazard class per
+var). 22+ scattered ``SFT_*`` vars grew organically across bench,
+telemetry, faults, overload, and the tools; an unregistered read is
+invisible to the gate's ambient-environment scrub, and a leftover armed
+plan leaking into a gate stage fails a healthy tree with injected
+faults (the exact reason ``tools/ci.py`` hand-scrubbed
+``SFT_FAULT_PLAN``/``SFT_OVERLOAD_POLICY`` before this registry
+existed).
+
+Checks (all skipped when the registry module is outside the project
+view — partial-view safety):
+
+1. every literal ``SFT_*`` read site (``os.environ.get/[]``,
+   ``os.getenv``, ``"X" in os.environ``) in non-test code must name a
+   registered var;
+2. every registered var must have at least one read site somewhere in
+   non-test code — a registry entry nothing reads is drift;
+3. the gate file (``tools/ci.py``) must scrub every var whose hazard
+   class is ``armed``: either it calls the registry's
+   ``gate_scrub_vars()`` (the derived form — new hazardous vars are
+   scrubbed automatically) or it ``.pop``\\ s each one literally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import is_test_relpath
+
+REGISTRY_RELPATH = "spatialflink_tpu/envvars.py"
+REGISTRY_CONST = "ENV_VARS"
+GATE_RELPATH = "tools/ci.py"
+GATE_DERIVER = "gate_scrub_vars"
+HAZARD_ARMED = "armed"
+
+
+def _registry_of(project) -> Optional[dict]:
+    facts = project.files.get(REGISTRY_RELPATH)
+    if facts is None:
+        return None
+    entry = facts.constants.get(REGISTRY_CONST)
+    if entry is None or not isinstance(entry.get("const"), dict):
+        return None
+    return {"facts": facts, "lineno": entry["lineno"],
+            "const": entry["const"]}
+
+
+class EnvRegistryPass(ProjectPass):
+    name = "env-registry"
+    description = ("every SFT_* env read names a var registered in "
+                   "spatialflink_tpu/envvars.py, and tools/ci.py "
+                   "scrubs every hazard-class-`armed` var from its "
+                   "gate stages")
+    invariant = ("configuration enters through the registry: one "
+                 "owner + hazard class per var, and armed-plan vars "
+                 "can never leak into a gate stage")
+
+    def in_scope(self, relpath: str) -> bool:
+        return not is_test_relpath(relpath) \
+            and relpath != REGISTRY_RELPATH
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        reg = _registry_of(project)
+        if reg is None:
+            return []  # no registry in view: nothing checkable
+        keys = set(reg["const"]["keys"])
+        reg_line = reg["lineno"]
+        findings: List[Finding] = []
+
+        read_vars: Dict[str, List[str]] = {}
+        for rel, facts, fn in project.iter_functions():
+            if is_test_relpath(rel) or rel == REGISTRY_RELPATH:
+                continue
+            for site in fn.env_reads:
+                if site["how"] not in ("get", "getitem", "getenv",
+                                       "contains"):
+                    continue
+                var = site["var"]
+                read_vars.setdefault(var, []).append(
+                    f"{rel}:{site['lineno']}")
+                if not var.startswith("SFT_") or var in keys:
+                    continue
+                if not in_scope(rel):
+                    continue
+                findings.append(Finding(
+                    rel, site["lineno"], site["end_lineno"], self.name,
+                    f"`{var}` is read here but not registered in "
+                    f"{REGISTRY_RELPATH}:ENV_VARS — register it with "
+                    "an owner and hazard class so the gate scrub and "
+                    "the docs can see it",
+                    evidence=(
+                        f"{rel}:{site['lineno']}: os.environ read of "
+                        f"`{var}`",
+                        f"{REGISTRY_RELPATH}:{reg_line}: ENV_VARS "
+                        f"registers {len(keys)} var(s); `{var}` is "
+                        "not among them",
+                    ),
+                ))
+
+        # drift: registered but read nowhere
+        for var in sorted(keys):
+            if var not in read_vars:
+                findings.append(Finding(
+                    REGISTRY_RELPATH, reg_line, reg_line, self.name,
+                    f"registered env var `{var}` has no read site in "
+                    "non-test code — delete the entry or the dead "
+                    "variable (a registry that drifts from the code "
+                    "stops being a registry)",
+                    evidence=(
+                        f"{REGISTRY_RELPATH}:{reg_line}: `{var}` "
+                        "registered in ENV_VARS",
+                        "no os.environ/getenv read of it anywhere in "
+                        "the project's non-test files",
+                    ),
+                ))
+
+        # gate scrub coverage
+        gate = project.files.get(GATE_RELPATH)
+        if gate is not None:
+            hazardous = sorted(
+                k for k in keys
+                if isinstance(reg["const"]["map"].get(k), dict)
+                and reg["const"]["map"][k]["map"].get("hazard")
+                == HAZARD_ARMED
+            )
+            derives = any(
+                call.target.split(".")[-1] == GATE_DERIVER
+                for fn in gate.functions.values() for call in fn.calls
+            )
+            popped = {
+                site["var"]
+                for fn in gate.functions.values()
+                for site in fn.env_reads if site["how"] == "pop"
+            }
+            missing = [] if derives else \
+                [v for v in hazardous if v not in popped]
+            if missing and in_scope(GATE_RELPATH):
+                anchor = min(
+                    (fn.lineno for fn in gate.functions.values()
+                     if fn.name == "_cpu_env"), default=1)
+                findings.append(Finding(
+                    GATE_RELPATH, anchor, anchor, self.name,
+                    "gate stages do not scrub hazard-class-`armed` "
+                    f"var(s) {missing} — an ambient armed plan would "
+                    "inject faults into a healthy gate run; derive "
+                    f"the scrub from envvars.{GATE_DERIVER}() instead "
+                    "of hand-listing",
+                    evidence=tuple(
+                        [f"{GATE_RELPATH}:{anchor}: gate env builder "
+                         f"pops {sorted(popped) or 'nothing'}; no "
+                         f"call to `{GATE_DERIVER}()`"]
+                        + [f"{REGISTRY_RELPATH}:{reg_line}: `{v}` is "
+                           f"hazard class `{HAZARD_ARMED}`"
+                           for v in missing[:5]]),
+                ))
+
+        findings.sort(key=lambda f: (f.path, f.lineno))
+        return findings

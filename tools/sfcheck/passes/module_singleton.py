@@ -1,0 +1,93 @@
+"""module-singleton pass — the ``python -m`` dual-module-instance trap.
+
+Invariant: **a module holding mutable process-global state must have a
+single instance**. Executing ``python -m pkg.mod`` runs ``mod``'s source
+as the ``__main__`` module; the moment anything it triggers imports
+``pkg.mod`` canonically (hooks, drivers, assemblers), the interpreter
+holds TWO copies of the module — two singleton slots, two lock objects,
+two registries — and ``install()`` on one is invisible to the other.
+This bit the overload smoke live (PR 9): the ``--smoke`` entry installed
+its controller in the ``__main__`` copy while the window-fire hooks read
+the canonical copy's empty slot.
+
+Detection: a module that BOTH
+
+- holds mutable module-global singleton state — a name declared
+  ``global`` inside any function (the ``_engine``/``_controller``
+  install-slot idiom), or a module-level instantiation of a class
+  defined in the same module (the ``telemetry = Telemetry()`` idiom) —
+- AND has a module-level ``if __name__ == "__main__":`` guard
+
+must have that guard delegate to the canonical import (the sanctioned
+escape hatch, overload.py's pattern)::
+
+    if __name__ == "__main__":
+        from spatialflink_tpu.overload import main as _canonical_main
+        sys.exit(_canonical_main())
+
+Top-level scripts (no package path) are exempt — they are run as
+``python script.py`` and nothing imports them back. Packages executed
+through a ``__main__.py`` are exempt by construction (the state-holding
+module is only ever imported canonically).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import MODULE_FN, is_test_relpath
+
+
+class ModuleSingletonPass(ProjectPass):
+    name = "module-singleton"
+    description = ("a python -m-runnable module with mutable "
+                   "module-global state must delegate its __main__ "
+                   "path to the canonical import")
+    invariant = ("one module instance per process: __main__ execution "
+                 "of a singleton-holding module delegates to the "
+                 "canonical import so hooks and the entry point share "
+                 "one slot")
+
+    def in_scope(self, relpath: str) -> bool:
+        # Only package modules can be python -m'd into a dual instance;
+        # root-level scripts have no canonical import path back.
+        return "/" in relpath and not is_test_relpath(relpath) \
+            and relpath.split("/")[-1] != "__main__.py"
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel, facts in sorted(project.files.items()):
+            if not in_scope(rel):
+                continue
+            guard = facts.main_guard
+            if guard is None or guard.get("delegates_to_self"):
+                continue
+            state_evidence: List[str] = []
+            for fn in facts.functions.values():
+                for name in fn.global_decls:
+                    where = ("module scope" if fn.qualname == MODULE_FN
+                             else f"`{fn.name}`")
+                    state_evidence.append(
+                        f"{rel}:{fn.lineno}: {where} rebinds module "
+                        f"global `{name}` (install-slot state)")
+            for inst in facts.module_instances:
+                state_evidence.append(
+                    f"{rel}:{inst['lineno']}: module-level singleton "
+                    f"`{inst['name']} = {inst['cls']}()`")
+            if not state_evidence:
+                continue
+            findings.append(Finding(
+                rel, guard["lineno"], guard["end_lineno"], self.name,
+                f"`python -m {facts.module}` would execute this "
+                "singleton-holding module as a second instance "
+                "(__main__ alongside the canonical import) — delegate "
+                "the guard body through `from "
+                f"{facts.module} import …` so both share one module "
+                "object (the overload.py idiom)",
+                evidence=tuple(
+                    [f"{rel}:{guard['lineno']}: `if __name__ == "
+                     "\"__main__\":` guard does not import the "
+                     "canonical module"] + state_evidence[:6]),
+            ))
+        return findings

@@ -20,12 +20,26 @@ passes need:
   runtime collection, ``.shape`` subscripts, loop indices — without
   passing a compaction-ladder sanitizer: ``pick_capacity`` /
   ``wire_pane_bucket`` / ``next_bucket`` / ``capacity_ladder``);
-- **classes** (bases + methods) and **names_used** (every identifier,
-  for mesh-parity's "referenced by a parity test" check);
+- **classes** (bases + methods + annotated field names) and
+  **names_used** (every identifier, for mesh-parity's "referenced by a
+  parity test" check);
 - **pragmas**: ``# sfcheck: ok`` comment tokens (tokenize-based, so
   pragmas inside string literals — the test corpus embeds some — are
   not mistaken for real suppressions), consumed-or-stale tracked by the
-  pragma-staleness rule.
+  pragma-staleness rule;
+- **concurrency & contract facts** (the v3 passes): per-function
+  lock-scope spans (``with self._lock:`` blocks plus paired
+  ``acquire()``/``release()`` regions on lock-named receivers),
+  ``global`` declarations, env-var access sites
+  (``os.environ.get/[]``/``getenv``/``.pop`` with a literal name),
+  instant-event emit sites (``emit_instant``/``_emit_locked``/
+  ``_telemetry_instant`` with a literal name or literal f-string head),
+  module-level singleton instantiations (``name = SameModuleClass()``),
+  the module's ``if __name__ == "__main__":`` guard (and whether it
+  delegates to the canonical import), and module-level literal
+  constants (strings/ints, string sequences, string-keyed dicts — the
+  twin-contract surfaces: version pins, ``SPEC_KEYS``,
+  ``INJECTION_POINTS``, the chaos ``MATRIX``, ``ENV_VARS``).
 
 Facts round-trip through JSON (``to_dict``/``facts_from_dict``) so the
 incremental cache can skip re-parsing unchanged files entirely.
@@ -83,6 +97,37 @@ JNP_SHAPE_SINKS = frozenset({
 
 MODULE_FN = "<module>"
 
+#: Call terminals that emit a structured instant event with the event
+#: name as their first argument — the producer side of the
+#: emitted-event ↔ sfprof-consumer contract. ``_emit_locked`` is the
+#: overload controller's queued-emit idiom, ``_telemetry_instant`` the
+#: fault injector's lazy-import wrapper; both forward to
+#: ``telemetry.emit_instant`` verbatim.
+EMIT_NAME_TERMINALS = frozenset({
+    "emit_instant", "_emit_locked", "_telemetry_instant",
+})
+
+_ENV_NAME_RE = None  # compiled lazily (module import stays light)
+
+
+def _is_lockish(token: str) -> bool:
+    """A dotted expression whose terminal segment names a lock
+    (``self._lock``, ``_LOCK_A``, ``registry_lock``)."""
+    return "lock" in token.split(".")[-1].lower()
+
+
+def _env_name(value) -> Optional[str]:
+    """The literal env-var name of an access site, or None. Restricted
+    to SHOUTY_SNAKE names so dict ``.pop("key")`` calls don't flood the
+    facts."""
+    global _ENV_NAME_RE
+    if not isinstance(value, str) or not value:
+        return None
+    if _ENV_NAME_RE is None:
+        import re
+        _ENV_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+    return value if _ENV_NAME_RE.match(value) and "_" in value else None
+
 
 @dataclasses.dataclass
 class CallFact:
@@ -114,6 +159,17 @@ class FunctionFacts:
     stores: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
     #: literal donate_argnums from a @jit/@partial(jax.jit, …) decorator
     donate_decorator: Optional[List[int]] = None
+    #: lock-scope regions: {"lock": raw token, "lineno", "end_lineno"}
+    #: from ``with <lock>:`` blocks and acquire()/release() pairs
+    lock_spans: List[dict] = dataclasses.field(default_factory=list)
+    #: names this function declares ``global``
+    global_decls: List[str] = dataclasses.field(default_factory=list)
+    #: env-var access sites: {"var", "how": get|getitem|getenv|pop|set
+    #: |contains, "lineno", "end_lineno"}
+    env_reads: List[dict] = dataclasses.field(default_factory=list)
+    #: instant-event emit sites: {"name": literal name or f-string head
+    #: or None (dynamic), "prefix": bool, "via", "lineno", "end_lineno"}
+    emit_sites: List[dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -125,6 +181,16 @@ class FileFacts:
     classes: Dict[str, dict] = dataclasses.field(default_factory=dict)
     names_used: List[str] = dataclasses.field(default_factory=list)
     pragmas: List[dict] = dataclasses.field(default_factory=list)
+    #: module-level literal constants: name → {"lineno", "end_lineno",
+    #: "const"} where const is a str/int/float, a list of strings, or
+    #: {"__kind__": "dict", "keys": [...], "map": {k: const|None}}
+    constants: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    #: the module-level ``if __name__ == "__main__":`` guard, if any:
+    #: {"lineno", "end_lineno", "delegates_to_self"}
+    main_guard: Optional[dict] = None
+    #: module-level ``name = SameModuleClass()`` singletons:
+    #: [{"name", "cls", "lineno"}]
+    module_instances: List[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -133,7 +199,8 @@ class FileFacts:
 def facts_from_dict(d: dict) -> FileFacts:
     f = FileFacts(d["relpath"], d["module"], d.get("imports", {}),
                   {}, d.get("classes", {}), d.get("names_used", []),
-                  d.get("pragmas", []))
+                  d.get("pragmas", []), d.get("constants", {}),
+                  d.get("main_guard"), d.get("module_instances", []))
     for q, fd in d.get("functions", {}).items():
         # .get, never .pop: the dict may be a live cache entry that will
         # be re-serialized — mutating it here gutted the on-disk cache.
@@ -327,13 +394,52 @@ class _Extractor(ast.NodeVisitor):
     def visit_ClassDef(self, node):
         bases = [d for d in (dotted(b) for b in node.bases) if d]
         self.cls_stack.append(node.name)
-        self.facts.classes[node.name] = {"bases": bases, "methods": {}}
+        self.facts.classes[node.name] = {
+            "bases": bases, "methods": {}, "fields": [],
+            "lineno": node.lineno,
+        }
         for stmt in node.body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.facts.classes[node.name]["methods"][stmt.name] = \
                     self._qual(stmt.name)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                # Annotated class attributes — dataclass fields (the
+                # SloSpec ↔ SPEC_KEYS twin surface).
+                self.facts.classes[node.name]["fields"].append(
+                    stmt.target.id)
             self.visit(stmt)
         self.cls_stack.pop()
+
+    def visit_Global(self, node):
+        for name in node.names:
+            if name not in self.fn.global_decls:
+                self.fn.global_decls.append(name)
+
+    # -- lock scopes ---------------------------------------------------------
+
+    def _visit_with(self, node):
+        for rank, item in enumerate(node.items):
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            d = dotted(item.context_expr)
+            if d and _is_lockish(d):
+                # rank: the item's position in a multi-item
+                # ``with a, b:`` — items acquire left-to-right, so rank
+                # order IS acquisition order for same-statement spans
+                # (they share a lineno, which hides them from the
+                # nested-span test alone).
+                self.fn.lock_spans.append({
+                    "lock": d, "lineno": node.lineno,
+                    "end_lineno": node.end_lineno or node.lineno,
+                    "rank": rank,
+                })
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
 
     # -- imports -------------------------------------------------------------
 
@@ -487,6 +593,98 @@ class _Extractor(ast.NodeVisitor):
             ))
         self._check_eager_jnp(node)
         self._check_shape_sink(node, d)
+        self._check_env_access(node, d)
+        self._check_emit_site(node, d)
+        self.generic_visit(node)
+
+    def _check_env_access(self, node: ast.Call, d: Optional[str]):
+        """os.environ.get / os.getenv / environ.setdefault reads and
+        ``.pop`` scrubs with a literal SHOUTY name."""
+        if d is None or not node.args:
+            return
+        term = d.split(".")[-1]
+        how = None
+        if d.endswith("environ.get") or d == "environ.get":
+            how = "get"
+        elif term == "getenv" and (d == "getenv" or d.endswith(".getenv")):
+            how = "getenv"
+        elif d.endswith("environ.setdefault"):
+            how = "get"
+        elif d.endswith("environ.pop"):
+            how = "pop"
+        elif term == "pop":
+            how = "pop"
+        if how is None:
+            return
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant):
+            return
+        var = _env_name(arg.value)
+        if var is None:
+            return
+        self.fn.env_reads.append({
+            "var": var, "how": how, "lineno": node.lineno,
+            "end_lineno": node.end_lineno or node.lineno,
+        })
+
+    def _check_emit_site(self, node: ast.Call, d: Optional[str]):
+        if d is None or not node.args:
+            return
+        term = d.split(".")[-1]
+        if term not in EMIT_NAME_TERMINALS:
+            return
+        arg = node.args[0]
+        name = None
+        prefix = False
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = True
+            if arg.values and isinstance(arg.values[0], ast.Constant) \
+                    and isinstance(arg.values[0].value, str) \
+                    and arg.values[0].value:
+                name = arg.values[0].value
+            # else: dynamic head — name stays None, the contract-twin
+            # pass reports it as statically uncheckable
+        else:
+            return  # a plain variable: a forwarding wrapper, not an emit
+        self.fn.emit_sites.append({
+            "name": name, "prefix": prefix, "via": term,
+            "lineno": node.lineno,
+            "end_lineno": node.end_lineno or node.lineno,
+        })
+
+    def visit_Compare(self, node):
+        # ``"SFT_X" in os.environ`` membership tests are read sites too
+        # — a var read only this way must not count as registry drift.
+        if len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(node.left, ast.Constant):
+            d = dotted(node.comparators[0])
+            if d and (d == "environ" or d.endswith(".environ")):
+                var = _env_name(node.left.value)
+                if var is not None:
+                    self.fn.env_reads.append({
+                        "var": var, "how": "contains",
+                        "lineno": node.lineno,
+                        "end_lineno": node.end_lineno or node.lineno,
+                    })
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # os.environ["X"] reads / os.environ["X"] = ... writes
+        d = dotted(node.value)
+        if d and (d == "environ" or d.endswith(".environ")) \
+                and isinstance(node.slice, ast.Constant):
+            var = _env_name(node.slice.value)
+            if var is not None:
+                self.fn.env_reads.append({
+                    "var": var,
+                    "how": "set" if isinstance(node.ctx, ast.Store)
+                    else "getitem",
+                    "lineno": node.lineno,
+                    "end_lineno": node.end_lineno or node.lineno,
+                })
         self.generic_visit(node)
 
     def _check_eager_jnp(self, node: ast.Call):
@@ -526,6 +724,126 @@ class _Extractor(ast.NodeVisitor):
             })
 
 
+def _literal_const(node: ast.AST, depth: int = 0):
+    """JSON-able mirror of a module-level literal constant: scalars,
+    string sequences (incl. ``frozenset({...})``/``tuple((...))``
+    wrappers), and string-keyed dicts (values captured recursively,
+    ``None`` where unresolvable — the chaos MATRIX's lambdas). Returns
+    ``None`` for anything else."""
+    if depth > 3:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (str, int, float)) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return None
+        return vals
+    if isinstance(node, ast.Call) and not node.keywords \
+            and len(node.args) == 1:
+        d = dotted(node.func)
+        if d in ("frozenset", "set", "tuple", "list"):
+            return _literal_const(node.args[0], depth + 1)
+    if isinstance(node, ast.Dict):
+        keys = []
+        mapping = {}
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                return None
+            keys.append(k.value)
+            mapping[k.value] = _literal_const(v, depth + 1)
+        return {"__kind__": "dict", "keys": keys, "map": mapping}
+    return None
+
+
+def _main_guard_of(tree: ast.AST, module: str) -> Optional[dict]:
+    """The module-level ``if __name__ == "__main__":`` block, with
+    whether its body delegates to the canonical import of this very
+    module (the dual-module-singleton escape hatch)."""
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Eq)):
+            continue
+        sides = [test.left] + list(test.comparators)
+        names = [s.id for s in sides if isinstance(s, ast.Name)]
+        consts = [s.value for s in sides if isinstance(s, ast.Constant)]
+        if "__name__" not in names or "__main__" not in consts:
+            continue
+        delegates = any(
+            isinstance(n, ast.ImportFrom) and n.level == 0
+            and n.module == module
+            for stmt in node.body for n in ast.walk(stmt)
+        )
+        return {"lineno": node.lineno,
+                "end_lineno": node.end_lineno or node.lineno,
+                "delegates_to_self": delegates}
+    return None
+
+
+def _module_scan(facts: FileFacts, tree: ast.AST):
+    """Module-level constants + same-module singleton instantiations."""
+    for node in tree.body:
+        target = None
+        value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        if target is None:
+            continue
+        const = _literal_const(value)
+        if const is not None:
+            facts.constants[target] = {
+                "lineno": node.lineno,
+                "end_lineno": node.end_lineno or node.lineno,
+                "const": const,
+            }
+        elif isinstance(value, ast.Call):
+            d = dotted(value.func)
+            if d is not None and d.split(".")[-1] in facts.classes:
+                facts.module_instances.append({
+                    "name": target, "cls": d.split(".")[-1],
+                    "lineno": node.lineno,
+                })
+    facts.main_guard = _main_guard_of(tree, facts.module)
+
+
+def _pair_lock_acquires(fn: FunctionFacts):
+    """``lock.acquire()`` … ``lock.release()`` pairs become lock spans
+    (unreleased acquires extend to the function end — conservative)."""
+    acquires = []
+    releases = {}
+    for call in fn.calls:
+        parts = call.target.split(".")
+        if len(parts) < 2:
+            continue
+        receiver = ".".join(parts[:-1])
+        if not _is_lockish(receiver):
+            continue
+        if parts[-1] == "acquire":
+            acquires.append((receiver, call.lineno))
+        elif parts[-1] == "release":
+            releases.setdefault(receiver, []).append(call.lineno)
+    for receiver, start in acquires:
+        ends = [ln for ln in releases.get(receiver, []) if ln >= start]
+        fn.lock_spans.append({
+            "lock": receiver, "lineno": start,
+            "end_lineno": min(ends) if ends else fn.end_lineno,
+        })
+
+
 def is_test_relpath(relpath: str) -> bool:
     parts = relpath.split("/")
     return parts[0] == "tests" or parts[-1].startswith("test_")
@@ -562,6 +880,8 @@ def extract_facts(relpath: str, tree: ast.AST, source: str,
         else []
     for fn in facts.functions.values():
         _prune_books(fn)
+        _pair_lock_acquires(fn)
+    _module_scan(facts, tree)
     facts.pragmas = scan_pragmas(source)
     return facts
 

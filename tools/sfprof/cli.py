@@ -23,6 +23,7 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from tools.sfprof import attribution
+from tools.sfprof import events as events_mod
 from tools.sfprof import ledger as ledger_mod
 from tools.sfprof import slo as slo_mod
 from tools.sfprof import stream as stream_mod
@@ -446,6 +447,13 @@ def cmd_health(args) -> int:
         fired = ", ".join(f"{k}×{int(v)}"
                           for k, v in sorted(snap["faults"].items()))
         print(f"note injected faults fired (chaos run): {fired}")
+    # Registered instant events (tools/sfprof/events.py — the consumer
+    # side of the emit-name contract sfcheck's contract-twin pass pins):
+    notable = events_mod.notable_event_counts(doc.get("events") or [])
+    if notable:
+        print("note instant events: "
+              + ", ".join(f"{g}={int(n)}"
+                          for g, n in sorted(notable.items())))
     print(f"{len(checks)} checks, {int(failed)} failed")
     return 1 if failed else 0
 
@@ -484,6 +492,14 @@ def cmd_recover(args) -> int:
             print(f"dropped a half-written tail line "
                   f"({int(info['skipped_bytes'])} bytes, "
                   f"{int(info['skipped_lines'])} later lines)")
+    # The crash story, by registered event name (events.py): what the
+    # recovered run was doing when it died — sheds, circuit flips,
+    # fault firings — without grepping the stream by hand.
+    notable = events_mod.notable_event_counts(doc.get("events") or [])
+    if notable:
+        print("recovered instant events: "
+              + ", ".join(f"{g}={int(n)}"
+                          for g, n in sorted(notable.items())))
     problems = ledger_mod.validate(doc)
     for p in problems:
         print(f"FAIL schema: {p}")
