@@ -58,6 +58,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
@@ -129,7 +130,8 @@ class WindowedDataflowDriver:
                  flush_at_end: bool = True,
                  failover: bool = True,
                  overload=None,
-                 source_pausable: Optional[bool] = None):
+                 source_pausable: Optional[bool] = None,
+                 pipeline=None):
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.sink = sink
@@ -156,6 +158,15 @@ class WindowedDataflowDriver:
         self.source_pausable = (bool(skip_on_resume)
                                 if source_pausable is None
                                 else bool(source_pausable))
+        #: Optional :class:`spatialflink_tpu.pipeline.PipelinePolicy` —
+        #: overlapped window processing for processors exposing the
+        #: split protocol (``pipeline_compute``/``pipeline_fetch``
+        #: attributes): up to ``fetch_lag`` windows stay in flight
+        #: between dispatch and their ordered fetch, drained to a
+        #: consistent frontier before every checkpoint commit. ``None``
+        #: falls back to the module policy (``SFT_PIPELINE``); with
+        #: neither, behavior is bit-identical to the synchronous loop.
+        self.pipeline = pipeline
         self.op = None
         self.process: Optional[Callable] = None
         self.fallback: Optional[Callable] = None
@@ -271,8 +282,10 @@ class WindowedDataflowDriver:
             )
         self._reset_fresh_sink()
         with self._installed_controller():
+            pipe = self._pipeline_state()
             for win in windows:
-                yield self._process_window(win)
+                yield from self._pipe_process(pipe, win)
+            yield from self._pipe_drain(pipe)
             self._commit_sink_only()
 
     def _reset_fresh_sink(self) -> None:
@@ -331,6 +344,7 @@ class WindowedDataflowDriver:
                 # reflected in the restored assembler/operator state.
                 next(itertools.islice(it, self._skip - 1, self._skip), None)
                 self._skip = 0
+            pipe = self._pipeline_state()
             for item in it:
                 if faults.armed:  # chaos injection point (faults.py)
                     faults.hit("source.stall")
@@ -345,13 +359,144 @@ class WindowedDataflowDriver:
                     continue
                 fired = feed(item)
                 for win in fired:
-                    yield self._process_window(win)
+                    yield from self._pipe_process(pipe, win)
                 if fired and self._since_ckpt >= self.checkpoint_every:
+                    # Drain to a consistent frontier FIRST: every
+                    # in-flight window is yielded (so the consumer has
+                    # staged its egress) before the checkpoint counts
+                    # it — committed and replayed are the only states a
+                    # window can be in after a crash, never half.
+                    yield from self._pipe_drain(pipe)
                     self._commit()
             if flush is not None:
                 for win in flush():
-                    yield self._process_window(win)
+                    yield from self._pipe_process(pipe, win)
+            yield from self._pipe_drain(pipe)
             self._commit(final=True)
+
+    # -- pipelined window processing (spatialflink_tpu/pipeline.py) ------------
+
+    def _pipeline_state(self) -> Optional[Dict[str, Any]]:
+        """Pipelined processing applies only when a policy is armed
+        (explicit ``pipeline=`` or the module slot), the bound DEVICE
+        process exposes the split protocol (``pipeline_compute`` /
+        ``pipeline_fetch`` attributes), and the process is idempotent
+        (a failed in-flight window is recomputed synchronously — a
+        stateful processor cannot re-run). Anything else → ``None`` and
+        the loop is the exact PR 10 synchronous path."""
+        from spatialflink_tpu import pipeline as pipeline_mod
+
+        pol = self.pipeline if self.pipeline is not None \
+            else pipeline_mod.policy()
+        if pol is None or int(pol.fetch_lag) < 1:
+            return None
+        proc = self.process
+        if self.backend != "device" or proc is None:
+            return None
+        compute = getattr(proc, "pipeline_compute", None)
+        fetch = getattr(proc, "pipeline_fetch", None)
+        if compute is None or fetch is None:
+            return None
+        if not getattr(proc, "idempotent", True):
+            return None
+        return {"pol": pol, "compute": compute, "fetch": fetch,
+                "inflight": deque()}
+
+    def _pipe_process(self, pipe, win) -> Iterator:
+        """Process one window, possibly deferring its fetch; yields any
+        results whose lagged fetch came due. The synchronous
+        ``_process_window`` (retry → failover → crash) remains the
+        error path: any pipelined dispatch/fetch failure drains the
+        healthy in-flight prefix and reprocesses the failed window
+        through it, so retry/failover/breaker semantics are unchanged."""
+        from spatialflink_tpu.pipeline import breaker_collapsed
+
+        if pipe is None:
+            yield self._process_window(win)
+            return
+        if self.backend != "device":
+            # A failover mid-overlap (a fetch failure flipped the
+            # backend while later windows sat in flight) must not
+            # reorder egress: drain the in-flight prefix BEFORE this
+            # window, exactly like the compute-failure path below.
+            yield from self._pipe_drain(pipe)
+            yield self._process_window(win)
+            return
+        if breaker_collapsed():
+            # Circuit open: no stacking windows onto a dead tunnel —
+            # drain and hand the window to the routing/fallback logic.
+            # The transition is instrumented like the executor's
+            # (literal event names — the contract-twin rule), so a
+            # tunnel death mid-overlap is visible in the ledger and
+            # `sfprof health` can print its STALLED note.
+            yield from self._pipe_drain(pipe)
+            if not pipe.get("collapsed"):
+                pipe["collapsed"] = True
+                telemetry.record_pipeline(collapses=1)
+                telemetry.emit_instant("pipeline_collapsed",
+                                       label="driver")
+                telemetry.maybe_flush_stream(force=True)
+            result = self._process_window(win)
+            telemetry.record_pipeline(windows=1, sync=1)
+            yield result
+            return
+        if pipe.get("collapsed"):
+            pipe["collapsed"] = False
+            telemetry.record_pipeline(resumes=1)
+            telemetry.emit_instant("pipeline_resumed", label="driver")
+            telemetry.maybe_flush_stream(force=True)
+        try:
+            if faults.armed:  # chaos injection point (faults.py)
+                faults.hit("pipeline.ship")
+            work = pipe["compute"](win)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except CheckpointCorruptError:
+            raise
+        except Exception:
+            yield from self._pipe_drain(pipe)
+            yield self._process_window(win)
+            return
+        pipe["inflight"].append((win, work))
+        while len(pipe["inflight"]) > int(pipe["pol"].fetch_lag):
+            yield from self._pipe_fetch_one(pipe)
+
+    def _pipe_fetch_one(self, pipe) -> Iterator:
+        win, work = pipe["inflight"].popleft()
+        ctrl = self.overload
+        breaker = ctrl.breaker if ctrl is not None else None
+        try:
+            if faults.armed:  # chaos injection point (faults.py)
+                faults.hit("pipeline.fetch")
+            result = pipe["fetch"](work)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except CheckpointCorruptError:
+            raise
+        except Exception:
+            # The in-flight handle is dead; recompute this window
+            # synchronously with the full retry/failover ladder.
+            yield self._process_window(win)
+            return
+        if breaker is not None:
+            breaker.record_success()
+        telemetry.record_pipeline(windows=1, overlapped=1)
+        # NEVER degraded: this window was computed AND fetched on the
+        # device path — a backend that flipped to fallback after its
+        # dispatch does not make it a degraded window (charging it
+        # would inflate degraded_window_budget for device-answered
+        # results).
+        yield self._finish_window(result, degraded=False)
+
+    def _pipe_drain(self, pipe) -> Iterator:
+        """Fetch every in-flight window now — the consistent frontier
+        every checkpoint commit (and end-of-stream) requires."""
+        if pipe is None:
+            return
+        if pipe["inflight"]:
+            telemetry.record_pipeline(drains=1)
+        while pipe["inflight"]:
+            yield from self._pipe_fetch_one(pipe)
 
     # -- per-window processing (retry → failover → crash) ----------------------
 

@@ -43,6 +43,12 @@ ENV_VARS = {
         "owner": "spatialflink_tpu/overload.py", "hazard": "armed",
         "doc": "overload policy (inline JSON or path) the driver installs",
     },
+    "SFT_PIPELINE": {
+        "owner": "spatialflink_tpu/pipeline.py", "hazard": "armed",
+        "doc": "pipelined-ingest policy (inline JSON or path), armed at "
+               "import; results stay bit-identical but an ambient value "
+               "would flip the gate's pipeline-off baselines",
+    },
     "SFT_SLO_SPEC": {
         "owner": "bench.py", "hazard": "armed",
         "doc": "SLO spec evaluated LIVE during a bench run",

@@ -82,6 +82,10 @@ INJECTION_POINTS: Dict[str, str] = {
                       "source→assembler admission decision",
     "source.stall": "driver.py:_drive — per-item source pull (the "
                     "slow-consumer / wedged-upstream hang point)",
+    "pipeline.ship": "pipeline.py:PipelinedExecutor — overlapped "
+                     "host→device pane ship (encode + stage ahead)",
+    "pipeline.fetch": "pipeline.py:PipelinedExecutor — lagged "
+                      "device→host result fetch (ordered drain)",
 }
 
 #: Points whose callers implement the cooperative ``partial_write`` kind.

@@ -252,6 +252,17 @@ class Telemetry:
         # "cost", "lower"} — the per-kernel runtime table behind
         # kernel_table()/capture_costs() (fed by instrument_jit).
         self._kernel_stats: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+        # Wire-codec compression gauges (ops/wire_codec.py via
+        # account_wire): raw vs post-codec bytes per encoded pane — the
+        # h2d counter keeps counting what actually ships, these keep the
+        # what-it-WOULD-have-cost denominator.
+        self.wire_raw_bytes = 0
+        self.wire_coded_bytes = 0
+        self.wire_panes = 0
+        # Pipelined-ingest executor counters (spatialflink_tpu/
+        # pipeline.py via record_pipeline): overlapped vs collapsed
+        # windows, checkpoint drains — sfprof health's stall notes.
+        self._pipeline: Dict[str, int] = {}
         # tids already named via a ph:"M" thread_name metadata event.
         self._named_tids: set = set()
 
@@ -802,6 +813,50 @@ class Telemetry:
                 for k, v in self._compaction.get(engine, {}).items()
             }
 
+    # -- pipelined ingest (spatialflink_tpu/pipeline.py) -----------------------
+
+    def account_wire(self, raw_bytes: int, coded_bytes: int):
+        """One encoded wire pane: what the raw 6 B/pt wire would have
+        shipped vs what the codec actually did (header included). The
+        ship-site ``account_h2d`` keeps counting the true shipped bytes
+        — this pair exists so the compression ratio has an honest
+        denominator in the record/ledger (``snapshot()["wire_codec"]``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.wire_raw_bytes += int(raw_bytes)
+            self.wire_coded_bytes += int(coded_bytes)
+            self.wire_panes += 1
+
+    def record_pipeline(self, **counts: int):
+        """Accumulate pipelined-executor counters (windows, overlapped,
+        sync, drains, collapses — pipeline.py documents each). Lands in
+        ``snapshot()["pipeline"]`` so `sfprof health` can note stalls."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for key, n in counts.items():
+                self._pipeline[key] = self._pipeline.get(key, 0) + int(n)
+
+    def pipeline_counters(self) -> Dict[str, int]:
+        """Current executor counters (empty dict before the first
+        pipelined window) — bench.py stamps these into its record."""
+        with self._lock:
+            return dict(self._pipeline)
+
+    def wire_codec_gauges(self) -> Optional[Dict[str, Any]]:
+        """Compression summary (None before the first encoded pane)."""
+        with self._lock:
+            if not self.wire_panes:
+                return None
+            return {
+                "panes": self.wire_panes,
+                "raw_bytes": self.wire_raw_bytes,
+                "coded_bytes": self.wire_coded_bytes,
+                "ratio": (self.wire_raw_bytes / self.wire_coded_bytes
+                          if self.wire_coded_bytes else None),
+            }
+
     # -- watermark / lateness gauges ------------------------------------------
 
     def record_watermark_lag(self, lag_ms: int):
@@ -968,6 +1023,18 @@ class Telemetry:
             )
             if self.fault_fires:
                 out["faults"] = dict(self.fault_fires)
+            if self._pipeline:
+                out["pipeline"] = dict(self._pipeline)
+            if self.wire_panes:
+                out["wire_codec"] = {
+                    "panes": self.wire_panes,
+                    "raw_bytes": self.wire_raw_bytes,
+                    "coded_bytes": self.wire_coded_bytes,
+                    "ratio": (
+                        self.wire_raw_bytes / self.wire_coded_bytes
+                        if self.wire_coded_bytes else None
+                    ),
+                }
         if self.overload_provider is not None:
             try:
                 out["overload"] = json_safe(self.overload_provider())  # sfcheck: ok=lock-discipline -- stream-flush checkpoints call this under Telemetry._lock by design; the provider contract (documented at overload.OverloadController._lock) forbids providers from taking telemetry's lock — overload queues transition emits for after release

@@ -11,7 +11,7 @@ flushes). The real-process SIGKILL analog (``abort`` kind,
 ``os._exit(137)``) is pinned by the slow subprocess test below and runs
 on every commit as tools/ci's chaos-smoke stage.
 
-Four pipeline harnesses cover the eleven points:
+Five pipeline harnesses cover the thirteen points:
 
 - range-query driver pipeline (collection source): device.ship,
   device.dispatch, device.fetch, window.feed, driver.window, sink.write,
@@ -22,7 +22,13 @@ Four pipeline harnesses cover the eleven points:
 - tJoin pane-engine pipeline (bounded SoA chunks → run_soa_panes →
   driver.run_precomputed): source.stall — the scan recomputes
   deterministically on resume and the driver skips the committed
-  window prefix.
+  window prefix;
+- PIPELINED range driver subprocess (SFT_PIPELINE armed, abort kind —
+  the kill -9 analog; on the DRIVER path in-process raise kinds are
+  CONTAINED by its sync-fallback, so only a real process death
+  exercises the crash contract there): pipeline.ship, pipeline.fetch —
+  killed mid-overlap, the resumed pipelined child converges to the
+  clean child's bytes, which equal a pipeline-OFF run's bytes too.
 """
 
 import json
@@ -349,6 +355,57 @@ def chaos_kafka(tmp_path, point, kind="raise"):
 
 
 # ---------------------------------------------------------------------------
+# Harness 5: pipelined range driver (subprocess, SFT_PIPELINE armed).
+# The DRIVER path contains in-process raise-kind faults (drain + sync
+# reprocess — tests/test_pipeline.py pins that), so the crash legs use
+# the abort kind: os._exit(137) mid-overlap, nothing flushes, and the
+# resumed pipelined child must still converge byte-exactly.
+
+
+def chaos_pipeline(tmp_path, point):
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""}
+    env_base.pop("SFT_FAULT_PLAN", None)
+    env_base.pop("SFT_PIPELINE", None)
+
+    def child(workdir, pipelined=True, plan=None):
+        env = dict(env_base)
+        if pipelined:
+            env["SFT_PIPELINE"] = json.dumps(
+                {"depth": 2, "fetch_lag": 2}
+            )
+        if plan:
+            env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        return subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.driver",
+             "--chaos-child", str(workdir)],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=REPO,
+        )
+
+    sync_dir = tmp_path / "sync"
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    for d in (sync_dir, clean, chaos):
+        d.mkdir()
+    p = child(sync_dir, pipelined=False)
+    assert p.returncode == 0, p.stderr[-2000:]
+    p = child(clean)
+    assert p.returncode == 0, p.stderr[-2000:]
+    want = (clean / "egress.csv").read_bytes()
+    assert want, "vacuous matrix entry: clean egress is empty"
+    # Overlap itself must not move results:
+    assert want == (sync_dir / "egress.csv").read_bytes()
+    at = 5 if point == "pipeline.ship" else 3
+    p = child(chaos, plan=[{"point": point, "kind": "abort", "at": at}])
+    assert p.returncode == ABORT_EXIT_CODE, (p.returncode,
+                                             p.stderr[-2000:])
+    p = child(chaos)  # resume, still pipelined
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert (chaos / "egress.csv").read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 
 
@@ -368,6 +425,8 @@ MATRIX = {
     "overload.admit": lambda tp: chaos_range(tp, "overload.admit", at=60,
                                              with_overload=True),
     "source.stall": lambda tp: chaos_tjoin_panes(tp, "source.stall"),
+    "pipeline.ship": lambda tp: chaos_pipeline(tp, "pipeline.ship"),
+    "pipeline.fetch": lambda tp: chaos_pipeline(tp, "pipeline.fetch"),
 }
 
 

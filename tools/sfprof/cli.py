@@ -150,8 +150,10 @@ def cmd_report(args) -> int:
             # Honest label: byte totals cover the WHOLE run (warm-up,
             # throughput loops, staging), while only the latency-probe
             # windows carry spans — so this is run-total ÷ traced
-            # windows, an upper bound on true per-window traffic.
-            print("\n-- device-boundary bytes "
+            # windows, an upper bound on true per-window traffic. These
+            # are WIRE bytes — what actually crossed the tunnel, i.e.
+            # post-codec when the delta-bitpacked pane codec ran.
+            print("\n-- device-boundary wire bytes, post-codec "
                   "(run totals ÷ traced windows) --")
             print(f"h2d {float(snap.get('bytes_h2d', 0) / n_win):.1f} "
                   f"B/traced-win  "
@@ -159,6 +161,13 @@ def cmd_report(args) -> int:
                   f"B/traced-win  over {int(n_win)} traced windows "
                   f"(run totals: h2d {int(snap.get('bytes_h2d', 0))} B, "
                   f"d2h {int(snap.get('bytes_d2h', 0))} B)")
+            wc = snap.get("wire_codec") or {}
+            if wc.get("ratio"):
+                print(f"wire codec: {int(wc.get('panes', 0))} panes, "
+                      f"raw {int(wc.get('raw_bytes', 0))} B → coded "
+                      f"{int(wc.get('coded_bytes', 0))} B  "
+                      f"(ratio {float(wc['ratio']):.3f}x)")
+            _print_link_utilization(snap, events)
         if snap.get("dropped_events"):
             print(f"\nWARNING: {int(snap['dropped_events'])} trace events "
                   "dropped (buffer cap) — attribution above is partial")
@@ -171,6 +180,47 @@ def cmd_report(args) -> int:
         print(f"{float(_ms(g['gap_us'])):10.3f} ms  after {g['after']} "
               f"→ before {g['before']}")
     return 0
+
+
+def _print_link_utilization(snap: Dict[str, Any], events: List[dict]):
+    """Effective link utilization against the MEASURED LinkProbe
+    bandwidth gauge — never the raw ~28 MB/s tunnel folklore constant:
+    transferred bytes over the traced span vs what the probe says this
+    run's tunnel could actually move. Both sides are honest run-wide
+    aggregates (the span includes compute time), so this is a floor on
+    utilization — a pipeline that overlaps well pushes it toward 1."""
+    lp = snap.get("link_probe") or {}
+    bw = lp.get("roundtrip_mbps_p50")
+    spans = complete_spans_ts_range(events)
+    if not isinstance(bw, (int, float)) or not bw or spans is None:
+        return
+    span_s = spans / 1e6
+    if span_s <= 0:
+        return
+    total = float(snap.get("bytes_h2d", 0)) + float(snap.get("bytes_d2h", 0))
+    mbps = total / 1e6 / span_s
+    print(f"link utilization: {float(mbps):.2f} MB/s transferred over "
+          f"the {float(span_s):.2f} s traced span = "
+          f"{float(100.0 * mbps / bw):.1f}% of the probed "
+          f"{float(bw):.1f} MB/s round-trip bandwidth (p50 gauge)")
+
+
+def complete_spans_ts_range(events: List[dict]) -> Optional[float]:
+    """µs between the first event start and the last event end (None
+    when nothing is timestamped)."""
+    ts0 = None
+    ts1 = None
+    for e in events or []:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        dur = e.get("dur")
+        end = ts + (dur if isinstance(dur, (int, float)) else 0)
+        ts0 = ts if ts0 is None else min(ts0, ts)
+        ts1 = end if ts1 is None else max(ts1, end)
+    if ts0 is None or ts1 is None or ts1 <= ts0:
+        return None
+    return float(ts1 - ts0)
 
 
 # -- diff / gate --------------------------------------------------------------
@@ -443,6 +493,21 @@ def cmd_health(args) -> int:
             print(f"note overload circuit: state={br.get('state')} "
                   f"opens={int(br.get('opens') or 0)} "
                   f"probes={int(br.get('probes') or 0)}")
+    # Pipelined-ingest visibility (informational, the overload idiom):
+    # a collapse means the circuit breaker forced the executor back to
+    # the synchronous cadence mid-run — a stalled pipeline, worth a
+    # loud note even though the run survived with identical results.
+    pipe = snap.get("pipeline") or {}
+    if pipe:
+        print(f"note pipeline: windows={int(pipe.get('windows') or 0)} "
+              f"overlapped={int(pipe.get('overlapped') or 0)} "
+              f"sync={int(pipe.get('sync') or 0)} "
+              f"drains={int(pipe.get('drains') or 0)}")
+        if pipe.get("collapses"):
+            print(f"note pipeline STALLED: collapsed to the synchronous "
+                  f"cadence {int(pipe['collapses'])}x (circuit breaker "
+                  f"open — see circuit notes; results stay identical, "
+                  f"overlap throughput was lost)")
     if snap.get("faults"):
         fired = ", ".join(f"{k}×{int(v)}"
                           for k, v in sorted(snap["faults"].items()))

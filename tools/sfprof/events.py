@@ -41,6 +41,10 @@ INSTANT_EVENTS = frozenset({
     "overload_shedding:oldest",
     "overload_recovered:admission",
     "overload_recovered:lag",
+    # pipelined-ingest executor (spatialflink_tpu/pipeline.py): the
+    # breaker-driven collapse to the synchronous cadence and back
+    "pipeline_collapsed",
+    "pipeline_resumed",
 })
 
 #: Literal name prefixes for parameterized events (the suffix names the
@@ -59,6 +63,7 @@ _GROUPS = (
     ("self-healing", ("driver_retry", "failover")),
     ("circuit", ("circuit_",)),
     ("overload", ("overload_",)),
+    ("pipeline", ("pipeline_collapsed", "pipeline_resumed")),
     ("slo", ("slo_violation:", "slo_recovered:")),
 )
 
