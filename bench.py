@@ -120,9 +120,12 @@ def _record_last_good(record: dict) -> None:
     import os
     import subprocess
 
-    if not record.get("value") or record.get("smoke"):
-        # Toy-size smoke captures (SFT_BENCH_SMOKE contract runs) must
-        # never shadow a real chip number in the last-good store.
+    if not record.get("value") or record.get("smoke") \
+            or record.get("tainted"):
+        # Toy-size smoke captures (SFT_BENCH_SMOKE contract runs) and
+        # TAINTED ablation captures (kernels stubbed to zeros —
+        # spatialflink_tpu/ablation.py) must never shadow a real chip
+        # number in the last-good store.
         return
     sha = None
     try:
@@ -905,8 +908,14 @@ def main() -> None:
     # uniform-random bench stream bounds the ratio near 1 + the oid
     # width win; the SNCB random-walk regime is where it pays
     # (tests/test_wire_codec.py).
+    _armed_pol = pipeline_mod.policy()
     out["pipeline"] = {
-        "armed": pipeline_mod.policy() is not None,
+        "armed": _armed_pol is not None,
+        # The armed policy's codec is part of the capture's identity:
+        # the trend store keys series by (pipeline, codec) arming so a
+        # codec-on capture never gates against codec-off history.
+        "armed_codec": _armed_pol.codec if _armed_pol is not None
+        else None,
         "probe_policy": overlap_pol.to_dict(),
         "counters": telemetry.pipeline_counters(),
     }
@@ -928,6 +937,15 @@ def main() -> None:
         out["overload"] = overload_ctrl.snapshot()
     if smoke:
         out["smoke"] = True
+    # Ablation taint (SFT_ABLATE armed at import, ablation.py): the
+    # record itself says it is a profiling artifact, so the trend
+    # ingester / last-good store / diff gate reject it even when only
+    # the one-line record (not the ledger) survives.
+    from spatialflink_tpu.ablation import ablation as _ablation
+
+    _taint = _ablation.taint_block()
+    if _taint is not None:
+        out["tainted"] = _taint
     # Measured CPU-backend throughput of the same fused program on this
     # host (bench_suite.py --cpu-baseline) — the measured counterpart to
     # the reference's configured 20k EPS target.

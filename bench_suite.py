@@ -85,6 +85,14 @@ def _result(name, n_points, seconds, extra=None, spread=None, resident=None):
             out["device_resident_vs_measured_cpu"] = round(pps_r / cpu_r, 2)
     if extra:
         out.update(extra)
+    from spatialflink_tpu.ablation import ablation
+
+    taint = ablation.taint_block()
+    if taint is not None:
+        # Ablated (kernel-stubbed) runs are profiling artifacts: the
+        # result line says so, and every downstream consumer (trend
+        # ingester, diff gate, baseline writers) rejects it.
+        out["tainted"] = taint
     print(json.dumps(out))
     return out
 
@@ -1194,6 +1202,81 @@ def bench_tknn(jax, jnp, grid, quick):
                    spread=(t_min, t_max), resident=(pps_r, r_min, r_max))
 
 
+def run_ablation(benches, top_n=6, ledger_dir=None):
+    """The measured kernel-ablation sweep (``--ablate``;
+    ``spatialflink_tpu/ablation.py``): per config, a clean baseline run
+    learns the config's kernel set (heaviest-first from the telemetry
+    runtime table), then the config re-runs once per kernel with that
+    kernel's dispatch substituted by cached correct-aval zeros — the
+    EPS delta is the kernel's MEASURED marginal cost, the empirical twin
+    of the XLA cost model's flops ranking (on XLA:CPU the two disagree
+    hard: scatters cost ~100× gathers).
+
+    Every ablated run is tainted end to end (result line, ledger,
+    stream) and a leg whose downstream asserts reject the zeroed
+    results is recorded as unmeasurable-with-evidence, not a crash —
+    an ablation that breaks the program proves the kernel is
+    load-bearing, which is an answer too. Prints one
+    ``ablation_table`` JSON line per config and returns the tables."""
+    from spatialflink_tpu.ablation import ablation
+    from spatialflink_tpu.telemetry import telemetry
+
+    tables = []
+    for name, fn in benches:
+        ablation.disarm()
+        telemetry.enable()
+        try:
+            base = fn()
+            kernel_rows = telemetry.kernel_table()
+        finally:
+            telemetry.disable()
+        base_eps = float(base["points_per_sec"])
+        seen = set()
+        kernels = [r["kernel"] for r in kernel_rows
+                   if not (r["kernel"] in seen or seen.add(r["kernel"]))]
+        rows = []
+        for kernel in kernels[:top_n]:
+            telemetry.enable()
+            ablation.arm([kernel])
+            try:
+                res = fn()
+                eps = float(res["points_per_sec"])
+                if ledger_dir:
+                    telemetry.write_ledger(
+                        os.path.join(ledger_dir,
+                                     f"{name}.ablate.{kernel}.json"),
+                        bench=res,
+                    )
+                rows.append({
+                    "kernel": kernel,
+                    "points_per_sec": round(eps, 1),
+                    "speedup_if_free": round(eps / base_eps, 3),
+                    "marginal_frac": round((eps - base_eps) / base_eps,
+                                           4),
+                })
+            except Exception as e:
+                rows.append({
+                    "kernel": kernel,
+                    "error": f"{type(e).__name__}: {e}",
+                    "note": "config rejects zeroed results — the "
+                            "kernel is load-bearing; marginal cost "
+                            "unmeasurable by substitution",
+                })
+            finally:
+                telemetry.disable()
+                ablation.disarm()
+        table = {
+            "ablation_table": name,
+            "baseline_points_per_sec": round(base_eps, 1),
+            "kernels": sorted(
+                rows, key=lambda r: -r.get("marginal_frac", -1e9)),
+            "tainted": True,
+        }
+        print(json.dumps(table))
+        tables.append(table)
+    return tables
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1201,6 +1284,18 @@ def main():
         "--cpu-baseline", action="store_true",
         help="run on the single-device CPU backend and write the measured "
              "points/s of every config to CPU_BASELINE.json",
+    )
+    ap.add_argument(
+        "--ablate", action="store_true",
+        help="measured kernel-ablation sweep: per config, re-run with "
+             "each kernel's dispatch substituted by cached zeros and "
+             "print the marginal-EPS table (all outputs tainted — "
+             "profiling only, never a record)",
+    )
+    ap.add_argument(
+        "--ablate-top", type=int, default=6,
+        help="kernels per config to ablate, heaviest steady-dispatch "
+             "first (default %(default)s)",
     )
     ap.add_argument(
         "--configs", default=None,
@@ -1216,6 +1311,12 @@ def main():
             "file is written whole, so a filtered run would silently "
             "drop every non-matching config's entry"
         )
+    if args.cpu_baseline and args.ablate:
+        ap.error(
+            "--ablate cannot combine with --cpu-baseline: ablated runs "
+            "are tainted profiling artifacts and must never enter "
+            "CPU_BASELINE.json"
+        )
 
     if args.cpu_baseline:
         # Must happen before jax import: force the CPU backend, one device.
@@ -1227,9 +1328,18 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from spatialflink_tpu.ablation import ablation
+
     if args.cpu_baseline:
         jax.config.update("jax_platforms", "cpu")
         assert jax.devices()[0].platform == "cpu"
+        if ablation.armed:
+            # Fail BEFORE the hours of runs, not at the write.
+            raise SystemExit(
+                "--cpu-baseline refused: SFT_ABLATE is armed and "
+                "ablated (tainted) numbers must never enter "
+                "CPU_BASELINE.json"
+            )
 
     from spatialflink_tpu.grid import UniformGrid
 
@@ -1269,6 +1379,10 @@ def main():
         if not all_benches:
             raise SystemExit(f"--configs matched nothing: {args.configs}")
     ledger_dir = os.environ.get("SFT_LEDGER_DIR")
+    if args.ablate:
+        run_ablation(all_benches, top_n=args.ablate_top,
+                     ledger_dir=ledger_dir)
+        return
     results = []
     for name, fn in all_benches:
         if ledger_dir:

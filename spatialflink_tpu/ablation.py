@@ -1,0 +1,204 @@
+"""Measured kernel ablation — what does each kernel actually COST?
+
+The tjoin long-pole hunt (ROADMAP item 5) and every future kernel
+optimization start with the same question: if this kernel were free,
+how much faster would the config run? The XLA cost model answers with
+estimated flops; this module answers with a MEASUREMENT: arm a kernel
+name and ``telemetry.instrument_jit`` substitutes its dispatch with a
+cached correct-aval zero result, so the config's EPS with the kernel
+"free" minus its baseline EPS is the kernel's *marginal* cost
+(``bench_suite.py --ablate`` drives the sweep and prints the table).
+
+Mechanics per (kernel, abstract signature):
+
+- the FIRST call runs the real kernel once (the *learning* call): it
+  compiles, produces a structurally-correct output, and that output's
+  zero-filled mirror (same pytree, shapes, dtypes — built by ONE jitted
+  ``zeros_like`` program, never eager per-leaf ops) is cached;
+- every later call returns a fresh jitted copy of the cached zeros.
+  Fresh — never the cached buffers themselves — because a downstream
+  jit with ``donate_argnums`` may consume what we hand it, and a
+  donated cache would poison every subsequent window. The copy is one
+  trivial dispatch: it IS the substituted kernel's residual cost, which
+  is exactly what a marginal measurement wants left in place.
+
+**Ablated runs are deliberately WRONG** (windows see zeros). They exist
+only to be timed, so every capture they touch is tainted: while armed
+(or after any substituted call since the capture began) the taint block
+rides ``telemetry.snapshot()["tainted"]``, the ledger's top level, the
+ledger-stream checkpoints (so a recovered stream stays tainted), and
+the bench record itself — and ``sfprof diff --gate`` / ``trend
+--gate`` / the last-good store / the CPU_BASELINE writer all
+hard-reject it. A stubbed run can never pollute the perf record.
+
+Arming (the faults/pipeline idiom): ``SFT_ABLATE`` at import —
+a comma-separated kernel-name list, inline JSON (``["k1","k2"]`` or
+``{"kernels": [...]}``) or a path to such JSON — or ``ablation.arm``
+in-process. Disarmed cost is one attribute check per dispatch
+(``if ablation.armed``). Import order note: this module is imported by
+``telemetry`` at module scope, so it must never import telemetry at
+module scope itself — all telemetry touches are lazy per-call imports
+(the faults.py rule, inverted).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_helpers():
+    """(zeros_like, fresh_copy) as jitted programs — compiled once per
+    output pytree structure by jax.jit's own cache, so the substituted
+    path never issues eager per-leaf ops from a per-window loop."""
+    import jax
+    import jax.numpy as jnp
+
+    zeros = jax.jit(
+        lambda t: jax.tree_util.tree_map(jnp.zeros_like, t))
+    copy = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))
+    return zeros, copy
+
+
+class KernelAblation:
+    """Process-global ablation controller (the faults/telemetry
+    singleton idiom). ``armed`` is the ONLY state the disarmed hot path
+    reads; the lock guards the cache/counters, never a dispatch."""
+
+    def __init__(self):
+        self.armed = False
+        self.kernels: frozenset = frozenset()
+        self._lock = threading.Lock()
+        # (kernel label, abstract signature) → cached zero pytree.
+        self._cache: Dict[Tuple[str, Tuple], Any] = {}
+        self._hits: Dict[str, int] = {}     # substituted calls
+        self._learned: Dict[str, int] = {}  # real learning calls
+
+    # -- arming ----------------------------------------------------------------
+
+    def arm(self, kernels: Sequence[str]):
+        """Arm ablation for the named kernel labels (the names
+        ``instrument_jit`` was given). Re-arming replaces the set and
+        resets the cache — each sweep leg learns fresh."""
+        ks = frozenset(str(k) for k in kernels if str(k))
+        with self._lock:
+            self.kernels = ks
+            self._cache.clear()
+            self.armed = bool(ks)
+        if self.armed:
+            self._emit_armed()
+
+    def disarm(self):
+        with self._lock:
+            self.armed = False
+            self.kernels = frozenset()
+            self._cache.clear()
+
+    def reset_counters(self):
+        """Start-of-capture reset (``telemetry.enable`` calls this): a
+        fresh capture's taint must reflect THIS capture's substitutions,
+        not a previous sweep leg's."""
+        with self._lock:
+            self._hits.clear()
+            self._learned.clear()
+            self._cache.clear()
+
+    def _emit_armed(self):
+        # Lazy + guarded: at import-time arming, telemetry may be mid-
+        # import (it imports THIS module at module scope) — the skipped
+        # emit is re-issued by telemetry.enable()'s armed check, the
+        # same both-sides coverage faults.py uses.
+        try:
+            from spatialflink_tpu.telemetry import telemetry
+        except Exception:
+            return
+        if telemetry.enabled:
+            telemetry.emit_instant(
+                "ablation_armed", kernels=sorted(self.kernels))
+
+    # -- the substituted dispatch ----------------------------------------------
+
+    def matches(self, label: str) -> bool:
+        return label in self.kernels
+
+    def dispatch(self, label: str, fn, args: tuple, kwargs: dict):
+        """Substitute one instrumented-kernel call (see module doc):
+        learning call per (kernel, signature), cached-zero copies after."""
+        from spatialflink_tpu.telemetry import abstract_signature
+
+        key = (label, abstract_signature(args, kwargs))
+        with self._lock:
+            cached = self._cache.get(key)
+        if cached is None:
+            out = fn(*args, **kwargs)  # learning call: the real kernel
+            zeros_fn, _copy_fn = _jit_helpers()
+            zeros = zeros_fn(out)
+            with self._lock:
+                self._cache.setdefault(key, zeros)
+                self._learned[label] = self._learned.get(label, 0) + 1
+            return out
+        with self._lock:
+            self._hits[label] = self._hits.get(label, 0) + 1
+        _zeros_fn, copy_fn = _jit_helpers()
+        return copy_fn(cached)
+
+    # -- taint -----------------------------------------------------------------
+
+    def taint_block(self) -> Optional[Dict[str, Any]]:
+        """The taint record (None while clean): armed now, or any
+        substituted/learning call since the capture began. Rides every
+        snapshot/ledger/stream checkpoint and the bench record."""
+        with self._lock:
+            if not self.armed and not self._hits and not self._learned:
+                return None
+            return {
+                "kind": "ablation",
+                "kernels": sorted(self.kernels),
+                "substituted_calls": dict(self._hits),
+                "learning_calls": dict(self._learned),
+            }
+
+
+ablation = KernelAblation()
+
+
+def _parse_spec(text: str) -> List[str]:
+    """SFT_ABLATE value → kernel list: inline JSON (list or
+    ``{"kernels": [...]}``), a path to such JSON, or a comma list."""
+    text = text.strip()
+    if not text:
+        return []
+    if not text.startswith(("[", "{")) and os.path.isfile(text):
+        with open(text) as f:
+            text = f.read().strip()
+    if text.startswith(("[", "{")):
+        spec = json.loads(text)
+        if isinstance(spec, dict):
+            spec = spec.get("kernels") or []
+        if not isinstance(spec, list):
+            raise ValueError(
+                f"SFT_ABLATE JSON must be a list or {{'kernels': [...]}}, "
+                f"got {type(spec).__name__}")
+        return [str(k) for k in spec]
+    return [k.strip() for k in text.split(",") if k.strip()]
+
+
+def maybe_arm_from_env():
+    """Arm from ``SFT_ABLATE`` when set (called at import, the
+    faults/pipeline idiom — ablation subprocesses arm with zero code).
+    A malformed spec raises: a sweep that silently measures the
+    UN-ablated program is worse than a crash."""
+    spec = os.environ.get("SFT_ABLATE")
+    if spec:
+        kernels = _parse_spec(spec)
+        if not kernels:
+            raise ValueError(f"SFT_ABLATE set but names no kernels: "
+                             f"{spec!r}")
+        ablation.arm(kernels)
+
+
+maybe_arm_from_env()

@@ -53,6 +53,14 @@ ENV_VARS = {
         "owner": "bench.py", "hazard": "armed",
         "doc": "SLO spec evaluated LIVE during a bench run",
     },
+    "SFT_ABLATE": {
+        "owner": "spatialflink_tpu/ablation.py", "hazard": "armed",
+        "doc": "kernel-ablation spec (comma list, inline JSON, or "
+               "path), armed at import; substituted kernels return "
+               "cached zeros, so an ambient value silently falsifies "
+               "every measurement (the run is tainted, but the gate "
+               "must never run tainted in the first place)",
+    },
     "SFT_BENCH_FORCE_FAIL": {
         "owner": "bench.py", "hazard": "armed",
         "doc": "forces the bench child to fail (contract tests)",

@@ -50,6 +50,7 @@ import time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
+from spatialflink_tpu.ablation import ablation
 from spatialflink_tpu.faults import faults
 from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 
@@ -338,6 +339,15 @@ class Telemetry:
         if faults.armed:
             self.emit_instant(
                 "fault_armed", plan=[r.to_dict() for r in faults.rules]
+            )
+        # Fresh capture, fresh ablation taint scope: counters reset so
+        # the taint block reflects THIS capture's substitutions; the
+        # armed marker is re-emitted for the same arm-before-enable
+        # reason as fault_armed above (SFT_ABLATE arms at import).
+        ablation.reset_counters()
+        if ablation.armed:
+            self.emit_instant(
+                "ablation_armed", kernels=sorted(ablation.kernels)
             )
 
     def disable(self):
@@ -770,6 +780,12 @@ class Telemetry:
         }
         if slo_block is not None:
             doc["slo"] = slo_block
+        taint = ablation.taint_block()
+        if taint is not None:
+            # Top-level mirror of the snapshot taint: gates must reject
+            # without digging into the snapshot, and a hand-edited
+            # snapshot must not untaint the document.
+            doc["tainted"] = taint
         doc, nonfinite = _sanitize_nonfinite(doc)
         if nonfinite:
             doc["nonfinite_values"] = nonfinite
@@ -1043,6 +1059,12 @@ class Telemetry:
         link = self.link_gauges()
         if link is not None:
             out["link_probe"] = link
+        # Ablation taint rides EVERY snapshot — including the ledger-
+        # stream checkpoints, so a recovered stream stays tainted and
+        # sfprof's gates keep rejecting it after a crash.
+        taint = ablation.taint_block()
+        if taint is not None:
+            out["tainted"] = taint
         return json_safe(out)
 
 
@@ -1239,6 +1261,12 @@ def instrument_jit(fn, name: Optional[str] = None):
         def __call__(self, *args, **kwargs):
             if faults.armed:  # chaos injection point (faults.py)
                 faults.hit("device.dispatch")
+            if ablation.armed and ablation.matches(label):
+                # Profiling-only substitution (ablation.py): cached
+                # correct-aval zeros after one real learning call.
+                # Deliberately OUTSIDE the runtime table — the numbers
+                # are wrong by construction and the capture is tainted.
+                return ablation.dispatch(label, fn, args, kwargs)
             if not telemetry.enabled:
                 return fn(*args, **kwargs)
             sig = abstract_signature(args, kwargs)

@@ -583,6 +583,10 @@ class TestTelemetryBlock:
         counters = rec["pipeline"]["counters"]
         assert counters["overlapped"] > 0
         assert counters.get("collapses", 0) == 0
+        # Codec-arming identity rides the record (the trend store keys
+        # series by it): unarmed smoke run → armed False, codec None.
+        assert rec["pipeline"]["armed"] is False
+        assert rec["pipeline"]["armed_codec"] is None
         assert 0 < rec["wire_bytes"] <= rec["raw_bytes"]
         assert led["snapshot"]["wire_codec"]["coded_bytes"] \
             == rec["wire_bytes"]

@@ -25,6 +25,11 @@ def test_dry_run_lists_all_stages(capsys):
     assert "[chaos-smoke]" in out
     plain = out.replace(sys.executable, "py")
     assert "tools.sfprof health" in plain
+    # The trajectory gate: the smoke capture vs the committed toy trend
+    # fixture, in the must-have-history CI mode.
+    assert "tools.sfprof trend" in plain
+    assert os.path.join("tests", "fixtures", "trend") in plain
+    assert "--require-history" in plain
     # The crash-recovery round trip: recover the stream the smoke run
     # wrote, then health-gate the recovered ledger.
     assert "tools.sfprof recover" in plain
@@ -101,6 +106,9 @@ def test_all_green_runs_every_stage(monkeypatch):
     assert any("bench.py" in c for c in calls)
     assert any("tools.sfprof health" in c for c in calls)
     assert any("tools.sfprof recover" in c for c in calls)
+    # The trend gate runs on the SAME ledger the smoke run wrote.
+    trend_call = next(c for c in calls if "tools.sfprof trend" in c)
+    assert "--gate" in trend_call and "--require-history" in trend_call
     assert any("spatialflink_tpu.driver --chaos-smoke" in c for c in calls)
     # recover targets the stream the bench env configured, and the
     # recovered ledger is health-gated too (2 health invocations).

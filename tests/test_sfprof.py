@@ -362,6 +362,28 @@ def test_report_cli_unreadable_input(tmp_path, capsys):
     assert sfprof_main(["report", str(tmp_path / "absent.json")]) == 2
 
 
+def test_report_and_health_json_on_real_ledger(tmp_path, capsys):
+    """--json on a ledger telemetry actually wrote (not a synthetic
+    fixture): parseable single document, roofline verdict present,
+    checks mirrored, exit codes unchanged."""
+    path = _make_ledger(tmp_path)
+    assert sfprof_main(["report", path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["roofline"]["verdict"] in (
+        "link-bound", "host-bound", "dispatch-bound", "compute-bound",
+        "memory-bound", "inconclusive")
+    assert rep["roofline"]["evidence"]
+    assert "window.demo" in rep["attribution"]["operators"]
+    assert any(r["kernel"] == "double" for r in rep["kernels"])
+    assert sfprof_main(["health", path, "--json"]) == 0
+    hea = json.loads(capsys.readouterr().out)
+    assert hea["failed"] == 0 and hea["tainted"] is None
+    assert hea["roofline"]["verdict"] == rep["roofline"]["verdict"]
+    assert {c["name"] for c in hea["checks"]} >= {
+        "recompile_churn_max_signatures", "late_dropped",
+        "max_watermark_lag_ms", "dropped_trace_events"}
+
+
 # -- CLI: diff / gate ---------------------------------------------------------
 
 

@@ -12,9 +12,13 @@ One shot, five stages, fail-fast, distinct banners:
    bench.py run on XLA:CPU writing a run ledger AND a ledger stream
    (``SFT_LEDGER_STREAM``), then ``python -m tools.sfprof health
    <ledger>`` threshold verdicts (recompile churn, overflows, late
-   drops, watermark lag), then the crash-recovery round trip:
-   ``sfprof recover <stream>`` → ``sfprof health <recovered>`` — every
-   commit proves the durable capture path still reconstructs a
+   drops, watermark lag), then ``sfprof trend --gate`` checking the
+   smoke capture against the committed toy trajectory fixture
+   (``tests/fixtures/trend`` — robust median + MAD band;
+   ``--require-history`` so a broken fixture fails loudly; tainted
+   ablation captures are hard-rejected), then the crash-recovery round
+   trip: ``sfprof recover <stream>`` → ``sfprof health <recovered>`` —
+   every commit proves the durable capture path still reconstructs a
    gateable ledger;
 4. **chaos smoke** — ``python -m spatialflink_tpu.driver
    --chaos-smoke``: a toy driver pipeline killed mid-run by an armed
@@ -110,6 +114,13 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
         out.append(("bench-smoke+health", [
             [py, "bench.py"],
             [py, "-m", "tools.sfprof", "health", ledger],
+            # Trajectory gate: the smoke capture against the committed
+            # toy trend fixture (robust median + MAD band, tainted
+            # captures hard-rejected). --require-history so a missing/
+            # mismatched fixture FAILS instead of waving runs through.
+            [py, "-m", "tools.sfprof", "trend",
+             os.path.join("tests", "fixtures", "trend"),
+             "--gate", ledger, "--require-history"],
             # Crash-recovery round trip on the stream the smoke run just
             # wrote: recover must rebuild a schema-valid ledger and that
             # ledger must pass the same health gate.

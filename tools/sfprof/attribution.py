@@ -104,6 +104,26 @@ def attribute_windows(events: List[dict]) -> Tuple[List[dict], Dict[str, dict]]:
     return windows, ops
 
 
+def span_range_us(events: List[dict]) -> Optional[float]:
+    """µs between the first timestamped event's start and the last
+    event's end (None when nothing is timestamped) — the honest "traced
+    wall" denominator the roofline classifier and the link-utilization
+    line share."""
+    ts0 = None
+    ts1 = None
+    for e in events or []:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        dur = e.get("dur")
+        end = ts + (dur if isinstance(dur, (int, float)) else 0)
+        ts0 = ts if ts0 is None else min(ts0, ts)
+        ts1 = end if ts1 is None else max(ts1, end)
+    if ts0 is None or ts1 is None or ts1 <= ts0:
+        return None
+    return float(ts1 - ts0)
+
+
 def host_gaps(events: List[dict], min_gap_us: int = 1) -> List[dict]:
     """Gaps between consecutive ``window.*`` spans per thread, largest
     first: host-side time no span covers."""

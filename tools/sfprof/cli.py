@@ -1,5 +1,5 @@
 """sfprof CLI — ``report`` / ``diff [--gate]`` / ``health [--slo]`` /
-``recover``.
+``recover`` / ``trend [--gate]``.
 
 Run from the repo root: ``python -m tools.sfprof <cmd> ...``. The first
 three subcommands consume run ledgers (``telemetry.write_ledger``);
@@ -8,11 +8,26 @@ JSON-lines or a ``{"traceEvents"}`` document); ``recover`` consumes a
 ledger STREAM (``SFT_LEDGER_STREAM`` JSONL) and reconstructs a
 gateable ledger from any truncation of it; ``health --slo <spec>``
 additionally applies a declarative SLO spec (the same JSON the live
-engine evaluates) to the ledger.
+engine evaluates) to the ledger; ``trend`` ingests a whole history
+(ledgers, streams, legacy ``BENCH_r*.json`` supervisor records) into
+per-config series and — with ``--gate`` — checks a new capture against
+the trajectory's robust median + MAD band instead of one noisy
+predecessor.
 
-Exit codes: 0 ok; 1 gated regression (``diff --gate``), failed health/
-SLO verdict, or a recovered document that fails schema validation; 2
-unreadable/invalid input.
+``report`` and ``health`` take ``--json`` for machine-readable verdicts
+(``diff`` stays row-structured already); exit-code contracts are
+identical either way. Both surface the roofline bound classification
+(``tools/sfprof/roofline.py``): link/host/dispatch/compute/memory-bound
+with an ``↳`` evidence chain — a diagnosis, never a gate.
+
+Tainted captures (``tainted`` block stamped by the ablation harness,
+``spatialflink_tpu/ablation.py``) are HARD-REJECTED by ``diff --gate``
+and ``trend --gate`` with the taint named: a run whose kernels were
+stubbed out must never enter the perf record.
+
+Exit codes: 0 ok; 1 gated regression/taint (``diff --gate``,
+``trend --gate``), failed health/SLO verdict, or a recovered document
+that fails schema validation; 2 unreadable/invalid input.
 """
 
 from __future__ import annotations
@@ -25,8 +40,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from tools.sfprof import attribution
 from tools.sfprof import events as events_mod
 from tools.sfprof import ledger as ledger_mod
+from tools.sfprof import roofline as roofline_mod
 from tools.sfprof import slo as slo_mod
 from tools.sfprof import stream as stream_mod
+from tools.sfprof import trend as trend_mod
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -74,6 +91,10 @@ def cmd_report(args) -> int:
     except (OSError, ValueError) as e:
         print(f"sfprof: cannot read {args.path}: {e}")
         return 2
+    bound = roofline_mod.classify(
+        doc, events, peak_flops=args.peak_flops, peak_bw=args.peak_bw)
+    if args.json:
+        return _report_json(args, doc, events, bound)
     print(f"== sfprof report: {args.path}")
     if doc is not None:
         env = doc.get("env") or {}
@@ -179,6 +200,63 @@ def cmd_report(args) -> int:
     for g in gaps[:args.top]:
         print(f"{float(_ms(g['gap_us'])):10.3f} ms  after {g['after']} "
               f"→ before {g['before']}")
+
+    _print_roofline(bound)
+    return 0
+
+
+def _print_roofline(bound: Dict[str, Any]):
+    """The bound verdict with its sfcheck-style ``↳`` evidence chain."""
+    dom = "" if bound.get("dominant") else " (weak dominance)"
+    print(f"\n-- roofline bound classification --")
+    print(f"verdict: {bound['verdict']}{dom}")
+    for line in bound.get("evidence") or []:
+        print(f"  ↳ {line}")
+    per_op = bound.get("per_operator") or {}
+    for name, row in sorted(per_op.items()):
+        ph = row["phases_us"]
+        print(f"  {name}: {row['verdict']}  "
+              f"(transfer {float(_ms(ph['transfer'])):.3f} ms, "
+              f"compute {float(_ms(ph['compute'])):.3f} ms, "
+              f"host {float(_ms(ph['host'])):.3f} ms)")
+
+
+def _report_json(args, doc, events, bound) -> int:
+    """Machine-readable report: same signals the human text renders,
+    as one JSON document on stdout (exit code unchanged)."""
+    windows, ops = attribution.attribute_windows(events)
+    gaps = attribution.host_gaps(events)
+    out: Dict[str, Any] = {
+        "path": args.path,
+        "ledger": None,
+        "attribution": {
+            "windows": len(windows),
+            "operators": {
+                name: {
+                    "windows": int(agg["windows"]),
+                    "dur_us": int(agg["dur_us"]),
+                    "unattributed_us": int(agg["unattributed_us"]),
+                    "phases_us": dict(agg["phases"]),
+                }
+                for name, agg in sorted(ops.items())
+            },
+        },
+        "host_gaps": gaps[:args.top],
+        "roofline": bound,
+    }
+    if doc is not None:
+        snap = doc.get("snapshot") or {}
+        out["ledger"] = {
+            "ledger_version": int(doc.get("ledger_version", 0)),
+            "env": doc.get("env") or {},
+            "snapshot": snap,
+            "bench": doc.get("bench"),
+        }
+        out["kernels"] = (doc.get("kernels") or [])[:args.top]
+        taint = trend_mod.taint_of(doc)
+        if taint is not None:
+            out["tainted"] = taint
+    print(json.dumps(out, allow_nan=False))
     return 0
 
 
@@ -207,20 +285,9 @@ def _print_link_utilization(snap: Dict[str, Any], events: List[dict]):
 
 def complete_spans_ts_range(events: List[dict]) -> Optional[float]:
     """µs between the first event start and the last event end (None
-    when nothing is timestamped)."""
-    ts0 = None
-    ts1 = None
-    for e in events or []:
-        ts = e.get("ts")
-        if not isinstance(ts, (int, float)):
-            continue
-        dur = e.get("dur")
-        end = ts + (dur if isinstance(dur, (int, float)) else 0)
-        ts0 = ts if ts0 is None else min(ts0, ts)
-        ts1 = end if ts1 is None else max(ts1, end)
-    if ts0 is None or ts1 is None or ts1 <= ts0:
-        return None
-    return float(ts1 - ts0)
+    when nothing is timestamped). Shared with the roofline classifier
+    via ``attribution.span_range_us`` — ONE traced-wall definition."""
+    return attribution.span_range_us(events)
 
 
 # -- diff / gate --------------------------------------------------------------
@@ -381,6 +448,21 @@ def cmd_diff(args) -> int:
     except (OSError, ValueError) as e:
         print(f"sfprof: cannot read ledger: {e}")
         return 2
+    # Tainted captures never enter the record: an ablation run stubbed
+    # kernels out, so its numbers are deliberately wrong — refuse to
+    # compare AT ALL (silent inclusion is how a stubbed 10x "win" would
+    # poison the next gate's reference).
+    for label, path, doc in (("A", args.a, a_doc), ("B", args.b, b_doc)):
+        taint = trend_mod.taint_of(doc)
+        if taint is not None:
+            kinds = taint.get("kind", "?")
+            detail = ",".join(taint.get("kernels") or []) or "-"
+            print(f"== sfprof diff: A={args.a}  B={args.b}")
+            print(f"REJECT: ledger {label} ({path}) is tainted "
+                  f"({kinds}: kernels={detail}) — ablated/stubbed "
+                  "captures are profiling artifacts and never gate, "
+                  "diff, or baseline")
+            return 1 if args.gate else 0
     baseline = None
     try:
         with open(args.baseline) as f:
@@ -427,6 +509,12 @@ def cmd_health(args) -> int:
         return 2
     problems = ledger_mod.validate(doc)
     if problems:
+        if args.json:
+            print(json.dumps({
+                "ledger": args.ledger, "schema_problems": problems,
+                "checks": [], "failed": len(problems),
+            }, allow_nan=False))
+            return 1
         print(f"== sfprof health: {args.ledger}")
         for p in problems:
             print(f"FAIL schema: {p}")
@@ -458,12 +546,46 @@ def cmd_health(args) -> int:
             print(f"sfprof: cannot read SLO spec {args.slo}: {e}")
             return 2
         checks.extend(slo_mod.evaluate(spec, doc))
+    failed = sum(0 if ok else 1 for _n, _v, _b, ok in checks)
+    bound = roofline_mod.classify(doc, doc.get("events") or [])
+    taint = trend_mod.taint_of(doc)
+    if args.json:
+        print(json.dumps({
+            "ledger": args.ledger,
+            "schema_problems": [],
+            "checks": [
+                {"name": name, "value": value, "band": band,
+                 "ok": bool(ok)}
+                for name, value, band, ok in checks
+            ],
+            "failed": failed,
+            "roofline": bound,
+            "tainted": taint,
+            "notes": {
+                "driver": snap.get("driver") or {},
+                "overload": snap.get("overload") or {},
+                "pipeline": snap.get("pipeline") or {},
+                "faults": snap.get("faults") or {},
+                "instant_events": events_mod.notable_event_counts(
+                    doc.get("events") or []),
+            },
+        }, allow_nan=False))
+        return 1 if failed else 0
     print(f"== sfprof health: {args.ledger}")
-    failed = 0
     for name, value, band, ok in checks:
-        failed += 0 if ok else 1
         print(f"{'ok  ' if ok else 'FAIL'} {name:<34} "
               f"{_fmt_num(value):<12} [{band}]")
+    # Bound verdict (roofline.py): a diagnosis line, never a check —
+    # health's exit code stays a pure threshold contract.
+    dom = "" if bound.get("dominant") else " (weak dominance)"
+    print(f"bound: {bound['verdict']}{dom}")
+    for line in bound.get("evidence") or []:
+        print(f"  ↳ {line}")
+    if taint is not None:
+        print(f"note TAINTED capture: {taint.get('kind', '?')} "
+              f"(kernels={','.join(taint.get('kernels') or []) or '-'})"
+              " — profiling artifact; diff/trend gates and baseline "
+              "writers reject it")
     # Self-healing visibility (informational — a run that SURVIVED on
     # retries/fallback is degraded, not failed; budget it via an --slo
     # spec's retry_budget/failover_budget to make it gate):
@@ -573,6 +695,143 @@ def cmd_recover(args) -> int:
     return 1 if problems else 0
 
 
+# -- trend --------------------------------------------------------------------
+
+
+def _key_str(key: tuple) -> str:
+    return " ".join(f"{f}={v}" for f, v in
+                    zip(trend_mod.SERIES_KEY_FIELDS, key))
+
+
+def cmd_trend(args) -> int:
+    points, skipped = trend_mod.ingest_paths(args.history)
+    series = trend_mod.build_series(points)
+    if args.config:
+        series = {k: v for k, v in series.items()
+                  if args.config in str(k[0])}
+
+    out: Dict[str, Any] = {
+        "series": [], "skipped": skipped, "gate": None,
+    }
+    for key, pts in sorted(series.items(), key=lambda kv: kv[0]):
+        values = [p["value"] for p in pts]
+        stats = trend_mod.robust_stats(values)
+        row = {
+            "key": dict(zip(trend_mod.SERIES_KEY_FIELDS, key)),
+            "n": stats["n"],
+            "median": stats["median"],
+            "mad": stats["mad"],
+            "floor": trend_mod.gate_floor(stats, args.mad_k,
+                                          args.eps_tol),
+            "latest": pts[-1]["value"],
+            "sources": [p["source"] for p in pts],
+        }
+        res = [p["resident"] for p in pts if p["resident"] is not None]
+        if res:
+            rstats = trend_mod.robust_stats(res)
+            row["resident_median"] = rstats["median"]
+            row["resident_n"] = rstats["n"]
+        out["series"].append(row)
+
+    rc = 0
+    if args.gate:
+        out["gate"], rc = _gate_against_trend(args, series)
+    if args.json:
+        print(json.dumps(out, allow_nan=False))
+        return rc
+
+    print(f"== sfprof trend: {len(points)} point(s) in "
+          f"{len(series)} series, {len(skipped)} record(s) skipped")
+    for row in out["series"]:
+        print(f"{_key_str(tuple(row['key'].values()))}: "
+              f"n={int(row['n'])} median={float(row['median']):.1f} "
+              f"MAD={float(row['mad']):.1f} "
+              f"floor={float(row['floor']):.1f} "
+              f"latest={float(row['latest']):.1f}")
+    for s in skipped:
+        print(f"skipped {s['source']}: {s['reason']}")
+    g = out["gate"]
+    if g:
+        print(f"== trend gate: {g['candidate']}")
+        if g.get("reject"):
+            print(f"REJECT: {g['reject']}")
+        for chk in g.get("checks") or []:
+            print(f"{'ok  ' if chk['ok'] else 'FAIL'} "
+                  f"{chk['metric']:<28} "
+                  f"value={float(chk['value']):.1f} [{chk['band']}]")
+        if g.get("note"):
+            print(f"note: {g['note']}")
+        print(f"gate verdict: {'PASS' if rc == 0 else 'FAIL'}")
+    return rc
+
+
+def _gate_against_trend(args, series) -> Tuple[Dict[str, Any], int]:
+    """(gate block, exit code) for the ``--gate`` candidate against its
+    series. Tainted candidates are hard-rejected; a candidate with no
+    matching history passes with a loud note unless
+    ``--require-history`` (the CI mode — a missing fixture must fail,
+    not silently wave everything through)."""
+    gate: Dict[str, Any] = {"candidate": args.gate, "checks": []}
+    try:
+        doc, kind = trend_mod.load_candidate(args.gate)
+    except (OSError, ValueError) as e:
+        gate["reject"] = f"cannot read candidate: {e}"
+        return gate, 2
+    taint = trend_mod.taint_of(doc)
+    if taint is not None:
+        gate["reject"] = (
+            f"candidate is tainted ({taint.get('kind', '?')}: kernels="
+            f"{','.join(taint.get('kernels') or []) or '-'}) — ablated "
+            "captures never enter the trend record")
+        return gate, 1
+    pt, reason = trend_mod.point_of(doc, kind, args.gate)
+    if pt is None:
+        gate["reject"] = f"candidate carries no gateable EPS: {reason}"
+        return gate, 1
+    gate["key"] = dict(zip(trend_mod.SERIES_KEY_FIELDS,
+                           trend_mod.series_key(pt)))
+    pts = series.get(trend_mod.series_key(pt)) or []
+    # Never gate a capture against itself: the candidate file may sit
+    # in the history dir (the SFT_LEDGER_DIR layout), and the same run
+    # may ALSO appear under another path — its sibling stream's
+    # recovery, a copied ledger — carrying the identical bench record.
+    # Exclude by path and by exact (value, resident) identity; a
+    # distinct run tying both rounded values is rare and could only
+    # make the gate stricter by one sample.
+    cand = os.path.abspath(args.gate)
+
+    def _own(p) -> bool:
+        return (os.path.abspath(p["source"]) == cand
+                or (p["value"] == pt["value"]
+                    and p["resident"] == pt["resident"]))
+
+    others = [p for p in pts if not _own(p)]
+    history = [p["value"] for p in others]
+    # Stats need >= 1 point: --min-history 0 still means "gate only
+    # with actual history", never an empty-series crash.
+    min_hist = max(int(args.min_history), 1)
+    if len(history) < min_hist:
+        note = (f"insufficient history for this key: {len(history)} "
+                f"point(s) < --min-history {int(min_hist)}")
+        gate["note"] = note
+        return gate, (1 if args.require_history else 0)
+    rc = 0
+    chk = trend_mod.gate_metric(history, pt["value"], args.mad_k,
+                                args.eps_tol)
+    chk["metric"] = "points_per_sec"
+    gate["checks"].append(chk)
+    rc = rc or (0 if chk["ok"] else 1)
+    res_hist = [p["resident"] for p in others
+                if p["resident"] is not None]
+    if pt["resident"] is not None and len(res_hist) >= min_hist:
+        chk = trend_mod.gate_metric(res_hist, pt["resident"],
+                                    args.mad_k, args.eps_tol)
+        chk["metric"] = "device_resident_points_per_sec"
+        gate["checks"].append(chk)
+        rc = rc or (0 if chk["ok"] else 1)
+    return gate, rc
+
+
 # -- entry --------------------------------------------------------------------
 
 
@@ -586,9 +845,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     rep = sub.add_parser(
         "report", help="phase attribution, top kernels, bytes/window, "
-                       "host gaps from a ledger or Chrome trace")
+                       "host gaps, roofline bound verdict from a "
+                       "ledger or Chrome trace")
     rep.add_argument("path")
     rep.add_argument("--top", type=int, default=10)
+    rep.add_argument("--json", action="store_true",
+                     help="one machine-readable JSON document instead "
+                          "of human text (same exit code)")
+    rep.add_argument("--peak-flops", type=float, default=None,
+                     help="override the roofline machine model's "
+                          "sustained flop/s")
+    rep.add_argument("--peak-bw", type=float, default=None,
+                     help="override the roofline machine model's "
+                          "memory bandwidth (B/s)")
     rep.set_defaults(fn=cmd_report)
 
     dif = sub.add_parser(
@@ -622,6 +891,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "evaluates: watermark-lag p99 ceiling, EPS "
                           "floor, late-drop/overflow budgets, recompile "
                           "ceiling)")
+    hea.add_argument("--json", action="store_true",
+                     help="one machine-readable JSON document (checks, "
+                          "roofline verdict, taint, notes) instead of "
+                          "human text (same exit code)")
     hea.set_defaults(fn=cmd_health)
 
     rec = sub.add_parser(
@@ -632,6 +905,43 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output ledger path (default: "
                           "<stream>.recovered.json)")
     rec.set_defaults(fn=cmd_recover)
+
+    trd = sub.add_parser(
+        "trend", help="per-config time series over a whole capture "
+                      "history (ledgers, streams, legacy BENCH_r*.json "
+                      "supervisor records); --gate checks a new "
+                      "capture against the robust median + MAD band")
+    trd.add_argument("history", nargs="+",
+                     help="history files and/or directories (dirs: "
+                          "every .json/.jsonl inside, sorted)")
+    trd.add_argument("--gate", default=None, metavar="NEW_LEDGER",
+                     help="candidate capture to gate against its "
+                          "series; exit 1 outside the band or tainted")
+    trd.add_argument("--config", default=None,
+                     help="only series whose config name contains this "
+                          "substring")
+    trd.add_argument("--mad-k", type=float,
+                     default=trend_mod.DEFAULT_MAD_K,
+                     help="MAD band width in robust sigmas "
+                          "(default %(default)s)")
+    trd.add_argument("--eps-tol", type=float,
+                     default=trend_mod.DEFAULT_EPS_TOL,
+                     help="relative floor: regression also requires "
+                          "value < median*(1-eps_tol) "
+                          "(default %(default)s — the tunnel variance)")
+    trd.add_argument("--min-history", type=int,
+                     default=trend_mod.DEFAULT_MIN_HISTORY,
+                     help="points required before the gate engages "
+                          "(default %(default)s)")
+    trd.add_argument("--require-history", action="store_true",
+                     help="fail (exit 1) when the candidate's series "
+                          "has fewer than --min-history points — the "
+                          "CI mode: a missing fixture must not wave "
+                          "captures through")
+    trd.add_argument("--json", action="store_true",
+                     help="one machine-readable JSON document (series, "
+                          "skipped evidence, gate verdict)")
+    trd.set_defaults(fn=cmd_trend)
     return ap
 
 

@@ -45,6 +45,9 @@ INSTANT_EVENTS = frozenset({
     # breaker-driven collapse to the synchronous cadence and back
     "pipeline_collapsed",
     "pipeline_resumed",
+    # kernel-ablation harness armed (spatialflink_tpu/ablation.py) —
+    # the event that marks a capture's numbers as deliberately wrong
+    "ablation_armed",
 })
 
 #: Literal name prefixes for parameterized events (the suffix names the
@@ -65,6 +68,7 @@ _GROUPS = (
     ("overload", ("overload_",)),
     ("pipeline", ("pipeline_collapsed", "pipeline_resumed")),
     ("slo", ("slo_violation:", "slo_recovered:")),
+    ("ablation", ("ablation_armed",)),
 )
 
 
