@@ -557,6 +557,149 @@ def bench_knn_multi_query(jax, jnp, grid, quick):
                    spread=(t_min, t_max), resident=(pps_r, r_min, r_max))
 
 
+def bench_qserve(jax, jnp, grid, quick):
+    """qserve config: 1024 standing queries (mixed range/kNN across
+    k-rungs and radius classes) served by the bucketed registry kernels
+    (ops/query_registry.py), with registration CHURN enabled — every
+    window swaps 16 queries per bucket for fresh ones (same occupancy →
+    same rung → zero recompiles; the ≤K-signatures contract is asserted
+    in tests/test_qserve.py, this config measures its throughput).
+    Rate = distinct ingested points / wall time; every point is
+    evaluated against every bucket (one vmapped program per bucket per
+    window), double-buffered like the other configs."""
+    from spatialflink_tpu.ops.cells import assign_cells
+    from spatialflink_tpu.ops.query_registry import registry_bucket_kernel
+    from spatialflink_tpu.qserve import (
+        StandingQuery,
+        bucket_host_arrays,
+        bucket_key,
+    )
+
+    nq = 256 if quick else 1024
+    win_pts = 65_536 if quick else 131_072
+    n_win = 3 if quick else 8
+    churn = 4 if quick else 16
+    nseg = 16_384
+    rng = np.random.default_rng(37)
+
+    def mk_query(i):
+        kind = "range" if i % 2 == 0 else "knn"
+        k = (32, 5, 10, 30)[i % 4]  # rungs 32, 8, 16, 32
+        return StandingQuery(
+            qid=f"q{i}", tenant=f"t{i % 97}", kind=kind,
+            x=float(rng.uniform(115.6, 117.5)),
+            y=float(rng.uniform(39.7, 41.0)),
+            radius=float((0.002, 0.02, 0.05)[i % 3]), k=k,
+        )
+
+    queries = [mk_query(i) for i in range(nq)]
+    flags_cache = {}
+
+    def flags_of(q):
+        key = (q.x, q.y, q.radius)
+        if key not in flags_cache:
+            flags_cache[key] = grid.neighbor_flags(
+                q.radius, [grid.flat_cell(q.x, q.y)]
+            )
+        return flags_cache[key]
+
+    buckets = {}
+    for q in queries:
+        buckets.setdefault(bucket_key(q), []).append(q)
+    dev = jax.devices()[0]
+
+    from spatialflink_tpu.ops.compaction import pick_capacity
+
+    def stage_bucket(key, qs):
+        cap = pick_capacity(len(qs), 1024, minimum=8)
+        qxy, radius, qvalid, tables = bucket_host_arrays(
+            grid, qs, cap, flags_of=flags_of
+        )
+        return {
+            "k": int(key[1]), "cap": cap,
+            "qxy": jax.device_put(jnp.asarray(qxy.astype(np.float32)),
+                                  dev),
+            "radius": jax.device_put(
+                jnp.asarray(radius.astype(np.float32)), dev),
+            "qvalid": jax.device_put(jnp.asarray(qvalid), dev),
+            "tables": jax.device_put(jnp.asarray(tables), dev),
+        }
+
+    staged = {key: stage_bucket(key, qs) for key, qs in sorted(
+        buckets.items())}
+    xy, oid, ts = _stream(win_pts * n_win, seed=41)
+    oid16 = oid.astype(np.int16)
+    valid_d = jax.device_put(jnp.asarray(np.ones(win_pts, bool)), dev)
+
+    def step(xy_w, oid16_w, valid, ftabs, qxy, radius, qvalid, k, cap):
+        cell = assign_cells(
+            xy_w, grid.min_x, grid.min_y, grid.cell_length, grid.n
+        )
+        res = registry_bucket_kernel(
+            xy_w, valid, cell, ftabs, oid16_w.astype(jnp.int32), qxy,
+            radius, qvalid, k=k, num_segments=nseg,
+            query_block=min(cap, 32),
+        )
+        return res.num_valid, res.within
+
+    jstep = _instr(jax.jit(step, static_argnames=("k", "cap")),
+                   "qserve_bucket_step")
+
+    def win_arrays(i):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        return (
+            jax.device_put(xy[sl], dev),
+            jax.device_put(oid16[sl], dev),
+        )
+
+    def dispatch_all(args):
+        xy_w, oid_w = args
+        return [
+            jstep(xy_w, oid_w, valid_d, b["tables"], b["qxy"],
+                  b["radius"], b["qvalid"], k=b["k"], cap=b["cap"])
+            for _key, b in sorted(staged.items())
+        ]
+
+    xa, oa = win_arrays(0)
+    jax.device_get(dispatch_all((xa, oa)))  # compile every bucket
+
+    # Churn: per timed window, swap `churn` queries per bucket for
+    # fresh ones at the SAME occupancy — re-stages (re-ships) that
+    # bucket's host arrays, the steady-state registration cost.
+    next_id = [nq]
+
+    def churn_buckets():
+        for key in sorted(buckets):
+            qs = buckets[key]
+            for _ in range(min(churn, len(qs))):
+                old = qs.pop(0)
+                fresh = mk_query(next_id[0])
+                next_id[0] += 1
+                # keep the swap inside the SAME bucket: reuse the old
+                # query's kind/k/radius (fresh position only)
+                qs.append(StandingQuery(
+                    qid=f"q{next_id[0]}", tenant=fresh.tenant,
+                    kind=old.kind,
+                    x=fresh.x, y=fresh.y, radius=old.radius, k=old.k,
+                ))
+            staged[key] = stage_bucket(key, qs)
+
+    def dispatch(args):
+        churn_buckets()
+        return dispatch_all(args)
+
+    out, dt, t_min, t_max = _pipelined(
+        jax, n_win, win_arrays, dispatch,
+    )
+    nv_last = sum(int(np.sum(nv)) for nv, _ in out[-1])
+    return _result(
+        "qserve_1024q_mixed", n_win * win_pts, dt,
+        {"queries": nq, "buckets": len(staged),
+         "churn_per_window": churn, "num_valid_last": nv_last},
+        spread=(t_min, t_max),
+    )
+
+
 def bench_point_polygon_join(jax, jnp, grid, quick):
     """Polygon-STREAM join config: points ⋈ 1000 polygons per window via
     the grid-pruned block kernel (ops/join.py:
@@ -1369,6 +1512,8 @@ def main():
          lambda: bench_tstats_pane(jax, jnp, grid, args.quick)),
         ("knn_multi_64queries_k10",
          lambda: bench_knn_multi_query(jax, jnp, grid, args.quick)),
+        ("qserve_1024q_mixed",
+         lambda: bench_qserve(jax, jnp, grid, args.quick)),
     ]
     if args.configs:
         wanted = [w.strip() for w in args.configs.split(",") if w.strip()]

@@ -24,6 +24,10 @@ Snapshottable components:
     mid-window with identical output (tests/test_checkpoint_panes.py —
     pass ``flush_at_end=False`` so a killed source doesn't flush open
     windows);
+  - qserve QueryRegistry (qserve.py): the standing-query set, applied-
+    command uids, and QoS counters — kill mid-registration-churn
+    resumes to byte-identical per-tenant egress (chaos matrix,
+    ``qserve.register``);
   - Interner: the objID vocabulary (so dense ids stay stable on resume);
   - WireKafkaSource: per-partition consumed offsets (kafka_source_state)
     — Flink's checkpointed Kafka-consumer role, so kill-and-resume
@@ -152,6 +156,9 @@ def operator_state(op) -> Dict[str, Any]:
                 "counts", [1] * len(wire_pane["digests"])
             )],
         }
+    qreg = getattr(op, "qserve_registry", None)
+    if qreg is not None:  # qserve standing-query registry (qserve.py)
+        out["qserve"] = qreg.state()
     jcarry = getattr(op, "_join_pane_carry", None)
     if jcarry is not None:  # join query_panes pane events + pair blocks
         out["join_pane_carry"] = {
@@ -218,6 +225,12 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
         # Consumed by the NEXT run_wire_panes call only — the
         # index-based carry must never leak into an ordinary fresh run.
         op._wire_pane_restored = True
+    if "qserve" in state and getattr(op, "qserve_registry", None) \
+            is not None:
+        # Flag tables are derived (rebuilt from the grid inside
+        # restore); the interner restored above keeps tenant/qid ids
+        # stable — one intern home.
+        op.qserve_registry.restore(state["qserve"])
     if "join_pane_carry" in state:
         # Pane batches are derived data — rebuild through the operator's
         # own batcher (the interner restored above keeps ids stable).

@@ -49,6 +49,13 @@ ENV_VARS = {
                "import; results stay bit-identical but an ambient value "
                "would flip the gate's pipeline-off baselines",
     },
+    "SFT_QSERVE": {
+        "owner": "spatialflink_tpu/qserve.py", "hazard": "armed",
+        "doc": "qserve serving config (inline JSON or path): standing "
+               "queries + per-tenant-class budgets; an ambient value "
+               "would register ghost queries / arm QoS budgets in runs "
+               "that never asked for them",
+    },
     "SFT_SLO_SPEC": {
         "owner": "bench.py", "hazard": "armed",
         "doc": "SLO spec evaluated LIVE during a bench run",

@@ -86,6 +86,9 @@ INJECTION_POINTS: Dict[str, str] = {
                      "host→device pane ship (encode + stage ahead)",
     "pipeline.fetch": "pipeline.py:PipelinedExecutor — lagged "
                       "device→host result fetch (ordered drain)",
+    "qserve.register": "qserve.py:QueryRegistry.apply — standing-query "
+                       "register/unregister command application (the "
+                       "kill-mid-registration-churn point)",
 }
 
 #: Points whose callers implement the cooperative ``partial_write`` kind.

@@ -1,0 +1,152 @@
+"""Bucketed standing-query evaluation kernels — the device half of
+qserve (spatialflink_tpu/qserve.py).
+
+GeoFlink's execution model is one spatial query per Flink job (CIKM 2020
+§IV); serving THOUSANDS of standing range/kNN queries against one object
+stream needs the batched form instead: every registered query in a
+bucket evaluates as ONE vmapped fixed-shape program per window. This
+module generalizes ``ops/knn.py:knn_multi_query_kernel`` along the two
+axes a registry needs:
+
+- **per-query radius**: the radius is a traced ``(Q,)`` operand, not a
+  static — queries with different radii share one compiled program, so
+  registration churn across radii never recompiles;
+- **padded query lanes**: buckets are padded to a power-of-two capacity
+  rung (ops/compaction.py ladder — the host picks the rung from the LIVE
+  query count), and ``query_valid`` masks the padding lanes to empty
+  results. Padding never changes results (the mask-don't-compact kernel
+  invariant).
+
+One result shape serves both query kinds: per query, the top-``k``
+distinct objects by min distance within that query's radius
+(``ops/knn.py``'s segment-min + top-k core — the same dedup contract as
+the reference's PQ/HashSet merge, KNNQuery.java:204-308). A kNN query
+reads its first ``k_q ≤ k`` rows; a range query reads all ``num_valid``
+rows (every row is within radius by construction) with ``within`` — the
+UNCLAMPED count of distinct in-radius objects — as its exactness
+counter: ``within > k`` means the rung truncated a range result
+(``range_bucket_overflow``), the standard overflow-and-retry contract.
+
+Per-query results are bit-identical to running ``ops/knn.py:
+knn_points_fused`` once per query with that query's own flag table and
+radius (parity pinned in tests/test_qserve.py); the mesh counterpart is
+``parallel/sharded.py:sharded_registry_bucket`` (same pmin-reduce as the
+other kNN kernels, CPU-mesh parity test alongside).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.distances import point_point_distance
+from spatialflink_tpu.ops.knn import _digest_from_point_dists, _finish_topk
+
+__all__ = [
+    "RegistryBucketResult",
+    "registry_bucket_query",
+    "registry_bucket_kernel",
+    "range_bucket_overflow",
+]
+
+
+class RegistryBucketResult(NamedTuple):
+    """Per-query top-k over a bucket. Leading axis = query lane; padded
+    lanes (``query_valid`` False) carry dist=+big, segment=-1, counts 0."""
+
+    dist: jnp.ndarray  # (Q, k) ascending min-distance per winning object
+    segment: jnp.ndarray  # (Q, k) interned objID (-1 = padding)
+    index: jnp.ndarray  # (Q, k) winning point's index in the window batch
+    num_valid: jnp.ndarray  # (Q,) min(within, k)
+    within: jnp.ndarray  # (Q,) distinct objects within radius, UNCLAMPED
+
+
+def registry_bucket_query(
+    xy, valid, cell, flags_table, oid, q_xy, radius, q_ok,
+    k: int, num_segments: int, axis_name=None, index_base=None,
+):
+    """ONE standing query against the window batch — the shared core the
+    vmapped bucket kernel and the sharded mesh counterpart both call.
+
+    ``radius`` is a traced scalar (per-query operand); ``q_ok`` masks a
+    padded query lane to an empty result. For a live lane this is
+    exactly ``ops/knn.py:knn_points_fused``'s digest + top-k (same
+    masked segment-min, same lowest-index tie-break), so bucketed
+    results are bit-identical to per-query sequential evaluation.
+    """
+    from spatialflink_tpu.ops.cells import gather_cell_flags
+
+    dist = point_point_distance(xy, q_xy[None, :])
+    flags = gather_cell_flags(cell, flags_table)
+    d = _digest_from_point_dists(
+        dist, valid & q_ok, flags, oid, radius, num_segments,
+        axis_name=axis_name, index_base=index_base,
+    )
+    big = jnp.asarray(jnp.finfo(d.seg_min.dtype).max, d.seg_min.dtype)
+    within = jnp.sum((d.seg_min < big).astype(jnp.int32))
+    res = _finish_topk(d.seg_min, d.rep, k)
+    return res.dist, res.segment, res.index, res.num_valid, within
+
+
+def registry_bucket_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    flags_tables: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    radius: jnp.ndarray,
+    query_valid: jnp.ndarray,
+    k: int,
+    num_segments: int,
+    query_block: int = 32,
+) -> RegistryBucketResult:
+    """One bucket of standing queries in ONE program per window.
+
+    ``query_xy``: (Q, 2); ``flags_tables``: (Q, num_cells+1) per-query
+    neighbor-cell tables; ``radius``: (Q,) per-query radii (traced);
+    ``query_valid``: (Q,) bool — padded rung lanes. ``k`` is the
+    bucket's result-capacity rung and ``num_segments`` the interner
+    bucket — the ONLY query-derived statics, so a registry sweeping any
+    occupancy compiles at most ladder-many programs (the recompile
+    detector sees stable signatures, not churn). Queries run in
+    ``query_block``-sized vmapped chunks under ``lax.map`` so peak
+    memory is O(query_block × N); Q must divide into blocks (the rung is
+    a power of two ≥ 8, so any power-of-two block ≤ Q divides).
+    """
+    q_total = query_xy.shape[0]
+    if q_total % query_block != 0:
+        raise ValueError("pad the query bucket to a multiple of query_block")
+
+    def one(q_xy, ftab, r, ok):
+        return registry_bucket_query(
+            xy, valid, cell, ftab, oid, q_xy, r, ok,
+            k=k, num_segments=num_segments,
+        )
+
+    def block(args):
+        q_blk, f_blk, r_blk, ok_blk = args
+        return jax.vmap(one)(q_blk, f_blk, r_blk, ok_blk)
+
+    nb = q_total // query_block
+    res = jax.lax.map(
+        block,
+        (
+            query_xy.reshape(nb, query_block, 2),
+            flags_tables.reshape(nb, query_block, -1),
+            radius.reshape(nb, query_block),
+            query_valid.reshape(nb, query_block),
+        ),
+    )
+    return RegistryBucketResult(
+        *[x.reshape((q_total,) + x.shape[2:]) for x in res]
+    )
+
+
+def range_bucket_overflow(within: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Total distinct in-radius objects the rung could NOT return across
+    a bucket — the range-query exactness counter (0 ⇒ every range result
+    in the bucket is complete; otherwise climb the result-cap rung)."""
+    return jnp.sum(jnp.maximum(within - k, 0))

@@ -102,6 +102,52 @@ _RUNG_KEYS = {
 }
 
 
+#: Per-tenant-class budget keys (``OverloadPolicy.tenant_budgets``).
+#: ``max_queries`` bounds STANDING queries a class may keep registered
+#: (qserve registration admission); ``max_results_per_window`` bounds
+#: the result rows a class may emit per fired window. Both controls
+#: scope to the class — a firehose tenant degrades ITSELF, never the
+#: fleet (tenant sheds deliberately do NOT feed the global degradation
+#: ladder).
+TENANT_BUDGET_KEYS = ("max_queries", "max_results_per_window")
+
+
+def validate_budget_map(tb, keys, what: str = "tenant_budgets"):
+    """Strict parse of a ``{class: {budget-key: int}}`` map — ONE home
+    for the per-class budget validation (this module's
+    ``OverloadPolicy.tenant_budgets`` and ``slo.SloSpec.tenant_budgets``
+    both accept this shape with different key tuples; two hand-rolled
+    copies would drift). Unknown keys and non-int/negative/bool values
+    raise at parse time — a malformed budget crashing mid-run (or
+    silently ignored) is the failure mode the strict parse prevents."""
+    if tb is None:
+        return None
+    if not isinstance(tb, dict):
+        raise ValueError(f"{what} must be an object, got {tb!r}")
+    out = {}
+    for cls, b in tb.items():
+        if not isinstance(b, dict):
+            raise ValueError(f"{what}[{cls!r}] is not an object: {b!r}")
+        unknown = sorted(set(b) - set(keys))
+        if unknown:
+            raise ValueError(
+                f"{what}[{cls!r}] has unknown keys {unknown} "
+                f"(keys: {tuple(keys)})"
+            )
+        for key, v in b.items():
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise ValueError(
+                    f"{what}[{cls!r}].{key} must be a "
+                    f"non-negative int, got {v!r}"
+                )
+        out[str(cls)] = dict(b)
+    return out
+
+
+def _parse_tenant_budgets(tb):
+    return validate_budget_map(tb, TENANT_BUDGET_KEYS)
+
+
 def _parse_ladder(ladder) -> Tuple[Dict[str, Any], ...]:
     if ladder is None:
         return ()
@@ -176,7 +222,13 @@ class OverloadPolicy:
     - ``breaker_probe_every``: fallback windows between half-open
       re-dial probes while the circuit is open;
     - ``breaker_link_ratio``: LinkProbe bandwidth ratio (last/p50)
-      below which the circuit opens preemptively.
+      below which the circuit opens preemptively;
+    - ``tenant_budgets``: per-tenant-class QoS scoping (qserve) —
+      ``{class: {"max_queries": N, "max_results_per_window": M}}``.
+      Excess registrations are rejected and excess result rows shed,
+      counted PER CLASS (``snapshot()["tenants"]``); tenant sheds never
+      step the global ladder — one firehose tenant degrades itself,
+      never the fleet.
     """
 
     max_buffered_events: Optional[int] = None
@@ -191,9 +243,14 @@ class OverloadPolicy:
     breaker_failures: int = 0
     breaker_probe_every: int = 8
     breaker_link_ratio: Optional[float] = None
+    tenant_budgets: Optional[Dict[str, Dict[str, int]]] = None
 
     def __post_init__(self):
         object.__setattr__(self, "ladder", _parse_ladder(self.ladder))
+        object.__setattr__(
+            self, "tenant_budgets",
+            _parse_tenant_budgets(self.tenant_budgets),
+        )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "OverloadPolicy":
@@ -330,7 +387,14 @@ class CircuitBreaker:
 
 def _measure_item(item) -> Tuple[Optional[int], int, int]:
     """(max event ts | None, n_events, nbytes) of one ingest item —
-    object events (``.timestamp``) or SoA chunks (dict of arrays)."""
+    object events (``.timestamp``) or SoA chunks (dict of arrays).
+    CONTROL-PLANE items (``control_plane`` attr True — e.g. qserve's
+    registration commands) measure as zero events: they are commands,
+    not load, and shedding one would silently diverge the registry from
+    the command stream for the rest of the run (duck-typed, because
+    this module must not import qserve)."""
+    if getattr(item, "control_plane", False):
+        return None, 0, 0
     ts = getattr(item, "timestamp", None)
     if ts is not None:
         return int(ts), 1, 0
@@ -387,6 +451,15 @@ class OverloadController:
         self._shedding = False
         self._shed_oldest = False
         self._shed_windows = 0  # fired windows while in shed mode
+        # per-tenant-class QoS (tenant_budgets): class → counters.
+        # Tenant sheds are deliberately ISOLATED from the global health
+        # machinery — a class over ITS budget degrades itself only.
+        self.tenant: Dict[str, Dict[str, int]] = {}
+        self._tenant_shedding: set = set()
+        self._tenant_shed_this_window: set = set()
+        # class → (window_start, last results charge): the retry-
+        # idempotence marker for tenant_result_allowance.
+        self._tenant_window_charge: Dict[str, Tuple[int, int]] = {}
         # degradation ladder
         self.rung = 0
         self.rung_transitions = 0
@@ -488,6 +561,101 @@ class OverloadController:
         with self._lock:
             return sum(r["events"] for r in self.shed.values())
 
+    # -- per-tenant-class QoS (qserve) -----------------------------------------
+
+    def _tenant_rec_locked(self, cls: str) -> Dict[str, int]:
+        return self.tenant.setdefault(str(cls), {
+            "queries_live": 0, "queries_shed": 0,
+            "results_shed": 0, "degraded_windows": 0,
+        })
+
+    def _tenant_budget(self, cls: str) -> Optional[Dict[str, int]]:
+        return (self.policy.tenant_budgets or {}).get(str(cls))
+
+    def admit_tenant_query(self, cls: str) -> bool:
+        """One standing-query registration for tenant class ``cls``
+        (qserve's registry calls this). False = the class is at its
+        ``max_queries`` budget — the registration is rejected and
+        counted against THE CLASS (``queries_shed``), with a per-class
+        shedding transition event. Never feeds the global ladder."""
+        try:
+            with self._lock:
+                rec = self._tenant_rec_locked(cls)
+                b = self._tenant_budget(cls)
+                limit = None if b is None else b.get("max_queries")
+                if limit is not None and rec["queries_live"] >= limit:
+                    rec["queries_shed"] += 1
+                    self._tenant_shed_this_window.add(str(cls))
+                    if cls not in self._tenant_shedding:
+                        self._tenant_shedding.add(str(cls))
+                        self._emit_locked(f"overload_tenant_shed:{cls}",
+                                          control="queries",
+                                          limit=int(limit))
+                    return False
+                rec["queries_live"] += 1
+                return True
+        finally:
+            self._drain_emits()
+
+    def release_tenant_query(self, cls: str):
+        """One standing-query unregistration for class ``cls``."""
+        with self._lock:
+            rec = self._tenant_rec_locked(cls)
+            rec["queries_live"] = max(0, rec["queries_live"] - 1)
+
+    def tenant_result_allowance(self, cls: str, n: int,
+                                window_start: Optional[int] = None) -> int:
+        """Result rows class ``cls`` may emit this window: ``n`` when
+        under its ``max_results_per_window`` budget, else the budget —
+        the excess is counted as ``results_shed`` and the window as a
+        per-class degraded window. Other classes are untouched.
+
+        ``window_start`` makes the charge RETRY-IDEMPOTENT: re-charging
+        the same (class, window) — a driver retry re-running the
+        window's process — replaces the previous charge instead of
+        accumulating it (the qserve record_range_overflow contract)."""
+        try:
+            with self._lock:
+                rec = self._tenant_rec_locked(cls)
+                b = self._tenant_budget(cls)
+                limit = (None if b is None
+                         else b.get("max_results_per_window"))
+                if limit is None or n <= limit:
+                    return int(n)
+                shed = int(n) - int(limit)
+                if window_start is not None:
+                    prev = self._tenant_window_charge.get(str(cls))
+                    if prev is not None and prev[0] == int(window_start):
+                        rec["results_shed"] -= prev[1]
+                        rec["degraded_windows"] -= 1
+                    self._tenant_window_charge[str(cls)] = (
+                        int(window_start), shed,
+                    )
+                rec["results_shed"] += shed
+                rec["degraded_windows"] += 1
+                self._tenant_shed_this_window.add(str(cls))
+                if cls not in self._tenant_shedding:
+                    self._tenant_shedding.add(str(cls))
+                    self._emit_locked(f"overload_tenant_shed:{cls}",
+                                      control="results",
+                                      limit=int(limit))
+                return int(limit)
+        finally:
+            self._drain_emits()
+
+    def tenant_shed_total(self, cls: str) -> int:
+        """Queries rejected + result rows shed for class ``cls`` (the
+        SLO ``tenant_budgets`` shed metric; 0 for an unseen class)."""
+        with self._lock:
+            rec = self.tenant.get(str(cls))
+            return 0 if rec is None \
+                else rec["queries_shed"] + rec["results_shed"]
+
+    def tenant_degraded_windows(self, cls: str) -> int:
+        with self._lock:
+            rec = self.tenant.get(str(cls))
+            return 0 if rec is None else rec["degraded_windows"]
+
     # -- window-fire hook ------------------------------------------------------
 
     def on_window_fired(self, n_events: int = 0,
@@ -527,6 +695,15 @@ class OverloadController:
                 self._admission_shedding = False
                 self._emit_locked("overload_recovered:admission")
             self._sheds_since_fire = 0
+            # Per-tenant shed transitions recover per fired window: a
+            # class that shed nothing since the last fire leaves shed
+            # mode (transition event, not per-shed spam). Class-local —
+            # the global health sample below never sees tenant sheds.
+            for cls in sorted(self._tenant_shedding
+                              - self._tenant_shed_this_window):
+                self._tenant_shedding.discard(cls)
+                self._emit_locked(f"overload_tenant_recovered:{cls}")
+            self._tenant_shed_this_window = set()
             lag_ok = True
             if pol.lag_shed_ceiling_ms is not None and lag_ms is not None:
                 ceiling = pol.lag_shed_ceiling_ms
@@ -666,6 +843,11 @@ class OverloadController:
                 "rung": int(self.rung),
                 "ladder_depth": len(self.policy.ladder),
                 "rung_transitions": int(self.rung_transitions),
+                # Always present (possibly empty): the sfprof twin reads
+                # an unseen class as 0 sheds, while a MISSING overload
+                # block fails on silence — the twin mirrors exactly that.
+                "tenants": {cls: dict(rec)
+                            for cls, rec in sorted(self.tenant.items())},
             }
         if self.breaker is not None:
             out["breaker"] = self.breaker.snapshot()
@@ -694,6 +876,13 @@ class OverloadController:
                 "backpressure_engaged": self.backpressure_engaged,
                 "rung": self.rung,
                 "rung_transitions": self.rung_transitions,
+                "tenant": {cls: dict(rec)
+                           for cls, rec in self.tenant.items()},
+                "tenant_shedding": sorted(self._tenant_shedding),
+                "tenant_window_charge": {
+                    cls: [int(w), int(c)]
+                    for cls, (w, c) in self._tenant_window_charge.items()
+                },
             }
 
     def restore(self, state: Dict[str, Any]):
@@ -713,6 +902,16 @@ class OverloadController:
             self.backpressure_engaged = int(state["backpressure_engaged"])
             self.rung = int(state["rung"])
             self.rung_transitions = int(state["rung_transitions"])
+            # Pre-qserve checkpoints carry no tenant block (fresh state).
+            self.tenant = {cls: dict(rec)
+                           for cls, rec in state.get("tenant", {}).items()}
+            self._tenant_shedding = set(state.get("tenant_shedding", ()))
+            self._tenant_shed_this_window = set()
+            self._tenant_window_charge = {
+                cls: (int(w), int(c))
+                for cls, (w, c) in state.get(
+                    "tenant_window_charge", {}).items()
+            }
             self._apply_effects()
 
 
@@ -757,6 +956,30 @@ def on_slo_evaluation(ok: bool):
     ctrl = _controller
     if ctrl is not None:
         ctrl.on_slo_evaluation(ok)
+
+
+def admit_tenant_query(cls: str) -> bool:
+    """qserve's registration-admission hook: True (admit) when no
+    controller is installed — one global read + None check."""
+    ctrl = _controller
+    return True if ctrl is None else ctrl.admit_tenant_query(cls)
+
+
+def release_tenant_query(cls: str):
+    """qserve's unregistration hook — free when uninstalled."""
+    ctrl = _controller
+    if ctrl is not None:
+        ctrl.release_tenant_query(cls)
+
+
+def tenant_result_allowance(cls: str, n: int,
+                            window_start: Optional[int] = None) -> int:
+    """Result rows class ``cls`` may emit this window (``n`` = no
+    controller / no budget); ``window_start`` keys the retry-idempotent
+    charge."""
+    ctrl = _controller
+    return int(n) if ctrl is None else ctrl.tenant_result_allowance(
+        cls, n, window_start=window_start)
 
 
 def compaction_clamp() -> Optional[int]:

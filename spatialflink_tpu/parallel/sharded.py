@@ -295,6 +295,85 @@ def sharded_knn_multi(
     return fn(xy, valid, cell, flags_tables, oid, query_xy, radius)
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_registry_bucket(mesh, k, num_segments):
+    from spatialflink_tpu.ops.query_registry import (
+        RegistryBucketResult,
+        registry_bucket_query,
+    )
+
+    def local(xy_l, valid_l, cell_l, ft, oid_l, q, r, qok):
+        base = jax.lax.axis_index("data") * xy_l.shape[0]
+
+        def one(q_xy, ftab, rad, ok):
+            return registry_bucket_query(
+                xy_l, valid_l, cell_l, ftab, oid_l, q_xy, rad, ok,
+                k=k, num_segments=num_segments,
+                axis_name="data", index_base=base,
+            )
+
+        # Same query blocking as the single-device bucket kernel: vmap
+        # only ``block`` query lanes at a time under lax.map so peak
+        # memory is O(block × N_local).
+        q_total = q.shape[0]
+        block = next(b for b in (32, 16, 8, 4, 2, 1) if q_total % b == 0)
+
+        def blk(args):
+            q_b, f_b, r_b, ok_b = args
+            return jax.vmap(one)(q_b, f_b, r_b, ok_b)
+
+        res = jax.lax.map(
+            blk,
+            (
+                q.reshape(-1, block, 2),
+                ft.reshape(q_total // block, block, -1),
+                r.reshape(-1, block),
+                qok.reshape(-1, block),
+            ),
+        )
+        return RegistryBucketResult(
+            *[x.reshape((q_total,) + x.shape[2:]) for x in res]
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("data"), P("data"), P("data"), P(), P("data"), P(), P(), P(),
+        ),
+        out_specs=RegistryBucketResult(P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_registry_bucket(
+    mesh: Mesh,
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    flags_tables: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    radius: jnp.ndarray,
+    query_valid: jnp.ndarray,
+    k: int,
+    num_segments: int,
+):
+    """Sharded standing-query bucket (qserve): points over ``data``, the
+    query bucket (coords, per-query radii, flag tables, validity lanes)
+    replicated. Per-object minima pmin-reduce over ``data`` inside
+    ``ops/query_registry.py:registry_bucket_query`` — the same one-ICI-
+    all-reduce shape as ``sharded_knn_multi`` — and the ``within``
+    exactness counter is computed on the REDUCED table, so results
+    (top-k rows, counts, overflow) are bit-identical to the
+    single-device ``registry_bucket_kernel`` (CPU-mesh parity pinned in
+    tests/test_qserve.py)."""
+    fn = _cached_registry_bucket(mesh, k, num_segments)
+    return fn(xy, valid, cell, flags_tables, oid, query_xy, radius,
+              query_valid)
+
+
 def sharded_traj_stats(
     mesh: Mesh,
     xy: jnp.ndarray,

@@ -67,6 +67,12 @@ class SloSpec:
       path (circuit-open routing or post-failover). A spec naming these
       against a run with NO controller installed VIOLATES — silence
       must fail the gate, the ``eps_floor`` rule;
+    - ``tenant_budgets``: per-tenant-class QoS budgets (the qserve
+      scoping of the two overload budgets above) — ``{class:
+      {"shed_budget": N, "degraded_window_budget": M}}`` checked against
+      the controller's PER-CLASS counters (queries rejected + result
+      rows shed / class-degraded windows). A spec naming a class against
+      a run with NO controller installed violates — silence fails;
     - ``eval_interval_s``: pacing of the incremental evaluation (the
       per-window cost between evaluations is counter updates only).
     """
@@ -81,8 +87,20 @@ class SloSpec:
     failover_budget: Optional[int] = None
     shed_budget: Optional[int] = None
     degraded_window_budget: Optional[int] = None
+    tenant_budgets: Optional[Dict[str, Dict[str, int]]] = None
     eval_interval_s: float = 1.0
     warmup_windows: int = 8
+
+    #: Per-class budget keys ``tenant_budgets`` accepts (the strict-
+    #: parse rule applies inside the mapping too).
+    TENANT_BUDGET_KEYS = ("shed_budget", "degraded_window_budget")
+
+    def __post_init__(self):
+        # ONE validation home (overload.validate_budget_map): same
+        # map shape as OverloadPolicy.tenant_budgets, different keys.
+        overload.validate_budget_map(
+            self.tenant_budgets, self.TENANT_BUDGET_KEYS
+        )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SloSpec":
@@ -230,6 +248,25 @@ class SloEngine:
             check("degraded_window_budget", dw,
                   f"<= {int(sp.degraded_window_budget)}",
                   dw is not None and dw <= sp.degraded_window_budget)
+        if sp.tenant_budgets:
+            ctrl = overload.controller()
+            for cls, b in sorted(sp.tenant_budgets.items()):
+                sb = b.get("shed_budget")
+                if sb is not None:
+                    shed = (None if ctrl is None
+                            else ctrl.tenant_shed_total(cls))
+                    check(f"tenant_shed_budget:{cls}", shed,
+                          f"<= {int(sb)}",
+                          # No controller = the per-class budget is
+                          # unanswerable — silence fails (eps_floor rule).
+                          shed is not None and shed <= sb)
+                dwb = b.get("degraded_window_budget")
+                if dwb is not None:
+                    dw = (None if ctrl is None
+                          else ctrl.tenant_degraded_windows(cls))
+                    check(f"tenant_degraded_window_budget:{cls}", dw,
+                          f"<= {int(dwb)}",
+                          dw is not None and dw <= dwb)
         if sp.overflow_budget is not None:
             counts: List[int] = []
             _find_overflows(self.tel.snapshot(), counts)

@@ -13,7 +13,12 @@ line-record boundary) and dispatches on ``query.option``:
   (extensions) 3 = window kNN, 4 = realtime kNN, 5 = window join,
   6 = tStats, 7 = tAggregate, 8 = multi-query window kNN (one fused
   program answers the whole queryPoints set per window) — the operator
-  families the reference keeps in its commented-out cases.
+  families the reference keeps in its commented-out cases — and
+  9 = qserve, the multi-tenant standing-query serving layer
+  (spatialflink_tpu/qserve.py): the query set comes from ``SFT_QSERVE``
+  (queries + per-tenant-class budgets) or falls back to one range + one
+  kNN standing query per yml queryPoint; registration commands ride the
+  stream and intern into the operator's objID table (one intern home).
 """
 
 from __future__ import annotations
@@ -140,7 +145,7 @@ def run_job(params: Params, source: Iterable[Point], sink,
     spatialflink_tpu.driver.WindowedDataflowDriver) routes the windowed
     query options through the self-healing dataflow driver —
     auto-checkpoint + exactly-once egress + retry/failover; supported
-    for the driver-wired operators (options 1, 3, 5 and 6)."""
+    for the driver-wired operators (options 1, 3, 5, 6 and 9)."""
     grid = params.input_stream1.make_grid()
     q = params.query
     window_conf = QueryConfiguration(
@@ -179,11 +184,11 @@ def run_job(params: Params, source: Iterable[Point], sink,
         % max(window_conf.slide_step_ms, 1) == 0
     )
 
-    if driver is not None and option not in (1, 3, 5, 6):
+    if driver is not None and option not in (1, 3, 5, 6, 9):
         raise SystemExit(
             f"--checkpoint (the dataflow driver) supports query options "
-            f"1, 3, 5 and 6, not {option} — the remaining operators keep "
-            "their own loops until they are driver-wired"
+            f"1, 3, 5, 6 and 9, not {option} — the remaining operators "
+            "keep their own loops until they are driver-wired"
         )
 
     if option in (1, 2):
@@ -259,6 +264,64 @@ def run_job(params: Params, source: Iterable[Point], sink,
             for oid, (sp, tp, ratio) in sorted(res.stats.items()):
                 sink(f"{res.start},{res.end},{oid},{float(sp)!r},{tp},{float(ratio)!r}")
                 n += 1
+    elif option == 9:
+        import itertools
+
+        from spatialflink_tpu import overload as overload_mod
+        from spatialflink_tpu import qserve as qserve_mod
+
+        cfg = qserve_mod.config_from_env()
+        if cfg and cfg.get("queries"):
+            queries = qserve_mod.queries_from_config(cfg)
+        else:
+            # No SFT_QSERVE query set: one range + one kNN standing
+            # query per yml queryPoint, all under the default tenant.
+            queries = []
+            for i, p in enumerate(q_points):
+                queries.append(qserve_mod.StandingQuery(
+                    qid=f"range{i}", tenant="default", kind="range",
+                    x=p.x, y=p.y, radius=q.radius, k=64,
+                ))
+                queries.append(qserve_mod.StandingQuery(
+                    qid=f"knn{i}", tenant="default", kind="knn",
+                    x=p.x, y=p.y, radius=q.radius, k=q.k,
+                ))
+        budgets = (cfg or {}).get("tenant_budgets")
+        prev_ctrl = overload_mod.controller()
+        installed = False
+        if budgets:
+            ctrl = overload_mod.OverloadController(
+                overload_mod.OverloadPolicy(tenant_budgets=budgets)
+            )
+            if driver is not None:
+                driver.overload = ctrl
+            else:
+                overload_mod.install(ctrl)
+                installed = True
+        op = qserve_mod.QServeOperator(
+            window_conf, grid, mesh=mesh,
+            cap_max=int((cfg or {}).get("cap_max",
+                                        qserve_mod.QUERY_CAP_MAX)),
+        )
+        try:
+            # Registration commands ride the SAME stream (deterministic
+            # uids), so a --checkpoint resume replays them exactly; the
+            # registry's applied-uid set keeps the replay idempotent.
+            stream = itertools.chain(qserve_mod.boot_commands(queries),
+                                     source)
+            for res in op.run(stream, driver=driver):
+                for line in res.lines():
+                    sink(line)
+                    n += 1
+        finally:
+            # The non-driver install must not outlive the run: restore
+            # whatever controller was global before (the driver path
+            # does this itself — driver._installed_controller).
+            if installed:
+                if prev_ctrl is not None:
+                    overload_mod.install(prev_ctrl)
+                else:
+                    overload_mod.uninstall()
     elif option == 7:
         op = TAggregateQuery(
             window_conf, grid, aggregate=q.aggregate_function,
@@ -269,7 +332,7 @@ def run_job(params: Params, source: Iterable[Point], sink,
                 sink(f"{res.start},{res.end},{cell},{cnt},{lens}")
                 n += 1
     else:
-        raise SystemExit(f"Unrecognized query option {option}. Use 1-8.")
+        raise SystemExit(f"Unrecognized query option {option}. Use 1-9.")
     return n
 
 

@@ -207,6 +207,11 @@ class Telemetry:
         # shed/degradation/circuit counters ride every ledger-stream
         # checkpoint and survive a mid-overload crash.
         self.overload_provider = None
+        # Optional standing-query-registry callback installed by
+        # qserve.install(): snapshot() embeds it as ["qserve"], so
+        # registered/evicted/bucket-occupancy/recompile counters ride
+        # ledger-stream checkpoints like the overload block does.
+        self.qserve_provider = None
         self._lock = threading.RLock()
         self._reset_state()
 
@@ -1054,6 +1059,11 @@ class Telemetry:
         if self.overload_provider is not None:
             try:
                 out["overload"] = json_safe(self.overload_provider())  # sfcheck: ok=lock-discipline -- stream-flush checkpoints call this under Telemetry._lock by design; the provider contract (documented at overload.OverloadController._lock) forbids providers from taking telemetry's lock — overload queues transition emits for after release
+            except Exception:  # a broken provider must not break snapshots
+                pass
+        if self.qserve_provider is not None:
+            try:
+                out["qserve"] = json_safe(self.qserve_provider())  # sfcheck: ok=lock-discipline -- same provider contract as overload_provider above: the qserve registry is lock-free host state and only re-enters this RLock on the same thread (distinct_shapes)
             except Exception:  # a broken provider must not break snapshots
                 pass
         link = self.link_gauges()

@@ -11,12 +11,15 @@ flushes). The real-process SIGKILL analog (``abort`` kind,
 ``os._exit(137)``) is pinned by the slow subprocess test below and runs
 on every commit as tools/ci's chaos-smoke stage.
 
-Five pipeline harnesses cover the thirteen points:
+Six pipeline harnesses cover the fourteen points:
 
 - range-query driver pipeline (collection source): device.ship,
   device.dispatch, device.fetch, window.feed, driver.window, sink.write,
   and — with an admission controller attached — overload.admit;
 - SoA driver pipeline (chunked source → run_soa): soa.feed;
+- qserve standing-query pipeline (Points + registration commands →
+  QServeOperator, registry state checkpointed): qserve.register —
+  killed mid-registration-churn, resumed egress byte-identical;
 - Kafka driver pipeline (FakeBroker ingest, offsets checkpointed):
   kafka.fetch, kafka.leader;
 - tJoin pane-engine pipeline (bounded SoA chunks → run_soa_panes →
@@ -77,6 +80,9 @@ def _disarm():
     # ledger-seal contract) — clean it so later tests in the process
     # don't inherit a crashed leg's stale controller.
     overload.uninstall()
+    from spatialflink_tpu import qserve
+
+    qserve.uninstall()
 
 
 RETRY = RetryPolicy(max_retries=1, backoff_s=0.0)
@@ -256,6 +262,94 @@ def chaos_tjoin_panes(tmp_path, point, kind="raise", at=4):
 
 
 # ---------------------------------------------------------------------------
+# Harness 2c: qserve standing-query pipeline (Points + registration
+# commands on one stream → QServeOperator). The qserve.register point
+# fires inside QueryRegistry.apply — mid-registration-churn — and the
+# resumed run must re-apply the replayed commands exactly once (the
+# applied-uid set) and converge to byte-identical per-tenant egress.
+
+
+def run_qserve_leg(workdir, fault_plan=None):
+    from spatialflink_tpu import qserve
+
+    grid, conf, source, _ = _toy_pipeline()
+    op = qserve.QServeOperator(conf, grid)
+    sink = TransactionalFileSink(os.path.join(workdir, "egress.csv"))
+    driver = WindowedDataflowDriver(
+        checkpoint_path=os.path.join(workdir, "ckpt.bin"),
+        checkpoint_every=2, sink=sink, retry=RETRY, failover=False,
+    )
+
+    def mk(i, kind, x, y, r, k=5, tenant="t0"):
+        return qserve.QServeCommand(
+            timestamp=0, action="register", uid=f"c{i}",
+            query=qserve.StandingQuery(
+                qid=f"q{i}", tenant=tenant, kind=kind, x=x, y=y,
+                radius=r, k=k,
+            ),
+        )
+
+    def stream():
+        # Boot registrations, then data, then MID-STREAM churn: an
+        # unregister + two registers landing around the 6-8 s windows —
+        # after several checkpoints, so the crash legs resume mid-churn.
+        churn = [
+            qserve.QServeCommand(timestamp=6005, action="unregister",
+                                 uid="c10", qid="q1"),
+            qserve.QServeCommand(timestamp=7005, action="register",
+                                 uid="c11", query=qserve.StandingQuery(
+                                     qid="q11", tenant="t1", kind="knn",
+                                     x=3.0, y=3.0, radius=2.0, k=5)),
+            qserve.QServeCommand(timestamp=8005, action="register",
+                                 uid="c12", query=qserve.StandingQuery(
+                                     qid="q12", tenant="t1", kind="range",
+                                     x=5.0, y=5.0, radius=1.8, k=8)),
+        ]
+        boot = [mk(0, "range", 4.0, 4.0, 1.5),
+                mk(1, "knn", 2.0, 6.0, 2.5),
+                mk(2, "knn", 6.0, 2.0, 2.5, tenant="t1")]
+        pending = sorted(churn, key=lambda c: c.timestamp)
+        yield from boot
+        for ev in source():
+            while pending and pending[0].timestamp <= ev.timestamp:
+                yield pending.pop(0)
+            yield ev
+        yield from pending
+
+    if fault_plan:
+        faults.arm(fault_plan)
+    try:
+        for res in op.run(stream(), driver=driver):
+            for line in res.lines():
+                sink.stage(line)
+    finally:
+        faults.disarm()
+        qserve.uninstall()
+    return driver
+
+
+def chaos_qserve(tmp_path, point, kind="raise", at=7):
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    run_qserve_leg(str(clean))
+    want = (clean / "egress.csv").read_bytes()
+    assert want, "vacuous matrix entry: clean egress is empty"
+    with pytest.raises(InjectedFault):
+        # at=7: the 3 boot registrations hit twice (two sliding windows
+        # contain ts=0 — duplicate applies still count a hit), so hit 7
+        # is the FIRST mid-stream churn command (~6 s), after several
+        # checkpoints exist to resume from.
+        run_qserve_leg(str(chaos), fault_plan=[
+            {"point": point, "kind": kind, "at": at, "times": 10_000},
+        ])
+    drv = run_qserve_leg(str(chaos))  # resume mid-churn
+    assert drv.stats["resumed"] is True
+    assert (chaos / "egress.csv").read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
 # Harness 3: Kafka pipeline (FakeBroker ingest, offsets checkpointed)
 
 
@@ -427,6 +521,7 @@ MATRIX = {
     "source.stall": lambda tp: chaos_tjoin_panes(tp, "source.stall"),
     "pipeline.ship": lambda tp: chaos_pipeline(tp, "pipeline.ship"),
     "pipeline.fetch": lambda tp: chaos_pipeline(tp, "pipeline.fetch"),
+    "qserve.register": lambda tp: chaos_qserve(tp, "qserve.register"),
 }
 
 

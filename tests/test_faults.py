@@ -72,7 +72,7 @@ class TestPlanParsing:
             "window.feed", "soa.feed", "kafka.fetch", "kafka.leader",
             "sink.write", "driver.window",
             "overload.admit", "source.stall",
-            "pipeline.ship", "pipeline.fetch",
+            "pipeline.ship", "pipeline.fetch", "qserve.register",
         }
 
 

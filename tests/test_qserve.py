@@ -1,0 +1,951 @@
+"""qserve — multi-tenant continuous-query serving (ISSUE 13).
+
+Pins the subsystem's four contracts:
+
+- **parity**: the bucketed vmapped kernel is BIT-identical to per-query
+  sequential evaluation of the same serving program (and to the CPU
+  mesh counterpart ``sharded_registry_bucket``); vs the independently-
+  fused ``knn_points_fused`` operator program, winner sets/indices are
+  exact and distances agree to 1 ulp (the suite-wide differently-fused-
+  programs contract, same as run_multi's);
+- **recompile surface**: randomized register/unregister storms move a
+  bucket across occupancy rungs but compile at most ladder-many
+  signatures (the telemetry recompile detector is the guard — the
+  tests/test_compaction.py idiom);
+- **per-tenant QoS**: a firehose tenant class sheds ITSELF (admission +
+  result budgets, per-class counters, per-class SLO checks live and
+  post-hoc) and never moves the fleet's degradation rung;
+- **one intern home**: registration strings intern into the operator's
+  objID table — no second string table exists.
+
+The kill-mid-churn crash leg lives in tests/test_chaos_matrix.py
+(``qserve.register``); the 1024-query acceptance run is the slow test
+at the bottom.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spatialflink_tpu import overload, qserve, slo
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.models.objects import Point
+from spatialflink_tpu.operators.query_config import (
+    QueryConfiguration,
+    QueryType,
+)
+from spatialflink_tpu.telemetry import telemetry
+
+GRID = UniformGrid(10, 0.0, 10.0, 0.0, 10.0)
+CONF = QueryConfiguration(QueryType.WindowBased, window_size=2.0,
+                          slide_step=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slots():
+    yield
+    telemetry.disable()
+    overload.uninstall()
+    qserve.uninstall()
+
+
+def _mk_query(i, rng, kind=None, k=5, radius=None, tenant_class="default"):
+    return qserve.StandingQuery(
+        qid=f"q{i}", tenant=f"t{i % 3}",
+        kind=kind or ("knn" if i % 2 else "range"),
+        x=float(rng.uniform(1, 9)), y=float(rng.uniform(1, 9)),
+        radius=float(radius if radius is not None
+                     else rng.uniform(0.5, 2.5)),
+        k=k, tenant_class=tenant_class,
+    )
+
+
+def _point_stream(rng, n=120, tmax_ms=12_000):
+    for i in range(n):
+        yield Point(obj_id=f"o{i % 13}", timestamp=(tmax_ms * i) // n,
+                    x=float(rng.uniform(0, 10)),
+                    y=float(rng.uniform(0, 10)))
+
+
+def _register_cmds(queries, ts=0, prefix="c"):
+    return [
+        qserve.QServeCommand(timestamp=ts, action="register",
+                             uid=f"{prefix}{i}", query=q)
+        for i, q in enumerate(queries)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+
+
+def _bucket_inputs(rng, n=256, n_obj=40):
+    xy = rng.uniform(0, 10, (n, 2))
+    oid = rng.integers(0, n_obj, n).astype(np.int32)
+    cell = GRID.assign_cells_np(xy)
+    valid = np.ones(n, bool)
+    return xy, oid, cell, valid
+
+
+def test_bucket_kernel_bit_matches_sequential_evaluation(rng):
+    """The acceptance pin: the bucketed vmapped program's row for query
+    i is BIT-identical to evaluating the same serving program for that
+    query alone (registry_bucket_query jitted per query)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.query_registry import (
+        registry_bucket_kernel,
+        registry_bucket_query,
+    )
+
+    xy, oid, cell, valid = _bucket_inputs(rng)
+    qs = [_mk_query(i, rng) for i in range(6)]
+    cap = 8
+    qxy, radius, qvalid, tables = qserve.bucket_host_arrays(GRID, qs, cap)
+    res = jax.jit(
+        registry_bucket_kernel,
+        static_argnames=("k", "num_segments", "query_block"),
+    )(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        jnp.asarray(tables), jnp.asarray(oid), jnp.asarray(qxy),
+        jnp.asarray(radius), jnp.asarray(qvalid),
+        k=8, num_segments=64, query_block=8,
+    )
+    single = jax.jit(
+        registry_bucket_query, static_argnames=("k", "num_segments")
+    )
+    for i in range(len(qs)):
+        d, seg, idx, nv, within = single(
+            jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+            jnp.asarray(tables[i]), jnp.asarray(oid),
+            jnp.asarray(qxy[i]), jnp.asarray(radius[i]),
+            jnp.asarray(qvalid[i]), k=8, num_segments=64,
+        )
+        np.testing.assert_array_equal(np.asarray(res.dist[i]),
+                                      np.asarray(d))
+        np.testing.assert_array_equal(np.asarray(res.segment[i]),
+                                      np.asarray(seg))
+        np.testing.assert_array_equal(np.asarray(res.index[i]),
+                                      np.asarray(idx))
+        assert int(res.num_valid[i]) == int(nv)
+        assert int(res.within[i]) == int(within)
+    # padded rung lanes are empty (padding never changes results)
+    for i in range(len(qs), cap):
+        assert int(res.num_valid[i]) == 0
+        assert int(res.within[i]) == 0
+        assert np.all(np.asarray(res.segment[i]) == -1)
+
+
+def test_bucket_kernel_vs_operator_kernel_fusion_contract(rng):
+    """vs knn_points_fused — a DIFFERENTLY-FUSED program (no `within`
+    consumer): winner sets, indices and counts exact, distances to
+    1 ulp (rtol 1e-12 — the run_multi/mesh suite-wide contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.operators.base import flags_for_queries
+    from spatialflink_tpu.ops.knn import knn_points_fused
+    from spatialflink_tpu.ops.query_registry import registry_bucket_kernel
+
+    xy, oid, cell, valid = _bucket_inputs(rng)
+    qs = [_mk_query(i, rng) for i in range(5)]
+    qxy, radius, qvalid, tables = qserve.bucket_host_arrays(GRID, qs, 8)
+    res = jax.jit(
+        registry_bucket_kernel,
+        static_argnames=("k", "num_segments", "query_block"),
+    )(
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        jnp.asarray(tables), jnp.asarray(oid), jnp.asarray(qxy),
+        jnp.asarray(radius), jnp.asarray(qvalid),
+        k=8, num_segments=64, query_block=8,
+    )
+    for i, q in enumerate(qs):
+        ft = flags_for_queries(GRID, q.radius, [Point(x=q.x, y=q.y)])
+        ref = knn_points_fused(
+            jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+            jnp.asarray(ft), jnp.asarray(oid),
+            jnp.asarray([q.x, q.y]), q.radius, k=8, num_segments=64,
+        )
+        np.testing.assert_array_equal(np.asarray(res.segment[i]),
+                                      np.asarray(ref.segment))
+        np.testing.assert_array_equal(np.asarray(res.index[i]),
+                                      np.asarray(ref.index))
+        np.testing.assert_allclose(np.asarray(res.dist[i]),
+                                   np.asarray(ref.dist), rtol=1e-12)
+        assert int(res.num_valid[i]) == int(ref.num_valid)
+
+
+def test_sharded_registry_bucket_matches_single_device(rng):
+    """Mesh parity (the mesh-parity pass's name-referenced test):
+    sharded_registry_bucket on the 8-device CPU mesh is bit-identical to
+    registry_bucket_kernel — every field, `within` included."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.parallel.mesh import make_mesh
+    from spatialflink_tpu.parallel.sharded import sharded_registry_bucket
+    from spatialflink_tpu.ops.query_registry import registry_bucket_kernel
+
+    xy, oid, cell, valid = _bucket_inputs(rng)
+    qs = [_mk_query(i, rng) for i in range(6)]
+    qxy, radius, qvalid, tables = qserve.bucket_host_arrays(GRID, qs, 8)
+    args = (
+        jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell),
+        jnp.asarray(tables), jnp.asarray(oid), jnp.asarray(qxy),
+        jnp.asarray(radius), jnp.asarray(qvalid),
+    )
+    res = jax.jit(
+        registry_bucket_kernel,
+        static_argnames=("k", "num_segments", "query_block"),
+    )(*args, k=8, num_segments=64, query_block=8)
+    mesh = make_mesh((8,), ("data",))
+    sres = sharded_registry_bucket(mesh, *args, k=8, num_segments=64)
+    for field in ("dist", "segment", "index", "num_valid", "within"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, field)),
+            np.asarray(getattr(sres, field)), err_msg=field,
+        )
+
+
+def test_range_bucket_overflow_counter():
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.query_registry import range_bucket_overflow
+
+    within = jnp.asarray([3, 8, 12, 0])
+    assert int(range_bucket_overflow(within, 8)) == 4
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+
+
+def test_bucket_key_and_rungs(rng):
+    q = _mk_query(0, rng, kind="knn", k=5, radius=0.004)
+    assert qserve.query_rung(q) == 8
+    q2 = _mk_query(1, rng, kind="knn", k=17, radius=0.004)
+    assert qserve.query_rung(q2) == 32
+    assert qserve.bucket_key(q)[0] == "knn"
+    # radius classes: power-of-two bands above the base
+    assert qserve.radius_class(0.0005) == 0
+    assert qserve.radius_class(0.001) == 0
+    assert qserve.radius_class(0.0021) > qserve.radius_class(0.001)
+
+
+def test_command_application_is_exactly_once(rng):
+    """Duplicate uids (sliding-window refires, crash/retry replays) are
+    no-ops — the byte-identical-resume contract's foundation."""
+    from spatialflink_tpu.utils.interning import Interner
+
+    reg = qserve.QueryRegistry(GRID, Interner())
+    q = _mk_query(0, rng)
+    cmd = qserve.QServeCommand(timestamp=0, action="register", uid="u0",
+                               query=q)
+    assert reg.apply(cmd) is True
+    assert reg.apply(cmd) is False  # replay: no-op
+    assert len(reg) == 1 and reg.registered_total == 1
+    un = qserve.QServeCommand(timestamp=1, action="unregister", uid="u1",
+                              qid=q.qid)
+    assert reg.apply(un) is True
+    assert reg.apply(un) is False
+    assert len(reg) == 0 and reg.unregistered_total == 1
+
+
+def test_registry_state_round_trip(rng):
+    from spatialflink_tpu.utils.interning import Interner
+
+    reg = qserve.QueryRegistry(GRID, Interner())
+    for i, cmd in enumerate(_register_cmds(
+            [_mk_query(i, rng) for i in range(5)])):
+        reg.apply(cmd)
+    reg.apply(qserve.QServeCommand(timestamp=9, action="unregister",
+                                   uid="u", qid="q2"))
+    state = reg.state()
+    reg2 = qserve.QueryRegistry(GRID, Interner())
+    reg2.restore(json.loads(json.dumps(state)))  # survives JSON round trip
+    assert sorted(reg2._queries) == sorted(reg._queries)
+    assert reg2._applied == reg._applied
+    assert reg2.unregistered_total == 1
+    # flag tables are derived data — rebuilt identically
+    for qid in reg2._queries:
+        np.testing.assert_array_equal(reg2.flags(qid), reg.flags(qid))
+
+
+def test_one_intern_home(rng):
+    """Registration strings intern into the OPERATOR's objID table —
+    one intern home, no second string table anywhere in qserve."""
+    import inspect
+
+    op = qserve.QServeOperator(CONF, GRID)
+    assert op.qserve_registry.interner is op.interner
+    before = len(op.interner)
+    op.qserve_registry.apply(qserve.QServeCommand(
+        timestamp=0, action="register", uid="u0",
+        query=_mk_query(0, rng),
+    ))
+    assert len(op.interner) == before + 2  # tenant + qid interned there
+    assert op.interner._to_int["q0"] is not None
+    # the module never constructs its own Interner
+    src = inspect.getsource(qserve)
+    assert "Interner(" not in src
+
+
+# ---------------------------------------------------------------------------
+# churn vs recompile surface (the ≤K-stable-signatures contract)
+
+
+def test_registration_storm_keeps_signatures_on_the_ladder(rng):
+    """Randomized register/unregister storms sweep a bucket across every
+    occupancy rung; the bucket kernel must compile at most ladder-many
+    signatures (telemetry recompile detector — the
+    tests/test_compaction.py idiom), and re-visiting an occupancy adds
+    none."""
+    from spatialflink_tpu.ops.compaction import capacity_ladder
+
+    cap_max = 32  # ladder (8, 16, 32)
+    op = qserve.QServeOperator(CONF, GRID, cap_max=cap_max)
+    reg = op.qserve_registry
+    # Same kind/k/radius-class → ONE bucket; occupancy is the only mover.
+    pool = [
+        qserve.StandingQuery(
+            qid=f"q{i}", tenant=f"t{i % 5}", kind="knn",
+            x=float(rng.uniform(1, 9)), y=float(rng.uniform(1, 9)),
+            radius=1.5, k=5,
+        )
+        for i in range(cap_max)
+    ]
+    # Pre-intern every qid/tenant BEFORE enabling telemetry so the
+    # interner bucket (num_segments) is stable across the storm — the
+    # bucket rung must be the only varying static.
+    for q in pool:
+        reg.interner.intern(q.tenant)
+        reg.interner.intern(q.qid)
+    for i in range(130):
+        reg.interner.intern(f"o{i % 13}")
+
+    def stream(phase, live_target):
+        # (re)register/unregister down to live_target, then some data
+        cmds = []
+        live = set(reg._queries)
+        want = {q.qid for q in pool[:live_target]}
+        seq = 0
+        for qid in sorted(live - want):
+            cmds.append(qserve.QServeCommand(
+                timestamp=0, action="unregister",
+                uid=f"p{phase}u{seq}", qid=qid))
+            seq += 1
+        for q in pool:
+            if q.qid in want - live:
+                cmds.append(qserve.QServeCommand(
+                    timestamp=0, action="register",
+                    uid=f"p{phase}r{seq}", query=q))
+                seq += 1
+        yield from cmds
+        yield from _point_stream(rng, n=30, tmax_ms=4000)
+
+    telemetry.enable()
+    try:
+        # occupancies 4 → 12 → 30 → 4 (rungs 8, 16, 32, 8 — the revisit
+        # is the stability probe)
+        for phase, target in enumerate((4, 12, 30, 4)):
+            for _ in op.run(stream(phase, target)):
+                pass
+        sigs = telemetry.distinct_shapes("registry_bucket_kernel")
+        assert 1 <= sigs <= len(capacity_ladder(cap_max)), sigs
+        buckets = telemetry.compaction_buckets("qserve_bucket")
+        assert set(buckets) <= set(capacity_ladder(cap_max))
+        snap = telemetry.snapshot()
+        assert snap["qserve"]["recompiles"] == sigs
+    finally:
+        telemetry.disable()
+        qserve.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant QoS
+
+
+def _run_two_class_pipeline(rng, policy):
+    ctrl = overload.install(overload.OverloadController(policy))
+    op = qserve.QServeOperator(CONF, GRID)
+    queries = (
+        # firehose: fat-radius queries, lots of results
+        [_mk_query(i, rng, kind="range", k=8, radius=3.0,
+                   tenant_class="firehose") for i in range(4)]
+        # modest: one tight query
+        + [_mk_query(9, rng, kind="knn", k=3, radius=1.0,
+                     tenant_class="modest")]
+    )
+
+    def stream():
+        yield from _register_cmds(queries)
+        yield from _point_stream(rng, n=150)
+
+    rows = []
+    for res in op.run(stream()):
+        rows.extend(res.rows)
+    return ctrl, rows
+
+
+def test_firehose_tenant_degrades_itself_not_the_fleet(rng):
+    policy = overload.OverloadPolicy(
+        tenant_budgets={
+            "firehose": {"max_queries": 3, "max_results_per_window": 5},
+        },
+        # a global ladder exists — tenant sheds must NOT step it
+        ladder=({"action": "clamp_compaction", "cap": 0},),
+        degrade_cooldown=1,
+    )
+    ctrl, rows = _run_two_class_pipeline(rng, policy)
+    snap = ctrl.snapshot()
+    t = snap["tenants"]
+    # the 4th firehose registration was rejected (admission budget)
+    assert t["firehose"]["queries_live"] == 3
+    assert t["firehose"]["queries_shed"] >= 1
+    # result rows truncated per window for the firehose class only
+    assert t["firehose"]["results_shed"] > 0
+    assert t["firehose"]["degraded_windows"] > 0
+    assert t["modest"]["results_shed"] == 0
+    assert t["modest"]["queries_shed"] == 0
+    # per-window firehose rows respect the budget
+    per_window = {}
+    for cls, _tenant, _qid, _obj, _d in rows:
+        per_window[cls] = per_window.get(cls, 0) + 1
+    assert any(cls == "modest" for cls, *_ in rows)
+    # THE scoping pin: the global degradation rung never moved
+    assert snap["rung"] == 0 and snap["rung_transitions"] == 0
+    assert ctrl.tenant_shed_total("firehose") > 0
+    assert ctrl.tenant_shed_total("modest") == 0
+
+
+def test_tenant_result_budget_bounds_every_window(rng):
+    policy = overload.OverloadPolicy(
+        tenant_budgets={"firehose": {"max_results_per_window": 5}},
+    )
+    ctrl = overload.install(overload.OverloadController(policy))
+    op = qserve.QServeOperator(CONF, GRID)
+    queries = [_mk_query(i, rng, kind="range", k=8, radius=3.0,
+                         tenant_class="firehose") for i in range(4)]
+
+    def stream():
+        yield from _register_cmds(queries)
+        yield from _point_stream(rng, n=150)
+
+    for res in op.run(stream()):
+        n_fire = sum(1 for cls, *_ in res.rows if cls == "firehose")
+        assert n_fire <= 5
+    assert ctrl.tenant_shed_total("firehose") > 0
+
+
+def test_tenant_budgets_strict_parse():
+    with pytest.raises(ValueError, match="unknown keys"):
+        overload.OverloadPolicy(tenant_budgets={"a": {"max_queriez": 1}})
+    with pytest.raises(ValueError, match="non-negative int"):
+        overload.OverloadPolicy(tenant_budgets={"a": {"max_queries": -1}})
+    # round trip through the strict dict parse
+    p = overload.OverloadPolicy(tenant_budgets={"a": {"max_queries": 2}})
+    p2 = overload.OverloadPolicy.from_dict(p.to_dict())
+    assert p2.tenant_budgets == {"a": {"max_queries": 2}}
+
+
+def test_tenant_state_checkpoint_round_trip(rng):
+    policy = overload.OverloadPolicy(
+        tenant_budgets={"firehose": {"max_queries": 1}},
+    )
+    ctrl = overload.OverloadController(policy)
+    assert ctrl.admit_tenant_query("firehose") is True
+    assert ctrl.admit_tenant_query("firehose") is False  # shed
+    state = ctrl.state()
+    ctrl2 = overload.OverloadController(policy)
+    ctrl2.restore(state)
+    assert ctrl2.tenant_shed_total("firehose") == 1
+    assert ctrl2.snapshot()["tenants"]["firehose"]["queries_live"] == 1
+
+
+def test_tenant_slo_budgets_live_engine(rng):
+    """SloSpec.tenant_budgets: per-class checks against the controller's
+    counters; violations are per class; no controller = silence fails."""
+    policy = overload.OverloadPolicy(
+        tenant_budgets={"firehose": {"max_results_per_window": 2}},
+    )
+    ctrl = overload.install(overload.OverloadController(policy))
+    spec = slo.SloSpec(
+        name="t", eval_interval_s=0.0,
+        tenant_budgets={
+            "firehose": {"shed_budget": 0, "degraded_window_budget": 0},
+            "modest": {"shed_budget": 10},
+        },
+    )
+    engine = slo.install(slo.SloEngine(spec))
+    try:
+        ctrl.tenant_result_allowance("firehose", 7)  # sheds 5
+        rows = engine.evaluate()
+        by = {r["check"]: r for r in rows}
+        assert by["tenant_shed_budget:firehose"]["ok"] is False
+        assert by["tenant_degraded_window_budget:firehose"]["ok"] is False
+        assert by["tenant_shed_budget:modest"]["ok"] is True
+        assert any(v["check"] == "tenant_shed_budget:firehose"
+                   for v in engine.violations)
+    finally:
+        slo.uninstall()
+    # silence fails: same spec, no controller installed
+    overload.uninstall()
+    engine2 = slo.SloEngine(spec)
+    rows = engine2.evaluate()
+    by = {r["check"]: r for r in rows}
+    assert by["tenant_shed_budget:firehose"]["ok"] is False
+    assert by["tenant_shed_budget:modest"]["ok"] is False
+
+
+def test_range_result_overflow_counts_at_query_cap(rng):
+    """A range query's results truncate at ITS k (≤ the rung) — the
+    overflow counter must see truncation at k, not only at the rung
+    (code-review repro: k=2 on rung 8 with >2 in-radius objects used to
+    report 0 overflow while dropping results)."""
+    op = qserve.QServeOperator(CONF, GRID)
+    q = qserve.StandingQuery(qid="r", tenant="t", kind="range",
+                             x=5.0, y=5.0, radius=4.0, k=2)
+
+    def stream():
+        yield qserve.QServeCommand(timestamp=0, action="register",
+                                   uid="u", query=q)
+        yield from _point_stream(rng, n=80, tmax_ms=4000)
+
+    rows_per_window = []
+    for res in op.run(stream()):
+        rows_per_window.append(len(res.rows))
+    assert max(rows_per_window) == 2  # truncated at the query's cap
+    assert op.qserve_registry.range_result_overflow > 0
+
+
+def test_record_range_overflow_is_retry_idempotent():
+    """Re-charging the SAME window (a driver retry re-running process)
+    replaces the previous charge — the counter never double-counts."""
+    from spatialflink_tpu.utils.interning import Interner
+
+    reg = qserve.QueryRegistry(GRID, Interner())
+    reg.record_range_overflow(100, 5)
+    reg.record_range_overflow(100, 5)  # retry of window 100
+    assert reg.range_result_overflow == 5
+    reg.record_range_overflow(200, 3)
+    assert reg.range_result_overflow == 8
+    # the marker survives a checkpoint round trip
+    reg2 = qserve.QueryRegistry(GRID, Interner())
+    reg2.restore(json.loads(json.dumps(reg.state())))
+    reg2.record_range_overflow(200, 3)
+    assert reg2.range_result_overflow == 8
+
+
+def test_commands_are_never_shed_by_admission(rng):
+    """Registration commands are CONTROL PLANE: the overload admission
+    gate measures them as zero load and must never shed one — a shed
+    command would silently diverge the registry from the command stream
+    for the rest of the run (code-review repro)."""
+    policy = overload.OverloadPolicy(max_buffered_events=1,
+                                     lag_shed_ceiling_ms=1,
+                                     lag_recover_ms=0)
+    ctrl = overload.OverloadController(policy)
+    cmd = qserve.QServeCommand(timestamp=0, action="register", uid="u",
+                               query=_mk_query(0, rng))
+    # force shed mode, then feed a late-tier command: still admitted
+    ctrl.on_window_fired(n_events=1, lag_ms=10_000, end=1000)
+    assert ctrl._shedding is True
+    ctrl._max_ts = 5000
+    assert ctrl.admit_item(cmd, pausable=False) is True
+    assert ctrl.shed_total == 0
+
+
+def test_allowed_lateness_is_rejected():
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=2.0,
+                              slide_step=1.0, allowed_lateness=1.0)
+    op = qserve.QServeOperator(conf, GRID)
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        next(iter(op.run(iter([]))))
+
+
+def test_tenant_result_charge_is_retry_idempotent():
+    """Re-charging the same (class, window) — a driver retry re-running
+    process() — replaces the previous charge (the record_range_overflow
+    contract applied to the tenant counters)."""
+    policy = overload.OverloadPolicy(
+        tenant_budgets={"a": {"max_results_per_window": 2}},
+    )
+    ctrl = overload.OverloadController(policy)
+    assert ctrl.tenant_result_allowance("a", 7, window_start=100) == 2
+    assert ctrl.tenant_result_allowance("a", 7, window_start=100) == 2
+    rec = ctrl.snapshot()["tenants"]["a"]
+    assert rec["results_shed"] == 5 and rec["degraded_windows"] == 1
+    assert ctrl.tenant_result_allowance("a", 4, window_start=200) == 2
+    rec = ctrl.snapshot()["tenants"]["a"]
+    assert rec["results_shed"] == 7 and rec["degraded_windows"] == 2
+    # the marker survives a checkpoint round trip
+    ctrl2 = overload.OverloadController(policy)
+    ctrl2.restore(ctrl.state())
+    assert ctrl2.tenant_result_allowance("a", 4, window_start=200) == 2
+    assert ctrl2.snapshot()["tenants"]["a"]["results_shed"] == 7
+
+
+def test_applied_uid_set_prunes_behind_the_watermark(rng):
+    """The exactly-once uid set keeps only uids a refire/resume can
+    still re-present; older ones prune so checkpoints don't grow with
+    lifetime command count."""
+    from spatialflink_tpu.utils.interning import Interner
+
+    reg = qserve.QueryRegistry(GRID, Interner())
+    for i in range(6):
+        reg.apply(qserve.QServeCommand(
+            timestamp=i * 1000, action="register", uid=f"u{i}",
+            query=_mk_query(i, rng)))
+    assert len(reg._applied) == 6
+    reg.prune_applied(watermark_ts=10_000, horizon_ms=3_000)
+    # cut = 7000: uids with ts < 7000 are gone, later ones kept
+    assert set(reg._applied) == set()
+    reg.apply(qserve.QServeCommand(
+        timestamp=12_000, action="register", uid="u9",
+        query=_mk_query(9, rng)))
+    reg.prune_applied(watermark_ts=12_500, horizon_ms=3_000)
+    assert set(reg._applied) == {"u9"}
+    # within the horizon a duplicate is still a no-op
+    assert reg.apply(qserve.QServeCommand(
+        timestamp=12_000, action="register", uid="u9",
+        query=_mk_query(9, rng))) is False
+
+
+def test_dead_bucket_device_arrays_are_evicted(rng):
+    """Churn that empties a bucket must drop its cached device arrays —
+    dead buckets must not pin device memory for the rest of the run."""
+    op = qserve.QServeOperator(CONF, GRID)
+    q = _mk_query(0, rng, kind="knn", k=5, radius=1.5)
+
+    def stream():
+        yield qserve.QServeCommand(timestamp=0, action="register",
+                                   uid="r0", query=q)
+        yield from _point_stream(rng, n=40, tmax_ms=4000)
+        yield qserve.QServeCommand(timestamp=5000, action="unregister",
+                                   uid="u0", qid=q.qid)
+        yield from (Point(obj_id=f"o{i}", timestamp=5000 + i * 100,
+                          x=5.0, y=5.0) for i in range(40))
+
+    for _ in op.run(stream()):
+        pass
+    assert op._bucket_dev == {}  # the emptied bucket was evicted
+
+
+def test_tenant_slo_spec_strict_parse():
+    with pytest.raises(ValueError, match="unknown keys"):
+        slo.SloSpec(tenant_budgets={"a": {"shed_budgett": 1}})
+    with pytest.raises(ValueError, match="non-negative int"):
+        slo.SloSpec(tenant_budgets={"a": {"shed_budget": "lots"}})
+    with pytest.raises(ValueError, match="non-negative int"):
+        slo.SloSpec(tenant_budgets={"a": {"shed_budget": -1}})
+    # twin field parity rides test_slo.py's cross-pin; spot-check here
+    from tools.sfprof import slo as slo_tool
+
+    assert "tenant_budgets" in slo_tool.SPEC_KEYS
+
+
+def test_tenant_slo_posthoc_twin(tmp_path):
+    """tools/sfprof/slo.py mirrors the live per-class checks against a
+    ledger's snapshot.overload.tenants block — including the
+    silence-fails rule for a ledger with no overload block."""
+    from tools.sfprof import slo as slo_tool
+
+    spec = {
+        "tenant_budgets": {
+            "firehose": {"shed_budget": 3,
+                         "degraded_window_budget": 0},
+            "unseen": {"shed_budget": 0},
+        },
+    }
+    doc = {
+        "snapshot": {"overload": {
+            "shed_total": 0,
+            "tenants": {
+                "firehose": {"queries_live": 2, "queries_shed": 2,
+                             "results_shed": 4, "degraded_windows": 1},
+            },
+        }},
+    }
+    rows = {r[0]: r for r in slo_tool.evaluate(spec, doc)}
+    name = "slo:tenant_shed_budget:firehose"
+    assert rows[name][1] == 6 and rows[name][3] is False
+    assert rows["slo:tenant_degraded_window_budget:firehose"][3] is False
+    # unseen class in a PRESENT overload block reads as 0 — ok
+    assert rows["slo:tenant_shed_budget:unseen"][3] is True
+    # no overload block at all: silence fails
+    rows2 = {r[0]: r for r in slo_tool.evaluate(spec, {"snapshot": {}})}
+    assert rows2[name][3] is False
+    # spec with tenant_budgets loads through the strict parser
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    assert slo_tool.load_spec(str(p))["tenant_budgets"] == \
+        spec["tenant_budgets"]
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+
+
+def test_snapshot_qserve_block_and_events(rng):
+    telemetry.enable()
+    op = qserve.QServeOperator(CONF, GRID)
+    try:
+        def stream():
+            yield from _register_cmds([_mk_query(i, rng)
+                                       for i in range(4)])
+            yield from _point_stream(rng, n=60, tmax_ms=6000)
+
+        for _ in op.run(stream()):
+            pass
+        snap = telemetry.snapshot()
+        qs = snap["qserve"]
+        assert qs["registered"] == 4 and qs["registered_total"] == 4
+        assert qs["buckets"] and all(
+            b["capacity"] >= b["live"] for b in qs["buckets"].values()
+        )
+        assert qs["recompiles"] >= 1
+        names = {e["name"] for e in telemetry.events}
+        assert "qserve_registered" in names
+        assert any(n.startswith("qserve_rung:") for n in names)
+    finally:
+        telemetry.disable()
+        qserve.uninstall()
+
+
+def test_sfprof_health_and_report_print_tenant_qos(tmp_path, capsys):
+    """health/report: per-tenant-class QoS lines next to the overload
+    notes, with --json coverage (notes.tenants / notes.qserve)."""
+    import time
+
+    from tools.sfprof import cli as sfprof_cli
+
+    doc = {
+        "ledger_version": 1,
+        "created_unix": time.time(),
+        "env": {"python": "3", "jax": "0", "backend": "cpu",
+                "device_count": 1, "devices": ["cpu:0"], "x64": True,
+                "pid": 1, "argv0": "t"},
+        "snapshot": {
+            "compiles": 1, "bytes_h2d": 0, "bytes_d2h": 0,
+            "window_latency_p50_ms": None, "window_latency_p95_ms": None,
+            "max_watermark_lag_ms": 0, "watermark_lag_p99_ms": None,
+            "late_dropped": 0, "h2d_transfers": 0, "d2h_transfers": 0,
+            "events": 0, "dropped_events": 0, "kernels": {},
+            "compaction": {}, "driver": {"retries": 0, "failovers": 0},
+            "overload": {
+                "version": 1, "shed": {}, "shed_total": 0,
+                "degraded_windows": 0, "backpressure_engaged": 0,
+                "shedding": False, "rung": 0, "ladder_depth": 0,
+                "rung_transitions": 0,
+                "tenants": {"firehose": {
+                    "queries_live": 3, "queries_shed": 1,
+                    "results_shed": 12, "degraded_windows": 2,
+                }},
+            },
+            "qserve": {
+                "version": 1, "registered": 4, "registered_total": 5,
+                "unregistered_total": 1, "evicted_total": 1,
+                "range_result_overflow": 0,
+                "buckets": {"knn_k8_rc11": {"live": 4, "capacity": 8}},
+                "recompiles": 2,
+            },
+        },
+        "kernels": [],
+        "events": [],
+        "bench": {"points_per_sec": 1.0},
+    }
+    path = tmp_path / "ledger.json"
+    path.write_text(json.dumps(doc))
+    rc = sfprof_cli.main(["health", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tenant QoS [firehose]" in out
+    assert "results_shed=12" in out
+    assert "note qserve: registered=4" in out
+    rc = sfprof_cli.main(["health", str(path), "--json"])
+    notes = json.loads(capsys.readouterr().out)["notes"]
+    assert notes["tenants"]["firehose"]["results_shed"] == 12
+    assert notes["qserve"]["registered"] == 4
+    rc = sfprof_cli.main(["report", str(path)])
+    out = capsys.readouterr().out
+    assert "per-tenant-class QoS" in out
+    assert "qserve registry: 4 standing queries" in out
+
+
+# ---------------------------------------------------------------------------
+# streaming_job + SFT_QSERVE config
+
+
+def test_config_from_env_strict(monkeypatch, tmp_path):
+    monkeypatch.delenv("SFT_QSERVE", raising=False)
+    assert qserve.config_from_env() is None
+    monkeypatch.setenv("SFT_QSERVE", json.dumps({
+        "queries": [{"qid": "a", "tenant": "t", "kind": "knn",
+                     "x": 1.0, "y": 2.0, "radius": 0.5, "k": 3}],
+        "tenant_budgets": {"default": {"max_queries": 10}},
+    }))
+    cfg = qserve.config_from_env()
+    qs = qserve.queries_from_config(cfg)
+    assert qs[0].qid == "a" and qs[0].k == 3
+    monkeypatch.setenv("SFT_QSERVE", json.dumps({"nope": 1}))
+    with pytest.raises(ValueError, match="unknown SFT_QSERVE keys"):
+        qserve.config_from_env()
+    # file-path form (the SFT_FAULT_PLAN convention)
+    p = tmp_path / "q.json"
+    p.write_text(json.dumps({"cap_max": 64}))
+    monkeypatch.setenv("SFT_QSERVE", str(p))
+    assert qserve.config_from_env() == {"cap_max": 64}
+
+
+def test_streaming_job_option9_serves_and_checkpoints(tmp_path,
+                                                      monkeypatch):
+    """Option 9 end to end with --checkpoint: the run completes with
+    per-tenant egress, and re-running against the completed checkpoint
+    is an exactly-once no-op (byte-identical output). Kill-mid-churn
+    equality is the chaos matrix's qserve.register leg — `--max-records`
+    ends the SOURCE (flushing open windows), which is deliberately not
+    the same thing as a crash."""
+    from spatialflink_tpu import streaming_job
+
+    rng = np.random.default_rng(5)
+    csv = tmp_path / "pts.csv"
+    lines = []
+    for i in range(90):
+        lines.append(f"o{i % 7},{i * 100},"
+                     f"{rng.uniform(0.5, 9.5):.4f},"
+                     f"{rng.uniform(0.5, 9.5):.4f}")
+    csv.write_text("\n".join(lines) + "\n")
+    yml = tmp_path / "conf.yml"
+    yml.write_text(
+        """
+inputStream1:
+  topicName: t
+  format: CSV
+  csvTsvSchemaAttr: [0, 1, 2, 3]
+  gridBBox: [0.0, 0.0, 10.0, 10.0]
+  numGridCells: 10
+  delimiter: ","
+query:
+  option: 9
+  radius: 1.5
+  k: 4
+  queryPoints:
+    - [4.0, 4.0]
+window:
+  type: "TIME"
+  interval: 2
+  step: 1
+"""
+    )
+    monkeypatch.delenv("SFT_QSERVE", raising=False)
+
+    out = tmp_path / "served.csv"
+    ck = tmp_path / "ck.bin"
+    rc = streaming_job.main([
+        "--config", str(yml), "--source", f"csv:{csv}",
+        "--output", str(out), "--checkpoint", str(ck),
+        "--checkpoint-every", "2",
+    ])
+    assert rc == 0
+    want = out.read_bytes()
+    assert want
+    # the default query set serves both kinds under the default tenant
+    first = want.decode().splitlines()[0].split(",")
+    assert first[0] == "default" and first[1] in ("range0", "knn0")
+    # resume against the COMPLETED checkpoint: exactly-once no-op
+    qserve.uninstall()
+    rc = streaming_job.main([
+        "--config", str(yml), "--source", f"csv:{csv}",
+        "--output", str(out), "--checkpoint", str(ck),
+        "--checkpoint-every", "2",
+    ])
+    assert rc == 0
+    assert out.read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 1024 standing queries (slow tier)
+
+
+@pytest.mark.slow
+def test_1024_standing_queries_ladder_bounded_and_exact(rng):
+    """The ISSUE 13 acceptance leg: 1024 mixed standing queries evaluate
+    through ≤ ladder-many compiled signatures per (rung, nseg) pair,
+    with a sampled per-query parity check against sequential evaluation
+    of the same program."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.ops.compaction import capacity_ladder
+    from spatialflink_tpu.ops.query_registry import (
+        registry_bucket_kernel,
+        registry_bucket_query,
+    )
+    from spatialflink_tpu.ops.compaction import pick_capacity
+
+    nq, n = 1024, 4096
+    xy, oid, cell, valid = _bucket_inputs(rng, n=n, n_obj=512)
+    queries = [
+        qserve.StandingQuery(
+            qid=f"q{i}", tenant=f"t{i % 31}",
+            kind="range" if i % 2 else "knn",
+            x=float(rng.uniform(1, 9)), y=float(rng.uniform(1, 9)),
+            radius=float((0.8, 1.6, 2.4)[i % 3]),
+            k=(32, 5, 10, 30)[i % 4],
+        )
+        for i in range(nq)
+    ]
+    buckets = {}
+    for q in queries:
+        buckets.setdefault(qserve.bucket_key(q), []).append(q)
+    telemetry.enable()
+    try:
+        jkern = jax.jit(
+            registry_bucket_kernel,
+            static_argnames=("k", "num_segments", "query_block"),
+        )
+        from spatialflink_tpu.telemetry import instrument_jit
+
+        ikern = instrument_jit(jkern, name="registry_bucket_kernel")
+        results = {}
+        args = (jnp.asarray(xy), jnp.asarray(valid), jnp.asarray(cell))
+        oid_d = jnp.asarray(oid)
+        for key in sorted(buckets):
+            qs = buckets[key]
+            cap = pick_capacity(len(qs), 1024, minimum=8)
+            qxy, radius, qvalid, tables = qserve.bucket_host_arrays(
+                GRID, qs, cap
+            )
+            results[key] = (qs, qxy, radius, qvalid, tables, ikern(
+                *args, jnp.asarray(tables), oid_d, jnp.asarray(qxy),
+                jnp.asarray(radius), jnp.asarray(qvalid),
+                k=int(key[1]), num_segments=512,
+                query_block=min(cap, 32),
+            ))
+        # ≤ ladder-many signatures per rung (nseg/N fixed here, so the
+        # global bound is rungs-many ≤ ladder size × distinct k-rungs)
+        sigs = telemetry.distinct_shapes("registry_bucket_kernel")
+        k_rungs = {key[1] for key in buckets}
+        assert sigs <= len(capacity_ladder(1024)) * len(k_rungs), sigs
+        # sampled parity vs sequential evaluation (bit-identical)
+        single = jax.jit(
+            registry_bucket_query, static_argnames=("k", "num_segments")
+        )
+        for key in sorted(buckets)[:3]:
+            qs, qxy, radius, qvalid, tables, res = results[key]
+            for lane in (0, len(qs) // 2, len(qs) - 1):
+                d, seg, idx, nv, within = single(
+                    *args, jnp.asarray(tables[lane]), oid_d,
+                    jnp.asarray(qxy[lane]), jnp.asarray(radius[lane]),
+                    jnp.asarray(qvalid[lane]),
+                    k=int(key[1]), num_segments=512,
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(res.dist[lane]), np.asarray(d))
+                np.testing.assert_array_equal(
+                    np.asarray(res.segment[lane]), np.asarray(seg))
+                assert int(res.within[lane]) == int(within)
+    finally:
+        telemetry.disable()

@@ -684,15 +684,16 @@ def test_v3_cli_json_carries_evidence_chains():
 
 
 def test_lock_discipline_tree_pragmas_are_live():
-    """The two telemetry provider-callback sites are real findings held
-    by documented pragmas — if either goes stale (the hazard is fixed or
-    the pass stops seeing it), pragma-staleness fails the tree, so this
-    pin just keeps the justification honest."""
+    """The three telemetry provider-callback sites (stream seal + the
+    overload and qserve snapshot providers) are real findings held by
+    documented pragmas — if any goes stale (the hazard is fixed or the
+    pass stops seeing it), pragma-staleness fails the tree, so this pin
+    just keeps the justification honest."""
     import re
 
     src = open(os.path.join(
         REPO, "spatialflink_tpu", "telemetry.py")).read()
-    assert len(re.findall(r"sfcheck: ok=lock-discipline", src)) == 2
+    assert len(re.findall(r"sfcheck: ok=lock-discipline", src)) == 3
 
 
 # -- v3 satellite: analyzer-cost telemetry -----------------------------------

@@ -189,6 +189,29 @@ def cmd_report(args) -> int:
                       f"{int(wc.get('coded_bytes', 0))} B  "
                       f"(ratio {float(wc['ratio']):.3f}x)")
             _print_link_utilization(snap, events)
+        # Per-tenant-class QoS, next to the device-boundary numbers
+        # (the health CLI prints the same rows as notes).
+        tenants = (snap.get("overload") or {}).get("tenants") or {}
+        if tenants:
+            print("\n-- per-tenant-class QoS (overload tenant budgets) --")
+            for cls, rec in sorted(tenants.items()):
+                rec = rec or {}
+                print(f"{cls:<16} queries_live="
+                      f"{int(rec.get('queries_live') or 0):<6} "
+                      f"queries_shed="
+                      f"{int(rec.get('queries_shed') or 0):<6} "
+                      f"results_shed="
+                      f"{int(rec.get('results_shed') or 0):<8} "
+                      f"degraded_windows="
+                      f"{int(rec.get('degraded_windows') or 0)}")
+        qs = snap.get("qserve") or {}
+        if qs:
+            print(f"qserve registry: {int(qs.get('registered') or 0)} "
+                  f"standing queries in {len(qs.get('buckets') or {})} "
+                  f"bucket(s), "
+                  f"{int(qs.get('recompiles') or 0)} compiled bucket "
+                  f"signatures (ladder-bounded), "
+                  f"{int(qs.get('evicted_total') or 0)} evicted")
         if snap.get("dropped_events"):
             print(f"\nWARNING: {int(snap['dropped_events'])} trace events "
                   "dropped (buffer cap) — attribution above is partial")
@@ -564,6 +587,11 @@ def cmd_health(args) -> int:
             "notes": {
                 "driver": snap.get("driver") or {},
                 "overload": snap.get("overload") or {},
+                # per-tenant-class QoS counters, surfaced at top level
+                # too (they also ride notes.overload.tenants)
+                "tenants": (snap.get("overload") or {}).get("tenants")
+                or {},
+                "qserve": snap.get("qserve") or {},
                 "pipeline": snap.get("pipeline") or {},
                 "faults": snap.get("faults") or {},
                 "instant_events": events_mod.notable_event_counts(
@@ -615,6 +643,27 @@ def cmd_health(args) -> int:
             print(f"note overload circuit: state={br.get('state')} "
                   f"opens={int(br.get('opens') or 0)} "
                   f"probes={int(br.get('probes') or 0)}")
+    # Per-tenant-class QoS (qserve's scoping of the overload budgets;
+    # informational like the overload notes — budget it via an --slo
+    # spec's tenant_budgets to gate). SLO verdicts for a class surface
+    # in the check rows above as slo:tenant_*_budget:<class>.
+    for cls, rec in sorted((ov.get("tenants") or {}).items()):
+        rec = rec or {}
+        print(f"note tenant QoS [{cls}]: "
+              f"queries_live={int(rec.get('queries_live') or 0)} "
+              f"queries_shed={int(rec.get('queries_shed') or 0)} "
+              f"results_shed={int(rec.get('results_shed') or 0)} "
+              f"degraded_windows="
+              f"{int(rec.get('degraded_windows') or 0)}")
+    # qserve registry visibility (the snapshot()["qserve"] block).
+    qs = snap.get("qserve") or {}
+    if qs:
+        print(f"note qserve: registered={int(qs.get('registered') or 0)} "
+              f"(+{int(qs.get('registered_total') or 0)} total, "
+              f"-{int(qs.get('unregistered_total') or 0)} unregistered, "
+              f"{int(qs.get('evicted_total') or 0)} evicted) "
+              f"buckets={len(qs.get('buckets') or {})} "
+              f"recompiles={int(qs.get('recompiles') or 0)}")
     # Pipelined-ingest visibility (informational, the overload idiom):
     # a collapse means the circuit breaker forced the executor back to
     # the synchronous cadence mid-run — a stalled pipeline, worth a

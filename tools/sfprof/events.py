@@ -48,6 +48,11 @@ INSTANT_EVENTS = frozenset({
     # kernel-ablation harness armed (spatialflink_tpu/ablation.py) —
     # the event that marks a capture's numbers as deliberately wrong
     "ablation_armed",
+    # qserve standing-query registry (spatialflink_tpu/qserve.py):
+    # registration lifecycle + per-tenant-class admission rejections
+    "qserve_registered",
+    "qserve_unregistered",
+    "qserve_evicted",
 })
 
 #: Literal name prefixes for parameterized events (the suffix names the
@@ -58,6 +63,13 @@ INSTANT_EVENT_PREFIXES = (
     "slo_recovered:",
     "overload_rung_down:",
     "overload_rung_up:",
+    # per-tenant-class QoS transitions (overload.py tenant budgets;
+    # the suffix names the tenant class)
+    "overload_tenant_shed:",
+    "overload_tenant_recovered:",
+    # qserve bucket-capacity rung transitions (the suffix names the
+    # (kind, k-rung, radius-class) bucket)
+    "qserve_rung:",
 )
 
 #: Display groups for the health/recover summaries.
@@ -66,6 +78,7 @@ _GROUPS = (
     ("self-healing", ("driver_retry", "failover")),
     ("circuit", ("circuit_",)),
     ("overload", ("overload_",)),
+    ("qserve", ("qserve_",)),
     ("pipeline", ("pipeline_collapsed", "pipeline_resumed")),
     ("slo", ("slo_violation:", "slo_recovered:")),
     ("ablation", ("ablation_armed",)),

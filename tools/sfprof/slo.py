@@ -48,7 +48,7 @@ SPEC_KEYS = (
     "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
     "overflow_budget", "recompile_ceiling", "retry_budget",
     "failover_budget", "shed_budget", "degraded_window_budget",
-    "eval_interval_s", "warmup_windows",
+    "tenant_budgets", "eval_interval_s", "warmup_windows",
 )
 
 
@@ -171,6 +171,43 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
             "slo:degraded_window_budget", dw, f"<= {int(budget)}",
             dw is not None and dw <= budget,
         ))
+
+    tb = spec.get("tenant_budgets") or {}
+    if isinstance(tb, dict) and tb:
+        # Live-side mirror (slo.SloSpec.tenant_budgets): per-class shed
+        # = queries rejected + result rows shed, read from the snapshot
+        # overload block's ``tenants`` map. A ledger with NO overload
+        # block cannot answer a per-class budget — silence fails (the
+        # eps_floor rule); a present block with an unseen class reads as
+        # 0, exactly like the live engine's counters.
+        tenants = ov.get("tenants") if ov else None
+        for cls, b in sorted(tb.items()):
+            if not isinstance(b, dict):
+                continue
+            rec = None if tenants is None else tenants.get(cls)
+            sb = _num(b.get("shed_budget"))
+            if sb is not None:
+                if not ov:
+                    shed = None
+                else:
+                    shed = ((_num((rec or {}).get("queries_shed")) or 0.0)
+                            + (_num((rec or {}).get("results_shed"))
+                               or 0.0))
+                rows.append((
+                    f"slo:tenant_shed_budget:{cls}", shed,
+                    f"<= {int(sb)}",
+                    shed is not None and shed <= sb,
+                ))
+            dwb = _num(b.get("degraded_window_budget"))
+            if dwb is not None:
+                dw = (None if not ov
+                      else _num((rec or {}).get("degraded_windows"))
+                      or 0.0)
+                rows.append((
+                    f"slo:tenant_degraded_window_budget:{cls}", dw,
+                    f"<= {int(dwb)}",
+                    dw is not None and dw <= dwb,
+                ))
 
     budget = _num(spec.get("overflow_budget"))
     if budget is not None:
