@@ -700,6 +700,79 @@ def bench_qserve(jax, jnp, grid, quick):
     )
 
 
+def bench_sncb_dag(jax, jnp, grid, quick):
+    """Config: the composed 7-node SNCB DAG (spatialflink_tpu/dag.py —
+    Q1–Q5 + StayTime + qserve on ONE source/interner/window clock,
+    exactly-once per-node egress). This is the END-TO-END pipeline
+    rate: event-object windowing, zone kernels, the stay-time segment
+    sum, and the bucketed qserve programs all per window, ingest and
+    interning paid ONCE for all seven queries — the composition
+    ROADMAP item 4 exists for. Host-dominated by design (per-event
+    Python windowing), so the number grounds the DAG's ingest wall,
+    not a kernel."""
+    import itertools
+    import tempfile
+
+    from spatialflink_tpu import dag as dag_mod
+    from spatialflink_tpu import qserve as qserve_mod
+    from spatialflink_tpu.sncb.common import GpsEvent
+
+    n_events = 3_000 if quick else 12_000
+    min_x, max_x, min_y, max_y = dag_mod.SNCB_BBOX
+    rng = np.random.default_rng(29)
+    xs = rng.uniform(min_x, max_x, n_events)
+    ys = rng.uniform(min_y, max_y, n_events)
+    # Concentrate thirds near the bundled zone centroids (the dag.py
+    # smoke idiom) so every node's egress is non-vacuous.
+    xs[::3] = 4.354 + rng.normal(0.0, 0.004, len(xs[::3]))
+    ys[::3] = 50.854 + rng.normal(0.0, 0.004, len(ys[::3]))
+    xs[1::3] = 4.404 + rng.normal(0.0, 0.004, len(xs[1::3]))
+    ys[1::3] = 50.854 + rng.normal(0.0, 0.004, len(ys[1::3]))
+    fas = rng.uniform(0.0, 1.0, n_events)
+    ffs = rng.uniform(0.0, 0.4, n_events)
+    sp = rng.uniform(20.0, 110.0, n_events)
+
+    def source():
+        for i in range(n_events):
+            yield GpsEvent(
+                device_id=f"dev{i % 11}", lon=float(xs[i]),
+                lat=float(ys[i]), ts=i * 100,
+                gps_speed=float(sp[i]), fa=float(fas[i]),
+                ff=float(ffs[i]),
+            )
+
+    from spatialflink_tpu.sncb.common import PolygonLoader
+
+    zones = (  # loaded once; build_sncb_dag buffers q1's copy per rep
+        PolygonLoader.load_geojson_buffered("high_risk_zones.geojson",
+                                            20.0),
+        PolygonLoader.load_geojson_buffered("maintenance_areas.geojson",
+                                            0.0),
+        PolygonLoader.load_wkt_buffered("q5_fence.wkt", 20.0),
+    )
+    reps = 2 if quick else 3
+    times, n_results = [], 0
+    for _ in range(reps):
+        with tempfile.TemporaryDirectory(prefix="sft_dagbench_") as tmp:
+            dag = dag_mod.build_sncb_dag(
+                tmp, qserve_queries=dag_mod.default_sncb_queries(),
+                zones=zones,
+            )
+            stream = itertools.chain(dag.qserve_boot, source())
+            n_results = 0
+            t0 = time.perf_counter()
+            for res in dag.run(stream):
+                n_results += sum(res.counts.values())
+            times.append(time.perf_counter() - t0)
+    dag_mod.uninstall()
+    qserve_mod.uninstall()
+    return _result(
+        "sncb_dag_7node", reps * n_events, sum(times),
+        {"nodes": len(dag.dag_nodes), "results_per_rep": n_results},
+        spread=(min(times) * reps, max(times) * reps),
+    )
+
+
 def bench_point_polygon_join(jax, jnp, grid, quick):
     """Polygon-STREAM join config: points ⋈ 1000 polygons per window via
     the grid-pruned block kernel (ops/join.py:
@@ -1514,6 +1587,8 @@ def main():
          lambda: bench_knn_multi_query(jax, jnp, grid, args.quick)),
         ("qserve_1024q_mixed",
          lambda: bench_qserve(jax, jnp, grid, args.quick)),
+        ("sncb_dag_7node",
+         lambda: bench_sncb_dag(jax, jnp, grid, args.quick)),
     ]
     if args.configs:
         wanted = [w.strip() for w in args.configs.split(",") if w.strip()]

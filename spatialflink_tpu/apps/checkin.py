@@ -39,11 +39,18 @@ class CheckInEvent:
         return self.device_id[self.device_id.index("-") + 1:]
 
 
-def _insert_missing(events: Iterable[CheckInEvent]) -> Iterator[CheckInEvent]:
+def _insert_missing(events: Iterable[CheckInEvent],
+                    last: Optional[Dict[str, CheckInEvent]] = None,
+                    ) -> Iterator[CheckInEvent]:
     """Per-user sliding count(2,1) pass inserting missing in/out events.
     Only the previous event per user is needed (bounded state — the
-    reference's count window holds 2)."""
-    last: Dict[str, CheckInEvent] = {}
+    reference's count window holds 2). ``last`` (mutated in place)
+    carries that per-user state ACROSS calls — the composed DAG's
+    CheckIn node (dag.py) processes one window pane per call and
+    checkpoints the dict; the default (fresh state per call) is the
+    batch contract the standalone queries use."""
+    if last is None:
+        last = {}
     for ev in events:
         prev = last.get(ev.user_id)
         last[ev.user_id] = ev

@@ -76,23 +76,32 @@ def cell_stay_time(
         evs = [p for p in win.events if not traj_ids or p.obj_id in traj_ids]
         if not evs:
             continue
-        per_cell: Dict[str, float] = {}
-        by_obj: Dict[str, list] = {}
-        for p in evs:
-            by_obj.setdefault(p.obj_id, []).append(p)
-        for pts in by_obj.values():
-            pts.sort(key=lambda p: p.timestamp)
-            if len(pts) < 2:
-                continue
-            ts = np.array([p.timestamp for p in pts], np.int64)
-            cells = grid.assign_cells_np(
-                np.array([[p.x, p.y] for p in pts], float)
-            )
-            gaps = ts[1:] - ts[:-1]
-            for cell, gap in zip(cells[:-1], gaps):
-                name = grid.cell_name(int(cell)) if cell < grid.num_cells else "out"
-                per_cell[name] = per_cell.get(name, 0.0) + float(gap)
-        yield (win.start, win.end, per_cell)
+        yield (win.start, win.end, stay_time_window(evs, grid))
+
+
+def stay_time_window(evs, grid: UniformGrid) -> Dict[str, float]:
+    """One window's {cellName: stayTimeMs} — the host walk shared by
+    the streaming generator above and the composed DAG's StayTime node
+    fallback route (dag.py). ``evs`` carries ``obj_id``/``timestamp``/
+    ``x``/``y`` attributes (Points or GpsEvent-likes adapted by the
+    caller)."""
+    per_cell: Dict[str, float] = {}
+    by_obj: Dict[str, list] = {}
+    for p in evs:
+        by_obj.setdefault(p.obj_id, []).append(p)
+    for pts in by_obj.values():
+        pts.sort(key=lambda p: p.timestamp)
+        if len(pts) < 2:
+            continue
+        ts = np.array([p.timestamp for p in pts], np.int64)
+        cells = grid.assign_cells_np(
+            np.array([[p.x, p.y] for p in pts], float)
+        )
+        gaps = ts[1:] - ts[:-1]
+        for cell, gap in zip(cells[:-1], gaps):
+            name = grid.cell_name(int(cell)) if cell < grid.num_cells else "out"
+            per_cell[name] = per_cell.get(name, 0.0) + float(gap)
+    return per_cell
 
 
 def cell_stay_time_soa(
@@ -142,28 +151,39 @@ def cell_stay_time_soa(
             # SUPPRESSED (cell_stay_time's `if not evs: continue`), while
             # one with events but no pairs fires empty.
             continue
-        if len(ts) < 2:
-            yield (win.start, win.end, np.empty(0, np.int32),
-                   np.empty(0, np.int64))
-            continue
-        order = np.lexsort((ts, oid))
-        cells = grid.assign_cells_np(xy[order])
-        nb = next_bucket(len(ts), minimum=8)
-        pad = nb - len(ts)
-        t_rel = ts[order] - int(ts.min())  # int32-safe on non-x64 devices
-        tp = np.concatenate([t_rel, np.zeros(pad, np.int64)]).astype(np.int32)
-        op_ = np.concatenate(
-            [oid[order], np.full(pad, -1, np.int64)]).astype(np.int32)
-        cp = np.concatenate(
-            [cells, np.full(pad, grid.num_cells, np.int64)]).astype(np.int32)
-        vp = np.concatenate([np.ones(len(ts), bool), np.zeros(pad, bool)])
-        dwell, cnt = kernel(
-            jnp.asarray(tp), jnp.asarray(cp), jnp.asarray(op_),
-            jnp.asarray(vp), num_cells=grid.num_cells,
-        )
-        dwell = np.asarray(dwell).astype(np.int64)
-        hit = np.nonzero(np.asarray(cnt))[0].astype(np.int32)
-        yield (win.start, win.end, hit, dwell[hit])
+        hit, dwell = stay_time_window_soa(ts, oid, xy, grid, kernel)
+        yield (win.start, win.end, hit, dwell)
+
+
+def stay_time_window_soa(ts, oid, xy, grid: UniformGrid, kernel):
+    """One window's (cell_ids, dwell_ms) via the segment-sum kernel —
+    the device core shared by ``cell_stay_time_soa`` and the composed
+    DAG's StayTime node (dag.py). ``ts``/``oid`` int64 arrays, ``xy``
+    (N, 2) float64; ``kernel`` a jitted stay_time_cells_kernel."""
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.utils.padding import next_bucket
+
+    if len(ts) < 2:
+        return np.empty(0, np.int32), np.empty(0, np.int64)
+    order = np.lexsort((ts, oid))
+    cells = grid.assign_cells_np(xy[order])
+    nb = next_bucket(len(ts), minimum=8)
+    pad = nb - len(ts)
+    t_rel = ts[order] - int(ts.min())  # int32-safe on non-x64 devices
+    tp = np.concatenate([t_rel, np.zeros(pad, np.int64)]).astype(np.int32)
+    op_ = np.concatenate(
+        [oid[order], np.full(pad, -1, np.int64)]).astype(np.int32)
+    cp = np.concatenate(
+        [cells, np.full(pad, grid.num_cells, np.int64)]).astype(np.int32)
+    vp = np.concatenate([np.ones(len(ts), bool), np.zeros(pad, bool)])
+    dwell, cnt = kernel(
+        jnp.asarray(tp), jnp.asarray(cp), jnp.asarray(op_),
+        jnp.asarray(vp), num_cells=grid.num_cells,
+    )
+    dwell = np.asarray(dwell).astype(np.int64)
+    hit = np.nonzero(np.asarray(cnt))[0].astype(np.int32)
+    return hit, dwell[hit]
 
 
 def cell_sensor_range_intersection(

@@ -28,6 +28,10 @@ Snapshottable components:
     command uids, and QoS counters — kill mid-registration-churn
     resumes to byte-identical per-tenant egress (chaos matrix,
     ``qserve.register``);
+  - DataflowDAG (dag.py): every node's backend/counters/substate as one
+    ``dag`` component — published atomically with the shared assembler,
+    interner, source position, and the MultiSink marker map (the atomic
+    unit checkpoint of the composed SNCB pipeline);
   - Interner: the objID vocabulary (so dense ids stay stable on resume);
   - WireKafkaSource: per-partition consumed offsets (kafka_source_state)
     — Flink's checkpointed Kafka-consumer role, so kill-and-resume
@@ -159,6 +163,12 @@ def operator_state(op) -> Dict[str, Any]:
     qreg = getattr(op, "qserve_registry", None)
     if qreg is not None:  # qserve standing-query registry (qserve.py)
         out["qserve"] = qreg.state()
+    if getattr(op, "dag_nodes", None) is not None:
+        # Composed dataflow (dag.py): every node's backend + counters +
+        # substate (qserve registry, checkin occupancy, …) snapshot as
+        # ONE component — the atomic-unit-checkpoint half that pairs
+        # with the MultiSink marker map in the same publish.
+        out["dag"] = op.dag_state()
     jcarry = getattr(op, "_join_pane_carry", None)
     if jcarry is not None:  # join query_panes pane events + pair blocks
         out["join_pane_carry"] = {
@@ -225,6 +235,11 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
         # Consumed by the NEXT run_wire_panes call only — the
         # index-based carry must never leak into an ordinary fresh run.
         op._wire_pane_restored = True
+    if "dag" in state and getattr(op, "dag_nodes", None) is not None:
+        # Restored BEFORE the assembler state is consumed (dag.py's
+        # _adopt_assembler) so resumed nodes see their backend/substate
+        # before the first replayed window fires.
+        op.restore_dag(state["dag"])
     if "qserve" in state and getattr(op, "qserve_registry", None) \
             is not None:
         # Flag tables are derived (rebuilt from the grid inside

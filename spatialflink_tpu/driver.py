@@ -82,11 +82,86 @@ class RetryPolicy:
     for transient device/ingest errors (a tunnel blip, a leader change);
     a deterministic error simply exhausts the budget fast and moves on
     to failover or the crash path.
+
+    ``sleep`` is the injectable clock hook: ``None`` (production) means
+    ``time.sleep``; tests inject a recorder so the backoff SCHEDULE is
+    pinned deterministically without burning wall-clock seconds or
+    monkeypatching the module's ``time`` (tests/test_driver.py).
     """
 
     max_retries: int = 2
     backoff_s: float = 0.05
     multiplier: float = 2.0
+    sleep: Optional[Callable[[float], None]] = None
+
+    def do_sleep(self, seconds: float) -> None:
+        (self.sleep if self.sleep is not None else time.sleep)(seconds)
+
+
+#: Test seam for the dial watchdog's process kill (a real timeout must
+#: ``os._exit`` — jax may be wedged in an unkillable C call, so neither
+#: exceptions nor atexit can be trusted to run).
+def _dial_timeout_exit(code: int) -> None:
+    import os
+
+    os._exit(code)  # pragma: no cover - replaced by tests
+
+
+DIAL_TIMEOUT_EXIT_CODE = 3  # bench.py's dial-failure exit code
+
+
+def _seal_stream_dial_timeout(label: str) -> None:
+    """Seal an armed ledger stream with reason ``dial_timeout``,
+    WITHOUT ever blocking the watchdog. Normal wedge (the tunnel): the
+    hung thread is stuck inside a device call and does NOT hold
+    telemetry's lock, so the seal goes through telemetry's own writer
+    (appending around its buffered handle would be silently overwritten
+    by the handle's next write). Host-side wedge (e.g. a dead
+    filesystem mid-flush, lock held): the lock acquire is BOUNDED, and
+    on timeout the epilogue appends directly to the stream file — it
+    may interleave with the stuck writer's buffer, but an attributable
+    tail beats an unbounded wait; the watchdog's exit must never block
+    on a lock. Best-effort either way: a dying process must exit,
+    sealed or not."""
+    import json
+    import os
+    import time as _time
+
+    got = telemetry._lock.acquire(timeout=2.0)
+    try:
+        if got:
+            telemetry.seal_stream("dial_timeout")  # sfcheck: ok=lock-discipline -- deliberate same-RLock re-entrancy: the BOUNDED acquire above proves this watchdog thread can take telemetry's RLock without wedging, and seal_stream re-enters it on the same thread; holding it across the seal keeps the sealed-check + epilogue write atomic against a concurrently recovering writer
+            return
+        path = telemetry.stream_path
+        if not path or not os.path.exists(path) \
+                or getattr(telemetry, "_stream_sealed", False):
+            return
+        with open(path, "a") as f:
+            f.write(json.dumps({
+                "t": "epilogue", "unix": _time.time(),
+                "reason": "dial_timeout",
+                "sealed_by": "driver-watchdog", "label": label,
+            }) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:  # the seal is best-effort on a dying process
+        pass
+    finally:
+        if got:
+            telemetry._lock.release()
+
+
+def resolve_dial_deadline_s(explicit=None) -> float:
+    """The driver's dial budget: an explicit construction value wins,
+    else ``SFT_DIAL_DEADLINE_S`` when SET (the bench convention; its
+    180 s default stays bench-owned — an un-set env disables the driver
+    watchdog so unit tests never race a global timer), else disabled."""
+    import os
+
+    if explicit is not None:
+        return float(explicit)
+    spec = os.environ.get("SFT_DIAL_DEADLINE_S")
+    return float(spec) if spec else 0.0
 
 
 def strict_driver() -> "WindowedDataflowDriver":
@@ -131,7 +206,8 @@ class WindowedDataflowDriver:
                  failover: bool = True,
                  overload=None,
                  source_pausable: Optional[bool] = None,
-                 pipeline=None):
+                 pipeline=None,
+                 dial_deadline_s: Optional[float] = None):
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.sink = sink
@@ -167,6 +243,15 @@ class WindowedDataflowDriver:
         #: falls back to the module policy (``SFT_PIPELINE``); with
         #: neither, behavior is bit-identical to the synchronous loop.
         self.pipeline = pipeline
+        #: Bounded first device touch (the bench dial-deadline semantics
+        #: brought to the driver): the FIRST device-path window process
+        #: after construction or resume runs under a watchdog — a
+        #: ``--checkpoint`` resume on a down tunnel dies in bounded time
+        #: with the ledger stream sealed ``dial_timeout`` instead of
+        #: hanging forever. Explicit value wins; else SFT_DIAL_DEADLINE_S
+        #: when set; else disabled (see :func:`resolve_dial_deadline_s`).
+        self.dial_deadline_s = resolve_dial_deadline_s(dial_deadline_s)
+        self._dialed = False
         self.op = None
         self.process: Optional[Callable] = None
         self.fallback: Optional[Callable] = None
@@ -374,6 +459,51 @@ class WindowedDataflowDriver:
             yield from self._pipe_drain(pipe)
             self._commit(final=True)
 
+    # -- bounded first device touch (the dial watchdog) ------------------------
+
+    @contextlib.contextmanager
+    def _dial_guard(self, device_path: bool):
+        """Arm a bounded watchdog around the run's FIRST device-path
+        window process — the first real tunnel touch a driver (or a
+        ``--checkpoint`` resume) makes. On deadline: seal any armed
+        ledger stream with reason ``dial_timeout`` (bounded-lock seal —
+        :func:`_seal_stream_dial_timeout` never blocks the watchdog)
+        and kill the process with bench.py's dial exit code; a wedged
+        tunnel cannot be un-wedged from Python, only reported and
+        abandoned. Disarmed (no deadline / already dialed / fallback
+        path) cost: one attribute check."""
+        import threading
+
+        if not device_path or self._dialed or self.dial_deadline_s <= 0:
+            yield
+            return
+        ok = threading.Event()
+        deadline = float(self.dial_deadline_s)
+
+        def _watchdog():
+            if not ok.wait(deadline):
+                if ok.is_set():  # lost the race at the boundary
+                    return
+                _seal_stream_dial_timeout("driver first device window")
+                import sys
+
+                print(
+                    "driver: first device window hung > "
+                    f"{float(deadline):.0f} s (SFT_DIAL_DEADLINE_S) — "
+                    "tunnel unreachable; ledger stream sealed "
+                    "dial_timeout", file=sys.stderr,
+                )
+                sys.stderr.flush()
+                _dial_timeout_exit(DIAL_TIMEOUT_EXIT_CODE)
+
+        t = threading.Thread(target=_watchdog, daemon=True)
+        t.start()
+        try:
+            yield
+            self._dialed = True
+        finally:
+            ok.set()
+
     # -- pipelined window processing (spatialflink_tpu/pipeline.py) ------------
 
     def _pipeline_state(self) -> Optional[Dict[str, Any]]:
@@ -446,9 +576,13 @@ class WindowedDataflowDriver:
             telemetry.emit_instant("pipeline_resumed", label="driver")
             telemetry.maybe_flush_stream(force=True)
         try:
-            if faults.armed:  # chaos injection point (faults.py)
-                faults.hit("pipeline.ship")
-            work = pipe["compute"](win)
+            # The injection point sits INSIDE the dial guard: a
+            # hang-kind fault here rehearses exactly the wedge the
+            # watchdog bounds (a tunnel stalling the overlapped ship).
+            with self._dial_guard(True):
+                if faults.armed:  # chaos injection point (faults.py)
+                    faults.hit("pipeline.ship")
+                work = pipe["compute"](win)
         except (KeyboardInterrupt, SystemExit):
             raise
         except CheckpointCorruptError:
@@ -523,10 +657,11 @@ class WindowedDataflowDriver:
         proc = self.process if self.backend == "device" else self.fallback
         while True:
             try:
-                if self.backend == "device" and proc is self.process \
-                        and faults.armed:
-                    faults.hit("driver.window")  # chaos injection point
-                result = proc(win)
+                with self._dial_guard(proc is self.process):
+                    if self.backend == "device" and proc is self.process \
+                            and faults.armed:
+                        faults.hit("driver.window")  # chaos injection pt
+                    result = proc(win)
                 if use_breaker and proc is self.process:
                     breaker.record_success()
                 break
@@ -546,7 +681,7 @@ class WindowedDataflowDriver:
                     attempt += 1
                     self.stats["retries"] += 1
                     telemetry.record_driver_retry(start, attempt, repr(e))
-                    time.sleep(delay)
+                    policy.do_sleep(delay)
                     delay *= policy.multiplier
                     continue
                 if use_breaker and proc is self.process:

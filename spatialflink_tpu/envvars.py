@@ -131,7 +131,10 @@ ENV_VARS = {
     },
     "SFT_DIAL_DEADLINE_S": {
         "owner": "bench.py", "hazard": "tuning",
-        "doc": "axon dial deadline; timeout seals the stream",
+        "doc": "axon dial deadline; timeout seals the stream. Also read "
+               "by spatialflink_tpu/driver.py: when SET it bounds the "
+               "driver's first device-path window (the --checkpoint "
+               "resume-on-a-down-tunnel hang), same dial_timeout seal",
     },
     "SFT_NO_LINK_PROBE": {
         "owner": "bench.py", "hazard": "tuning",

@@ -89,6 +89,13 @@ INJECTION_POINTS: Dict[str, str] = {
     "qserve.register": "qserve.py:QueryRegistry.apply — standing-query "
                        "register/unregister command application (the "
                        "kill-mid-registration-churn point)",
+    "dag.node": "dag.py:DataflowDAG — per-node device-path window "
+                "processing (the per-node retry/failover ladder's "
+                "crash point)",
+    "dag.commit": "streams/sinks.py:MultiSink.commit — per-sink append "
+                  "inside the atomic unit commit (`at: 2` lands BETWEEN "
+                  "one sink's commit and the next — the cut the unit "
+                  "checkpoint must survive)",
 }
 
 #: Points whose callers implement the cooperative ``partial_write`` kind.

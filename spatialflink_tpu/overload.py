@@ -1008,12 +1008,71 @@ def batch_slides() -> int:
 # tools/ci runs on every commit.
 
 
+def _smoke_tenant_leg(fail) -> Optional[int]:
+    """The per-tenant-class QoS walk (smoke leg 2, same ledger stream):
+    registration rejection at ``max_queries``, retry-idempotent result
+    truncation at ``max_results_per_window``, class-local accounting
+    (the GLOBAL ladder must not move), per-class recovery on a clean
+    window fire, and the per-class SLO budgets answering. Swaps its own
+    controller/engine into the module slots — the caller's ``finally``
+    uninstalls whatever is current. Returns None on success, the
+    ``fail(...)`` exit code otherwise."""
+    from spatialflink_tpu import slo
+
+    tctrl = install(OverloadController(OverloadPolicy(tenant_budgets={
+        "bulk": {"max_queries": 1, "max_results_per_window": 5},
+    })))
+    slo.install(slo.SloEngine(slo.SloSpec(
+        name="overload-smoke-tenants", eval_interval_s=0.0,
+        tenant_budgets={"bulk": {"shed_budget": 3,
+                                 "degraded_window_budget": 0}},
+    )))
+    tengine = slo.engine()
+    if not tctrl.admit_tenant_query("bulk"):
+        return fail("tenant leg: first registration rejected")
+    if tctrl.admit_tenant_query("bulk"):
+        return fail("tenant leg: budget-exceeding registration admitted")
+    kept = tctrl.tenant_result_allowance("bulk", 9, window_start=1000)
+    # Retry-idempotence: re-charging the SAME window must replace the
+    # previous charge, not accumulate it.
+    kept2 = tctrl.tenant_result_allowance("bulk", 9, window_start=1000)
+    if (kept, kept2) != (5, 5):
+        return fail(f"tenant leg: allowance ({kept}, {kept2}) != (5, 5)")
+    if tctrl.tenant_shed_total("bulk") != 1 + 4:
+        return fail(f"tenant leg: shed_total "
+                    f"{tctrl.tenant_shed_total('bulk')} != 5 (1 "
+                    "rejected query + 4 shed rows, charged once)")
+    if tctrl.rung != 0 or tctrl.rung_transitions != 0:
+        return fail("tenant leg: class-local sheds moved the GLOBAL "
+                    "ladder")
+    # Two fired windows: the first clears the shed-this-window marker
+    # the charges above set; the second — clean — recovers the class
+    # (the overload_tenant_recovered transition, sealed in the stream).
+    tctrl.on_window_fired(n_events=1, lag_ms=0.0, end=2000)
+    tctrl.on_window_fired(n_events=1, lag_ms=0.0, end=3000)
+    trows = {r["check"]: r for r in tengine.evaluate()}
+    srow = trows.get("tenant_shed_budget:bulk")
+    drow = trows.get("tenant_degraded_window_budget:bulk")
+    if srow is None or srow["ok"] is not False:
+        # 5 sheds > the 3 budget — the per-class check must violate.
+        return fail(f"tenant leg: shed-budget row wrong: {srow}")
+    if drow is None or drow["ok"] is not False:
+        # 1 class-degraded window > the 0 budget — must violate too.
+        return fail(f"tenant leg: degraded-window row wrong: {drow}")
+    return None
+
+
 def smoke() -> int:
     """Deterministic toy burst against a tiny admission budget and a
     low lag ceiling: sheds must be counted, the ladder must step down
     AND back up, the SLO verdict must carry the shed/degradation
     budgets, and every transition must be recoverable from the sealed
-    ledger stream. Exit 0 on success."""
+    ledger stream. A second leg walks the PER-TENANT-CLASS machinery
+    (``tenant_budgets``): an over-budget class must have its
+    registration rejected and its result rows truncated — counted
+    against THE CLASS, never stepping the global ladder — with the
+    per-class transition events sealed in the same stream and the
+    per-class SLO budgets in a verdict. Exit 0 on success."""
     import tempfile
 
     import numpy as np
@@ -1092,6 +1151,9 @@ def smoke() -> int:
                 max_rung = max(max_rung, ctrl.rung)
             verdict = engine.verdict()
             snap = telemetry.snapshot()
+            tenant_fail = _smoke_tenant_leg(fail)
+            if tenant_fail is not None:
+                return tenant_fail
         finally:
             slo.uninstall()
             uninstall()
@@ -1120,7 +1182,8 @@ def smoke() -> int:
                 sealed = rec.get("t") == "epilogue"
         want = ("overload_shedding:lag", "overload_shedding:admission",
                 "overload_recovered:lag", "overload_rung_down:",
-                "overload_rung_up:")
+                "overload_rung_up:", "overload_tenant_shed:bulk",
+                "overload_tenant_recovered:bulk")
         missing = [w for w in want
                    if not any(n.startswith(w) for n in names)]
         if missing:

@@ -570,148 +570,163 @@ class QServeOperator(SpatialOperator):
         )
 
         def process(win) -> QServeWindowResult:
-            with telemetry.span("window.qserve", start=win.start,
-                                events=len(win.events)):
-                cmds = sorted(
-                    (e for e in win.events
-                     if isinstance(e, QServeCommand)),
-                    key=lambda c: (c.timestamp, c.uid),
-                )
-                for cmd in cmds:
-                    reg.apply(cmd)
-                # The exactly-once uid set only needs to reach as far
-                # back as a refire/resume can (one window span +
-                # lateness + slide behind this fire) — prune beyond it
-                # so checkpoints don't grow with lifetime command count.
-                reg.prune_applied(
-                    win.start,
-                    self.conf.window_size_ms
-                    + self.conf.allowed_lateness_ms
-                    + self.conf.slide_step_ms,
-                )
-                pts = [e for e in win.events
-                       if not isinstance(e, QServeCommand)]
-                buckets = reg.buckets()
-                # Evict device arrays of buckets churn has emptied —
-                # a dead bucket must not pin its (cap, num_cells+1)
-                # tables in device memory for the rest of the run.
-                for key in [k for k in self._bucket_dev
-                            if k not in buckets]:
-                    del self._bucket_dev[key]
-                rows: List[Tuple[str, str, str, Any, float]] = []
-                win_overflow = 0
-                if pts and buckets:
-                    with telemetry.span("assemble"):
-                        batch = self.point_batch(pts)
-                        nseg = next_bucket(
-                            max(self.interner.num_segments, 1),
-                            minimum=64,
-                        )
-                    with telemetry.span("ship"):
-                        valid_d, cell_d, oid_d = ship(
-                            batch.valid, batch.cell, batch.oid
-                        )
-                        xy_d = self.device_xy(batch, dtype)
-                    pending = []
-                    for key in sorted(buckets):
-                        qs = buckets[key]
-                        cap = pick_capacity(
-                            len(qs), reg.cap_max, minimum=QUERY_RUNG_MIN
-                        )
-                        telemetry.record_compaction(
-                            "qserve_bucket", cap, len(qs)
-                        )
-                        if self._last_rung.get(key) != cap:
-                            # A rung move is one (bounded) XLA compile —
-                            # worth an instant marker in the stream.
-                            self._last_rung[key] = cap
-                            telemetry.emit_instant(
-                                f"qserve_rung:{bucket_key_str(key)}",
-                                capacity=int(cap), live=len(qs),
-                            )
-                        arrays = self._bucket_device_arrays(
-                            key, qs, cap, dtype
-                        )
-                        rung = int(key[1])
-                        with telemetry.span(
-                            "compute", bucket=bucket_key_str(key)
-                        ):
-                            if mesh is not None:
-                                from spatialflink_tpu.parallel.sharded \
-                                    import sharded_registry_bucket
-
-                                res = sharded_registry_bucket(
-                                    mesh, xy_d, valid_d, cell_d,
-                                    arrays["tables"], oid_d,
-                                    arrays["qxy"], arrays["radius"],
-                                    arrays["qvalid"],
-                                    k=rung, num_segments=nseg,
-                                )
-                            else:
-                                res = kernel(
-                                    xy_d, valid_d, cell_d,
-                                    arrays["tables"], oid_d,
-                                    arrays["qxy"], arrays["radius"],
-                                    arrays["qvalid"],
-                                    k=rung, num_segments=nseg,
-                                    query_block=min(cap, 32),
-                                )
-                        pending.append((qs, res))
-                    # ONE true sync for ALL buckets (the flush_pending
-                    # idiom): every bucket's dispatch is in flight
-                    # before the window pays its single device→host
-                    # round trip — per-bucket fetches would serialize
-                    # ~bucket-count tunnel syncs per window.
-                    with telemetry.span("fetch"):
-                        fetched = telemetry.fetch([
-                            (r.num_valid, r.within, r.segment, r.dist)
-                            for _qs, r in pending
-                        ])
-                    for (qs, _r), (nvs, within, segs, dists) in zip(
-                            pending, fetched):
-                        for lane, q in enumerate(qs):
-                            nv = int(nvs[lane])
-                            if q.kind == "range":
-                                # Truncation against the QUERY's own
-                                # result cap (k ≤ rung): any distinct
-                                # in-radius object beyond the k rows
-                                # returned is an incomplete range
-                                # result, counted.
-                                win_overflow += max(
-                                    int(within[lane]) - int(q.k), 0
-                                )
-                            for r_ in range(min(nv, int(q.k))):
-                                rows.append((
-                                    q.tenant_class, q.tenant, q.qid,
-                                    self.interner.lookup(
-                                        int(segs[lane, r_])
-                                    ),
-                                    float(dists[lane, r_]),
-                                ))
-                reg.record_range_overflow(win.start, win_overflow)
-                # Per-tenant-class result budgets: each class keeps its
-                # first `allowance` rows (deterministic bucket/qid/rank
-                # order), the excess is counted against THE CLASS only.
-                counts: Dict[str, int] = {}
-                for row in rows:
-                    counts[row[0]] = counts.get(row[0], 0) + 1
-                allow = {
-                    cls: overload.tenant_result_allowance(
-                        cls, n, window_start=win.start)
-                    for cls, n in sorted(counts.items())
-                }
-                kept: List[Tuple[str, str, str, Any, float]] = []
-                used: Dict[str, int] = {}
-                for row in rows:
-                    used[row[0]] = used.get(row[0], 0) + 1
-                    if used[row[0]] <= allow[row[0]]:
-                        kept.append(row)
-                return QServeWindowResult(
-                    win.start, win.end, kept, len(win.events)
-                )
+            return self.serve_window(win, kernel, dtype=dtype, mesh=mesh)
 
         drv.bind(self, process, fallback=None)
         yield from drv.run(stream)
+
+    def serve_window(self, win, kernel, dtype=np.float64,
+                     mesh=None) -> QServeWindowResult:
+        """One window's serving pass: apply the window's commands
+        exactly once, evaluate every bucket as one program, ONE true
+        sync for all buckets, per-tenant-class result budgets. The
+        shared core of :meth:`run`'s process and the composed DAG's
+        qserve node (dag.py) — both route retries through the
+        retry-idempotent accumulators (record_range_overflow,
+        tenant_result_allowance), so re-running a window is safe."""
+        from spatialflink_tpu.ops.compaction import pick_capacity
+
+        reg = self.qserve_registry
+        with telemetry.span("window.qserve", start=win.start,
+                            events=len(win.events)):
+            cmds = sorted(
+                (e for e in win.events
+                 if isinstance(e, QServeCommand)),
+                key=lambda c: (c.timestamp, c.uid),
+            )
+            for cmd in cmds:
+                reg.apply(cmd)
+            # The exactly-once uid set only needs to reach as far
+            # back as a refire/resume can (one window span +
+            # lateness + slide behind this fire) — prune beyond it
+            # so checkpoints don't grow with lifetime command count.
+            reg.prune_applied(
+                win.start,
+                self.conf.window_size_ms
+                + self.conf.allowed_lateness_ms
+                + self.conf.slide_step_ms,
+            )
+            pts = [e for e in win.events
+                   if not isinstance(e, QServeCommand)]
+            buckets = reg.buckets()
+            # Evict device arrays of buckets churn has emptied —
+            # a dead bucket must not pin its (cap, num_cells+1)
+            # tables in device memory for the rest of the run.
+            for key in [k for k in self._bucket_dev
+                        if k not in buckets]:
+                del self._bucket_dev[key]
+            rows: List[Tuple[str, str, str, Any, float]] = []
+            win_overflow = 0
+            if pts and buckets:
+                with telemetry.span("assemble"):
+                    batch = self.point_batch(pts)
+                    nseg = next_bucket(
+                        max(self.interner.num_segments, 1),
+                        minimum=64,
+                    )
+                with telemetry.span("ship"):
+                    valid_d, cell_d, oid_d = ship(
+                        batch.valid, batch.cell, batch.oid
+                    )
+                    xy_d = self.device_xy(batch, dtype)
+                pending = []
+                for key in sorted(buckets):
+                    qs = buckets[key]
+                    cap = pick_capacity(
+                        len(qs), reg.cap_max, minimum=QUERY_RUNG_MIN
+                    )
+                    telemetry.record_compaction(
+                        "qserve_bucket", cap, len(qs)
+                    )
+                    if self._last_rung.get(key) != cap:
+                        # A rung move is one (bounded) XLA compile —
+                        # worth an instant marker in the stream.
+                        self._last_rung[key] = cap
+                        telemetry.emit_instant(
+                            f"qserve_rung:{bucket_key_str(key)}",
+                            capacity=int(cap), live=len(qs),
+                        )
+                    arrays = self._bucket_device_arrays(
+                        key, qs, cap, dtype
+                    )
+                    rung = int(key[1])
+                    with telemetry.span(
+                        "compute", bucket=bucket_key_str(key)
+                    ):
+                        if mesh is not None:
+                            from spatialflink_tpu.parallel.sharded \
+                                import sharded_registry_bucket
+
+                            res = sharded_registry_bucket(
+                                mesh, xy_d, valid_d, cell_d,
+                                arrays["tables"], oid_d,
+                                arrays["qxy"], arrays["radius"],
+                                arrays["qvalid"],
+                                k=rung, num_segments=nseg,
+                            )
+                        else:
+                            res = kernel(
+                                xy_d, valid_d, cell_d,
+                                arrays["tables"], oid_d,
+                                arrays["qxy"], arrays["radius"],
+                                arrays["qvalid"],
+                                k=rung, num_segments=nseg,
+                                query_block=min(cap, 32),
+                            )
+                    pending.append((qs, res))
+                # ONE true sync for ALL buckets (the flush_pending
+                # idiom): every bucket's dispatch is in flight
+                # before the window pays its single device→host
+                # round trip — per-bucket fetches would serialize
+                # ~bucket-count tunnel syncs per window.
+                with telemetry.span("fetch"):
+                    fetched = telemetry.fetch([
+                        (r.num_valid, r.within, r.segment, r.dist)
+                        for _qs, r in pending
+                    ])
+                for (qs, _r), (nvs, within, segs, dists) in zip(
+                        pending, fetched):
+                    for lane, q in enumerate(qs):
+                        nv = int(nvs[lane])
+                        if q.kind == "range":
+                            # Truncation against the QUERY's own
+                            # result cap (k ≤ rung): any distinct
+                            # in-radius object beyond the k rows
+                            # returned is an incomplete range
+                            # result, counted.
+                            win_overflow += max(
+                                int(within[lane]) - int(q.k), 0
+                            )
+                        for r_ in range(min(nv, int(q.k))):
+                            rows.append((
+                                q.tenant_class, q.tenant, q.qid,
+                                self.interner.lookup(
+                                    int(segs[lane, r_])
+                                ),
+                                float(dists[lane, r_]),
+                            ))
+            reg.record_range_overflow(win.start, win_overflow)
+            # Per-tenant-class result budgets: each class keeps its
+            # first `allowance` rows (deterministic bucket/qid/rank
+            # order), the excess is counted against THE CLASS only.
+            counts: Dict[str, int] = {}
+            for row in rows:
+                counts[row[0]] = counts.get(row[0], 0) + 1
+            allow = {
+                cls: overload.tenant_result_allowance(
+                    cls, n, window_start=win.start)
+                for cls, n in sorted(counts.items())
+            }
+            kept: List[Tuple[str, str, str, Any, float]] = []
+            used: Dict[str, int] = {}
+            for row in rows:
+                used[row[0]] = used.get(row[0], 0) + 1
+                if used[row[0]] <= allow[row[0]]:
+                    kept.append(row)
+            return QServeWindowResult(
+                win.start, win.end, kept, len(win.events)
+            )
+
 
 
 # -- module-level wiring (the telemetry/overload singleton idiom) --------------

@@ -73,6 +73,14 @@ class SloSpec:
       the controller's PER-CLASS counters (queries rejected + result
       rows shed / class-degraded windows). A spec naming a class against
       a run with NO controller installed violates — silence fails;
+    - ``node_budgets``: per-DAG-node freshness/health budgets (the
+      composed-dataflow scoping, spatialflink_tpu/dag.py) — ``{node:
+      {"watermark_lag_p99_ms": L, "retry_budget": N,
+      "failover_budget": M, "degraded_window_budget": K}}`` checked
+      against the installed DAG's PER-NODE counters, so each query's
+      watermark lag is budgeted separately. A spec naming a node
+      against a run with NO DAG installed (or an unknown node name)
+      violates — silence fails;
     - ``eval_interval_s``: pacing of the incremental evaluation (the
       per-window cost between evaluations is counter updates only).
     """
@@ -88,6 +96,7 @@ class SloSpec:
     shed_budget: Optional[int] = None
     degraded_window_budget: Optional[int] = None
     tenant_budgets: Optional[Dict[str, Dict[str, int]]] = None
+    node_budgets: Optional[Dict[str, Dict[str, int]]] = None
     eval_interval_s: float = 1.0
     warmup_windows: int = 8
 
@@ -95,11 +104,20 @@ class SloSpec:
     #: parse rule applies inside the mapping too).
     TENANT_BUDGET_KEYS = ("shed_budget", "degraded_window_budget")
 
+    #: Per-node budget keys ``node_budgets`` accepts (integer ms /
+    #: counts — same strict map shape).
+    NODE_BUDGET_KEYS = ("watermark_lag_p99_ms", "retry_budget",
+                        "failover_budget", "degraded_window_budget")
+
     def __post_init__(self):
         # ONE validation home (overload.validate_budget_map): same
         # map shape as OverloadPolicy.tenant_budgets, different keys.
         overload.validate_budget_map(
             self.tenant_budgets, self.TENANT_BUDGET_KEYS
+        )
+        overload.validate_budget_map(
+            self.node_budgets, self.NODE_BUDGET_KEYS,
+            what="node_budgets",
         )
 
     @classmethod
@@ -267,6 +285,32 @@ class SloEngine:
                     check(f"tenant_degraded_window_budget:{cls}", dw,
                           f"<= {int(dwb)}",
                           dw is not None and dw <= dwb)
+        if sp.node_budgets:
+            from spatialflink_tpu import dag as dag_mod
+
+            d = dag_mod.active()
+            for node, b in sorted(sp.node_budgets.items()):
+                stats = None if d is None else d.node_stats(node)
+                # ONE (key, head, metric) table — the same triple shape
+                # as the post-hoc twin's (tools/sfprof/slo.py).
+                for key, head, metric in (
+                    ("watermark_lag_p99_ms", "node_watermark_lag_p99_ms",
+                     "watermark_lag_p99_ms"),
+                    ("retry_budget", "node_retry_budget", "retries"),
+                    ("failover_budget", "node_failover_budget",
+                     "failovers"),
+                    ("degraded_window_budget",
+                     "node_degraded_window_budget", "degraded_windows"),
+                ):
+                    bound = b.get(key)
+                    if bound is None:
+                        continue
+                    val = None if stats is None else stats[metric]
+                    check(f"{head}:{node}", val, f"<= {int(bound)}",
+                          # No DAG installed / unknown node = the
+                          # per-node budget is unanswerable — silence
+                          # fails (the eps_floor rule).
+                          val is not None and val <= bound)
         if sp.overflow_budget is not None:
             counts: List[int] = []
             _find_overflows(self.tel.snapshot(), counts)

@@ -196,6 +196,44 @@ def contains_any_zone(zones: Sequence[BufferedZone], xy_metric: np.ndarray) -> n
     return np.asarray(hit)[:n]
 
 
+def contains_any_zone_np(zones: Sequence[BufferedZone],
+                         xy_metric: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`contains_any_zone` — the fallback route the
+    composed-DAG nodes fail over to when the device path dies (dag.py's
+    per-node ladder). Same semantics: inside any zone's polygon OR
+    within its ``buffer_m`` of the boundary; results match the device
+    kernel to float ulps (tests/test_dag.py pins set parity)."""
+    if not zones or not len(xy_metric):
+        return np.zeros(len(xy_metric), bool)
+    pts = np.asarray(xy_metric, np.float64)
+    hit = np.zeros(len(pts), bool)
+    for z in zones:
+        verts, ev = z.packed()
+        x, y = pts[:, 0:1], pts[:, 1:2]
+        x1, y1 = verts[:-1, 0][None, :], verts[:-1, 1][None, :]
+        x2, y2 = verts[1:, 0][None, :], verts[1:, 1][None, :]
+        # Even-odd ray cast (ops/polygon.py:points_in_polygon, host form).
+        spans = (y1 > y) != (y2 > y)
+        dy = y2 - y1
+        t = np.where(dy != 0, (y - y1) / np.where(dy != 0, dy, 1.0), 0.0)
+        inside = (
+            np.sum(spans & (x < x1 + t * (x2 - x1)) & ev[None, :], axis=1)
+            % 2 == 1
+        )
+        # Min distance to any valid edge (segment projection clamp).
+        exy = np.stack([x2 - x1, y2 - y1], axis=-1)[0]  # (E, 2)
+        p1 = verts[:-1]  # (E, 2)
+        seg_len2 = np.maximum(np.sum(exy * exy, axis=-1), 1e-300)
+        rel = pts[:, None, :] - p1[None, :, :]  # (N, E, 2)
+        tt = np.clip(np.sum(rel * exy[None, :, :], axis=-1)
+                     / seg_len2[None, :], 0.0, 1.0)
+        near = p1[None, :, :] + tt[..., None] * exy[None, :, :]
+        d2 = np.sum((pts[:, None, :] - near) ** 2, axis=-1)
+        d2 = np.where(ev[None, :], d2, np.inf)
+        hit |= inside | (np.sqrt(np.min(d2, axis=1)) <= z.buffer_m)
+    return hit
+
+
 class PolygonLoader:
     """Load GeoJSON FeatureCollections / WKT files, reproject rings to
     EPSG:25831, attach a buffer radius (PolygonLoader.java:24-138)."""

@@ -64,8 +64,11 @@ def keyed_windows(events, size_ms, slide_ms, key_fn, lateness_ms=_LATENESS_MS):
             yield key, win.start, win.end, groups[key]
 
 
-def _zone_filter(events: Sequence[GpsEvent], zones, keep_inside: bool) -> List[GpsEvent]:
-    """Batched zone containment filter over metric coordinates."""
+def _zone_filter(events: Sequence[GpsEvent], zones, keep_inside: bool,
+                 backend: str = "device") -> List[GpsEvent]:
+    """Batched zone containment filter over metric coordinates.
+    ``backend="numpy"`` routes the host twin (contains_any_zone_np) —
+    the per-node failover route of the composed DAG (dag.py)."""
     if not events:
         return []
     from spatialflink_tpu.ops.counters import counters
@@ -75,7 +78,12 @@ def _zone_filter(events: Sequence[GpsEvent], zones, keep_inside: bool) -> List[G
         # the distCompCounter analog for the SNCB zone kernels.
         counters.record_candidates(len(events), len(events) * len(zones))
     xy = CRSUtils.enrich_batch(events)
-    inside = contains_any_zone(zones, xy)
+    if backend == "numpy":
+        from spatialflink_tpu.sncb.common import contains_any_zone_np
+
+        inside = contains_any_zone_np(zones, xy)
+    else:
+        inside = contains_any_zone(zones, xy)
     keep = inside if keep_inside else ~inside
     return [e for e, k in zip(events, keep) if k]
 
@@ -94,13 +102,9 @@ def q1_high_risk(
     described in the module docstring; 0.001° ≈ tens of meters at Brussels
     latitudes, hence the 20 m default).
     """
-    zones = [
-        BufferedZone(z.rings_metric, z.buffer_m + radius_m, z.name)
-        for z in high_risk_zones
-    ]
+    zones = buffer_q1_zones(high_risk_zones, radius_m)
     for win in _windows(events, window_s * 1000, window_s * 1000):
-        for e in _zone_filter(win.events, zones, keep_inside=True):
-            yield CRSUtils.enrich(e)
+        yield from q1_window(win.events, zones)
 
 
 def q2_brake_monitor(
@@ -255,6 +259,109 @@ def q2_brake_monitor_batch(
             )
         )
     out.sort(key=lambda o: (o.win_start, o.device_id))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Window-scoped query cores — one fired window's events in, result
+# records out. These are the node bodies of the composed SNCB DAG
+# (spatialflink_tpu/dag.py): the DAG shares ONE window clock across all
+# queries (amortizing ingest/interning — the deliberate deviation from
+# the per-query window configs above, PARITY.md "Composed dataflow"),
+# so each query's per-window core is factored out here. ``backend``
+# routes the zone kernels: "device" (contains_any_zone) or "numpy"
+# (contains_any_zone_np) — the per-node failover route; results match
+# to float ulps.
+
+
+def _by_device(events: Sequence[GpsEvent]) -> Dict[str, List[GpsEvent]]:
+    groups: Dict[str, List[GpsEvent]] = {}
+    for e in events:
+        groups.setdefault(e.device_id, []).append(e)
+    return groups
+
+
+def buffer_q1_zones(high_risk_zones: Sequence[BufferedZone],
+                    radius_m: float = 20.0) -> List[BufferedZone]:
+    """Q1's proximity widening (build once, not per window)."""
+    return [
+        BufferedZone(z.rings_metric, z.buffer_m + radius_m, z.name)
+        for z in high_risk_zones
+    ]
+
+
+def q1_window(events: Sequence[GpsEvent],
+              zones: Sequence[BufferedZone],
+              backend: str = "device") -> List[EnrichedEvent]:
+    """Q1 core: events near the (pre-buffered) high-risk zones,
+    enriched to metric coords (Q1_HighRisk.java:73-78)."""
+    return [
+        CRSUtils.enrich(e)
+        for e in _zone_filter(events, zones, keep_inside=True,
+                              backend=backend)
+    ]
+
+
+def q2_window(events: Sequence[GpsEvent],
+              maintenance_zones: Sequence[BufferedZone],
+              start: int, end: int,
+              var_fa_min: float = 0.6, var_ff_max: float = 0.5,
+              backend: str = "device") -> List[VarOut]:
+    """Q2 core: maintenance-zone exclude → per-device brake-pressure
+    variation → varFA > a ∧ varFF ≤ b filter (Q2_BrakeMonitor.java)."""
+    kept = _zone_filter(events, maintenance_zones, keep_inside=False,
+                        backend=backend)
+    out: List[VarOut] = []
+    for dev in sorted(groups := _by_device(kept)):
+        evs = groups[dev]
+        var_fa, var_ff = variation(evs)
+        if var_fa > var_fa_min and var_ff <= var_ff_max:
+            out.append(VarOut(dev, var_fa, var_ff, start, end, len(evs)))
+    return out
+
+
+def q3_window(events: Sequence[GpsEvent],
+              start: int, end: int) -> List[TrajOut]:
+    """Q3 core: per-device window trajectory WKT (Q3_Trajectory.java)."""
+    groups = _by_device(events)
+    return [
+        TrajOut(dev, trajectory_wkt(groups[dev]), start, end)
+        for dev in sorted(groups)
+    ]
+
+
+def q4_window(events: Sequence[GpsEvent], start: int, end: int,
+              min_lon: float, max_lon: float,
+              min_lat: float, max_lat: float,
+              t_min: int, t_max: int) -> List[TrajOut]:
+    """Q4 core: Q3 with bbox/time-range predicate pushdown
+    (Q4_TrajectoryRestricted.java)."""
+    return q3_window(
+        [e for e in events
+         if min_lon <= e.lon <= max_lon and min_lat <= e.lat <= max_lat
+         and t_min <= e.ts <= t_max],
+        start, end,
+    )
+
+
+def q5_window(events: Sequence[GpsEvent],
+              fence_zones: Sequence[BufferedZone],
+              start: int, end: int,
+              avg_threshold: float = 50.0, min_threshold: float = 20.0,
+              backend: str = "device") -> List[TrajSpeedOut]:
+    """Q5 core: geofence include → per-device trajectory + speed stats,
+    avg > a ∨ min > m filter (Q5_TrajAndSpeedFence.java)."""
+    fenced = _zone_filter(events, fence_zones, keep_inside=True,
+                          backend=backend)
+    out: List[TrajSpeedOut] = []
+    for dev in sorted(groups := _by_device(fenced)):
+        wkt, avg_speed, min_speed = traj_speed(groups[dev])
+        if avg_speed > avg_threshold or (
+            min_speed == min_speed and min_speed > min_threshold
+        ):
+            out.append(
+                TrajSpeedOut(dev, wkt, avg_speed, min_speed, start, end)
+            )
     return out
 
 

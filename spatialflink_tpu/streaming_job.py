@@ -13,12 +13,17 @@ line-record boundary) and dispatches on ``query.option``:
   (extensions) 3 = window kNN, 4 = realtime kNN, 5 = window join,
   6 = tStats, 7 = tAggregate, 8 = multi-query window kNN (one fused
   program answers the whole queryPoints set per window) — the operator
-  families the reference keeps in its commented-out cases — and
+  families the reference keeps in its commented-out cases —
   9 = qserve, the multi-tenant standing-query serving layer
   (spatialflink_tpu/qserve.py): the query set comes from ``SFT_QSERVE``
   (queries + per-tenant-class budgets) or falls back to one range + one
   kNN standing query per yml queryPoint; registration commands ride the
-  stream and intern into the operator's objID table (one intern home).
+  stream and intern into the operator's objID table (one intern home);
+  and 10 = the composed SNCB DAG (spatialflink_tpu/dag.py): Q1–Q5 +
+  StayTime + qserve on ONE source/interner/window clock, one
+  transactional sink per node under the ``--output`` DIRECTORY, and —
+  with ``--checkpoint`` — the atomic unit checkpoint (kill -9 anywhere
+  resumes byte-identical on every sink).
 """
 
 from __future__ import annotations
@@ -139,13 +144,67 @@ def build_source(params: Params, source_arg: str) -> Iterator[Point]:
     raise ValueError(f"unknown source spec {source_arg!r}")
 
 
+def _run_sncb_dag(params: Params, source, output_dir, driver) -> int:
+    """Query option 10: the composed SNCB DAG (spatialflink_tpu/dag.py)
+    — Q1–Q5 + StayTime + qserve on one source/interner/window clock,
+    one transactional sink per node under ``output_dir``. Point events
+    from the generic sources adapt to GpsEvents (obj_id → deviceId,
+    x/y → lon/lat); qserve's standing-query set comes from
+    ``SFT_QSERVE`` or the built-in Brussels default, registered via
+    deterministic boot commands ON the stream (so a ``--checkpoint``
+    resume replays them exactly)."""
+    import itertools
+
+    from spatialflink_tpu import dag as dag_mod
+    from spatialflink_tpu import qserve as qserve_mod
+    from spatialflink_tpu.sncb.common import GpsEvent
+
+    if not output_dir:
+        raise SystemExit(
+            "query option 10 (the SNCB DAG) needs --output <directory> "
+            "— one transactional sink per node lands there"
+        )
+    cfg = qserve_mod.config_from_env()
+    if cfg and cfg.get("queries"):
+        queries = qserve_mod.queries_from_config(cfg)
+    else:
+        queries = dag_mod.default_sncb_queries()
+    w = params.window
+    dag = dag_mod.build_sncb_dag(
+        output_dir,
+        window_s=float(w.interval), slide_s=float(w.step),
+        grid=params.input_stream1.make_grid(),
+        qserve_queries=queries,
+        cap_max=(cfg or {}).get("cap_max"),
+    )
+
+    def gps(src):
+        for p in src:
+            if isinstance(p, GpsEvent):
+                yield p
+            else:
+                yield GpsEvent(
+                    device_id=p.obj_id, lon=float(p.x), lat=float(p.y),
+                    ts=int(p.timestamp),
+                    gps_speed=getattr(p, "speed", None),
+                )
+
+    stream = itertools.chain(dag.qserve_boot, gps(source))
+    n = 0
+    for res in dag.run(stream, driver=driver):
+        n += sum(res.counts.values())
+    return n
+
+
 def run_job(params: Params, source: Iterable[Point], sink,
-            driver=None) -> int:
+            driver=None, output_dir=None) -> int:
     """Dispatch on ``query.option``. ``driver=`` (a configured
     spatialflink_tpu.driver.WindowedDataflowDriver) routes the windowed
     query options through the self-healing dataflow driver —
     auto-checkpoint + exactly-once egress + retry/failover; supported
-    for the driver-wired operators (options 1, 3, 5, 6 and 9)."""
+    for the driver-wired operators (options 1, 3, 5, 6, 9 and 10).
+    ``output_dir`` is option 10's egress directory (the composed DAG
+    owns one transactional sink per node; ``sink`` is ignored there)."""
     grid = params.input_stream1.make_grid()
     q = params.query
     window_conf = QueryConfiguration(
@@ -184,12 +243,15 @@ def run_job(params: Params, source: Iterable[Point], sink,
         % max(window_conf.slide_step_ms, 1) == 0
     )
 
-    if driver is not None and option not in (1, 3, 5, 6, 9):
+    if driver is not None and option not in (1, 3, 5, 6, 9, 10):
         raise SystemExit(
             f"--checkpoint (the dataflow driver) supports query options "
-            f"1, 3, 5, 6 and 9, not {option} — the remaining operators "
-            "keep their own loops until they are driver-wired"
+            f"1, 3, 5, 6, 9 and 10, not {option} — the remaining "
+            "operators keep their own loops until they are driver-wired"
         )
+
+    if option == 10:
+        return _run_sncb_dag(params, source, output_dir, driver)
 
     if option in (1, 2):
         conf = window_conf if option == 1 else realtime_conf
@@ -332,7 +394,7 @@ def run_job(params: Params, source: Iterable[Point], sink,
                 sink(f"{res.start},{res.end},{cell},{cnt},{lens}")
                 n += 1
     else:
-        raise SystemExit(f"Unrecognized query option {option}. Use 1-9.")
+        raise SystemExit(f"Unrecognized query option {option}. Use 1-10.")
     return n
 
 
@@ -385,7 +447,8 @@ def main(argv=None) -> int:
                 or args.output.startswith("kafka:"):
             raise SystemExit(
                 "--checkpoint requires a file --output (the exactly-once "
-                "egress protocol is file-based)"
+                "egress protocol is file-based; option 10 takes a "
+                "directory — one sink per DAG node)"
             )
         if args.source.partition(":")[0] not in ("csv", "geojson"):
             raise SystemExit(
@@ -396,13 +459,29 @@ def main(argv=None) -> int:
         from spatialflink_tpu.driver import WindowedDataflowDriver
         from spatialflink_tpu.streams.sinks import TransactionalFileSink
 
-        sink = TransactionalFileSink(args.output)
-        driver = WindowedDataflowDriver(
-            checkpoint_path=args.checkpoint,
-            checkpoint_every=args.checkpoint_every,
-            sink=sink,
-        )
-        n = run_job(params, source, sink, driver=driver)
+        if params.query.option == 10:
+            # The composed DAG wires its own MultiSink (one
+            # transactional sink per node under the --output dir) into
+            # the driver; the unit checkpoint covers them all.
+            driver = WindowedDataflowDriver(
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                sink=None,
+            )
+            n = run_job(params, source, None, driver=driver,
+                        output_dir=args.output)
+        else:
+            sink = TransactionalFileSink(args.output)
+            driver = WindowedDataflowDriver(
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                sink=sink,
+            )
+            n = run_job(params, source, sink, driver=driver)
+        print(f"StreamingJob done: {n} result records", file=sys.stderr)
+        return 0
+    if params.query.option == 10:
+        n = run_job(params, source, None, output_dir=args.output)
         print(f"StreamingJob done: {n} result records", file=sys.stderr)
         return 0
     if args.output and (args.output == "kafka"

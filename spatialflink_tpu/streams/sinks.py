@@ -230,6 +230,78 @@ class TransactionalFileSink:
             self.close()
 
 
+class MultiSink:
+    """N named :class:`TransactionalFileSink`\\ s committed as ONE unit —
+    the egress half of the DAG's atomic unit checkpoint
+    (spatialflink_tpu/dag.py).
+
+    Each node of a composed dataflow stages into its own sub-sink;
+    ``commit()`` durably appends every sub-sink's staged records IN NAME
+    ORDER and returns the combined marker map, which the driver embeds
+    in the SAME checkpoint as every node's operator state. A crash
+    between two sub-commits (the ``dag.commit`` injection point fires
+    before EACH sub-append) leaves the earlier sinks with a tail past
+    their last checkpointed marker and the later ones without —
+    ``restore()`` truncates the former and leaves the latter, and the
+    replay regenerates both, so kill-anywhere still yields byte-
+    identical egress on EVERY sink. A sink file SHORTER than its marker
+    (committed egress lost out-of-band, or a marker from a FUTURE
+    checkpoint generation) stays loud: the sub-sink's restore raises
+    ``CheckpointCorruptError`` naming the file.
+    """
+
+    def __init__(self, sinks: "Dict[str, TransactionalFileSink]"):
+        #: name → sub-sink, committed in sorted-name order (the
+        #: deterministic order the between-commit cut contract rides).
+        self.sinks = dict(sinks)
+
+    def __getitem__(self, name: str) -> TransactionalFileSink:
+        return self.sinks[name]
+
+    def stage(self, name: str, record: Any) -> None:
+        self.sinks[name].stage(record)
+
+    @property
+    def pending(self) -> int:
+        return sum(s.pending for s in self.sinks.values())
+
+    def reset(self) -> None:
+        for name in sorted(self.sinks):
+            self.sinks[name].reset()
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Resume every sub-sink from the checkpointed marker map. A
+        sink the checkpoint has no marker for (a node added since) gets
+        a fresh ``reset()`` — its whole history replays."""
+        markers = state["sinks"]
+        for name in sorted(self.sinks):
+            if name in markers:
+                self.sinks[name].restore(markers[name])
+            else:
+                self.sinks[name].reset()
+
+    def commit(self) -> Dict[str, Any]:
+        """The unit commit: every sub-sink's staged records append
+        durably, in sorted-name order, each behind the ``dag.commit``
+        injection point — then the combined marker map returns for the
+        driver's checkpoint. Any crash mid-sequence is repaired by
+        ``restore()`` exactly like a single sink's torn append."""
+        out: Dict[str, Any] = {}
+        for name in sorted(self.sinks):
+            if faults.armed:  # chaos injection point (faults.py)
+                faults.hit("dag.commit")
+            out[name] = self.sinks[name].commit()
+        return {"sinks": out}
+
+    def state(self) -> Dict[str, Any]:
+        return {"sinks": {name: s.state()
+                          for name, s in sorted(self.sinks.items())}}
+
+    def close(self) -> None:
+        for name in sorted(self.sinks):
+            self.sinks[name].close()
+
+
 class LatencySink:
     """Record per-item latency = now − event/ingestion time.
 

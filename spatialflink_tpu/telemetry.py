@@ -212,6 +212,12 @@ class Telemetry:
         # registered/evicted/bucket-occupancy/recompile counters ride
         # ledger-stream checkpoints like the overload block does.
         self.qserve_provider = None
+        # Optional composed-dataflow callback installed by
+        # dag.install(): snapshot() embeds it as ["dag"] — per-node
+        # backend/retry/failover/degraded/lag counters, the post-hoc
+        # half of the per-node SLO twin (tools/sfprof/slo.py
+        # node_budgets).
+        self.dag_provider = None
         self._lock = threading.RLock()
         self._reset_state()
 
@@ -1064,6 +1070,11 @@ class Telemetry:
         if self.qserve_provider is not None:
             try:
                 out["qserve"] = json_safe(self.qserve_provider())  # sfcheck: ok=lock-discipline -- same provider contract as overload_provider above: the qserve registry is lock-free host state and only re-enters this RLock on the same thread (distinct_shapes)
+            except Exception:  # a broken provider must not break snapshots
+                pass
+        if self.dag_provider is not None:
+            try:
+                out["dag"] = json_safe(self.dag_provider())  # sfcheck: ok=lock-discipline -- same provider contract: the DAG's node-state dicts are driver-thread confined host state; the provider takes no locks
             except Exception:  # a broken provider must not break snapshots
                 pass
         link = self.link_gauges()

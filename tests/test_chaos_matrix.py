@@ -11,7 +11,7 @@ flushes). The real-process SIGKILL analog (``abort`` kind,
 ``os._exit(137)``) is pinned by the slow subprocess test below and runs
 on every commit as tools/ci's chaos-smoke stage.
 
-Six pipeline harnesses cover the fourteen points:
+Seven pipeline harnesses cover the sixteen points:
 
 - range-query driver pipeline (collection source): device.ship,
   device.dispatch, device.fetch, window.feed, driver.window, sink.write,
@@ -31,7 +31,15 @@ Six pipeline harnesses cover the fourteen points:
   CONTAINED by its sync-fallback, so only a real process death
   exercises the crash contract there): pipeline.ship, pipeline.fetch —
   killed mid-overlap, the resumed pipelined child converges to the
-  clean child's bytes, which equal a pipeline-OFF run's bytes too.
+  clean child's bytes, which equal a pipeline-OFF run's bytes too
+  (hang kinds have their own legs: bounded hangs are contained
+  in-process, a wedge past SFT_DIAL_DEADLINE_S dies on the driver's
+  dial watchdog);
+- composed SNCB DAG subprocess (7 nodes, 7 transactional sinks, one
+  atomic unit checkpoint, SFT_OVERLOAD_POLICY + SFT_PIPELINE armed):
+  dag.commit — killed BETWEEN two sink commits of a unit commit —
+  and dag.node (mid-node-walk), plus a qserve.register leg inside the
+  DAG; every sink must converge byte-identically on resume.
 """
 
 import json
@@ -500,6 +508,154 @@ def chaos_pipeline(tmp_path, point):
 
 
 # ---------------------------------------------------------------------------
+# Harness 6: the composed SNCB DAG (subprocess, armed overload +
+# pipeline policies). Seven nodes, seven transactional sinks, ONE unit
+# checkpoint: the abort kind kills the child at the named point —
+# including BETWEEN two sink commits of a unit commit (dag.commit at 9
+# = the second unit commit's 2nd sub-append) — and the resumed child
+# must converge every sink to the clean child's bytes.
+
+
+def chaos_dag(tmp_path, point, at):
+    from spatialflink_tpu.dag import SMOKE_OVERLOAD_POLICY
+
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""}
+    env_base.pop("SFT_FAULT_PLAN", None)
+    # Armed overload (the shed schedule CHANGES egress and must replay
+    # exactly across the kill) + armed pipeline policy (result-
+    # transparent by contract; arming it proves the DAG path tolerates
+    # it).
+    env_base["SFT_OVERLOAD_POLICY"] = json.dumps(SMOKE_OVERLOAD_POLICY)
+    env_base["SFT_PIPELINE"] = json.dumps({"depth": 2, "fetch_lag": 2})
+
+    def child(workdir, plan=None):
+        env = dict(env_base)
+        if plan:
+            env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        return subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.dag",
+             "--chaos-child", str(workdir)],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=REPO,
+        )
+
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    p = child(clean)
+    assert p.returncode == 0, p.stderr[-2000:]
+
+    def sinks(d):
+        out = {}
+        for f in sorted((d / "egress").iterdir()):
+            out[f.name] = f.read_bytes()
+        return out
+
+    want = sinks(clean)
+    assert len(want) == 7 and all(want.values()), {
+        k: len(v) for k, v in want.items()}
+    p = child(chaos, plan=[{"point": point, "kind": "abort", "at": at}])
+    assert p.returncode == ABORT_EXIT_CODE, (p.returncode,
+                                             p.stderr[-2000:])
+    p = child(chaos)  # resume from the unit checkpoint
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert sinks(chaos) == want
+
+
+def test_dag_qserve_register_kill_under_armed_policies(tmp_path):
+    """The acceptance's fourth cut: kill -9 at qserve.register INSIDE
+    the composed DAG (mid-registration-churn of the qserve node), same
+    armed overload + pipeline env, every sink byte-identical after
+    resume."""
+    chaos_dag(tmp_path, "qserve.register", at=11)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline hang legs: the wedged (not killed) tunnel mid-overlap.
+# In-process, a hang-kind fault on the DRIVER's pipelined path is
+# CONTAINED (sleep → raise → drain + synchronous reprocess) — results
+# must not move. The WEDGE-past-any-patience mode is bounded by the
+# driver's dial watchdog (SFT_DIAL_DEADLINE_S): the first device
+# window hangs, the watchdog seals and kills the child with bench's
+# dial exit code, and a resumed child still converges byte-exactly.
+
+
+@pytest.mark.parametrize("point", ["pipeline.ship", "pipeline.fetch"])
+def test_pipeline_hang_kind_is_contained_in_process(tmp_path, point):
+    from spatialflink_tpu import pipeline
+
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    pipeline.install(pipeline.PipelinePolicy(depth=2, fetch_lag=2))
+    try:
+        run_range_leg(str(clean))
+        want = (clean / "egress.csv").read_bytes()
+        assert want
+        # Bounded hangs (10 ms each), MORE than the retry budget: the
+        # pipelined driver path must drain and reprocess synchronously,
+        # not crash — and the egress must not move.
+        drv = run_range_leg(str(chaos), fault_plan=[
+            {"point": point, "kind": "hang", "hang_s": 0.01, "at": 2,
+             "times": 3},
+        ])
+        assert drv.stats["resumed"] is False
+        assert (chaos / "egress.csv").read_bytes() == want
+    finally:
+        pipeline.uninstall()
+
+
+def test_pipeline_hang_wedge_is_bounded_by_dial_deadline(tmp_path):
+    """A hang far past any retry patience on the FIRST overlapped ship:
+    the driver's dial watchdog (SFT_DIAL_DEADLINE_S) must kill the
+    child with bench's dial exit code in bounded time — not ride out
+    the wedge — and a fresh child must still converge to the clean
+    bytes."""
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""}
+    env_base.pop("SFT_FAULT_PLAN", None)
+    env_base["SFT_PIPELINE"] = json.dumps({"depth": 2, "fetch_lag": 2})
+
+    def child(workdir, plan=None, deadline=None):
+        env = dict(env_base)
+        env.pop("SFT_DIAL_DEADLINE_S", None)
+        if deadline is not None:
+            env["SFT_DIAL_DEADLINE_S"] = str(deadline)
+        if plan:
+            env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        return subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.driver",
+             "--chaos-child", str(workdir)],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=REPO,
+        )
+
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    assert child(clean).returncode == 0
+    want = (clean / "egress.csv").read_bytes()
+    assert want
+    p = child(chaos, deadline="0.3", plan=[
+        {"point": "pipeline.ship", "kind": "hang", "hang_s": 60,
+         "at": 1},
+    ])
+    from spatialflink_tpu.driver import DIAL_TIMEOUT_EXIT_CODE
+
+    assert p.returncode == DIAL_TIMEOUT_EXIT_CODE, (p.returncode,
+                                                    p.stderr[-2000:])
+    assert "dial_timeout" in p.stderr or "SFT_DIAL_DEADLINE_S" \
+        in p.stderr
+    p = child(chaos)  # recover: fresh run, no wedge
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert (chaos / "egress.csv").read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 
 
@@ -522,6 +678,11 @@ MATRIX = {
     "pipeline.ship": lambda tp: chaos_pipeline(tp, "pipeline.ship"),
     "pipeline.fetch": lambda tp: chaos_pipeline(tp, "pipeline.fetch"),
     "qserve.register": lambda tp: chaos_qserve(tp, "qserve.register"),
+    # The 7-node SNCB DAG under armed overload + pipeline policies:
+    # at=9 is the SECOND unit commit's 2nd sub-append — the between-
+    # sink-commits cut the atomic unit checkpoint exists to close.
+    "dag.commit": lambda tp: chaos_dag(tp, "dag.commit", at=9),
+    "dag.node": lambda tp: chaos_dag(tp, "dag.node", at=25),
 }
 
 

@@ -39,6 +39,9 @@ def test_dry_run_lists_all_stages(capsys):
     # And the burst/shed/degrade/recover overload round trip.
     assert "[overload-smoke]" in out
     assert "spatialflink_tpu.overload --smoke" in plain
+    # And the composed-DAG kill-between-sink-commits round trip.
+    assert "[dag-smoke]" in out
+    assert "spatialflink_tpu.dag --smoke" in plain
 
 
 def test_skip_flags_trim_stages(capsys):
@@ -46,14 +49,18 @@ def test_skip_flags_trim_stages(capsys):
     out = capsys.readouterr().out
     assert "[sfcheck]" in out
     assert "pytest" not in out and "bench" not in out
-    # --skip-bench does NOT drop the chaos/overload smokes (CPU-only,
-    # independent of the bench stage); only their own flags do.
+    # --skip-bench does NOT drop the chaos/overload/dag smokes
+    # (CPU-only, independent of the bench stage); only their own flags
+    # do.
     assert "[chaos-smoke]" in out
     assert "[overload-smoke]" in out
+    assert "[dag-smoke]" in out
     assert ci.main(["--dry-run", "--skip-tests", "--skip-bench",
-                    "--skip-chaos", "--skip-overload"]) == 0
+                    "--skip-chaos", "--skip-overload",
+                    "--skip-dag"]) == 0
     out = capsys.readouterr().out
     assert "chaos" not in out and "overload" not in out
+    assert "dag" not in out
 
 
 def test_changed_flag_passes_through(capsys):

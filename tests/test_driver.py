@@ -257,16 +257,27 @@ class TestRetry:
             _run_range(driver=drv)
         assert drv.stats["retries"] == 1
 
-    def test_backoff_sleeps_between_attempts(self, monkeypatch):
+    def test_backoff_schedule_pinned_via_sleep_hook(self):
+        """RetryPolicy.sleep is the injectable clock: the full backoff
+        schedule is pinned deterministically with ZERO wall-clock
+        sleeping and no module monkeypatching (the production default —
+        sleep=None → time.sleep — is untouched)."""
+        sleeps = []
+        faults.arm([{"point": "driver.window", "at": 1, "times": 3}])
+        drv = WindowedDataflowDriver(
+            retry=RetryPolicy(max_retries=3, backoff_s=0.1,
+                              multiplier=3.0, sleep=sleeps.append))
+        _run_range(driver=drv)
+        assert sleeps == [0.1, pytest.approx(0.3), pytest.approx(0.9)]
+        assert drv.stats["retries"] == 3
+
+    def test_sleep_hook_default_is_time_sleep(self, monkeypatch):
         import spatialflink_tpu.driver as driver_mod
 
-        sleeps = []
-        monkeypatch.setattr(driver_mod.time, "sleep", sleeps.append)
-        faults.arm([{"point": "driver.window", "at": 1, "times": 2}])
-        drv = WindowedDataflowDriver(
-            retry=RetryPolicy(max_retries=2, backoff_s=0.1, multiplier=3.0))
-        _run_range(driver=drv)
-        assert sleeps[:2] == [0.1, pytest.approx(0.3)]
+        called = []
+        monkeypatch.setattr(driver_mod.time, "sleep", called.append)
+        RetryPolicy().do_sleep(0.07)
+        assert called == [0.07]
 
 
 class TestFailoverParity:
@@ -463,6 +474,67 @@ class TestCheckpointResume:
         drv.process = lambda w: w
         with pytest.raises(ValueError, match="run_windows"):
             list(drv.run_windows(iter([])))
+
+
+class TestDialDeadline:
+    """The driver's bounded first device touch (the bench dial-deadline
+    semantics): a --checkpoint resume on a down tunnel must die in
+    bounded time with the ledger stream sealed ``dial_timeout``, never
+    hang forever."""
+
+    def test_resolution_order(self, monkeypatch):
+        from spatialflink_tpu.driver import resolve_dial_deadline_s
+
+        monkeypatch.delenv("SFT_DIAL_DEADLINE_S", raising=False)
+        assert resolve_dial_deadline_s() == 0.0  # unset env → disabled
+        monkeypatch.setenv("SFT_DIAL_DEADLINE_S", "7.5")
+        assert resolve_dial_deadline_s() == 7.5
+        assert resolve_dial_deadline_s(2.0) == 2.0  # explicit wins
+
+    def test_first_window_hang_fires_watchdog_and_seals(
+            self, tmp_path, monkeypatch):
+        import time as _time
+
+        import spatialflink_tpu.driver as driver_mod
+
+        fired = []
+        monkeypatch.setattr(driver_mod, "_dial_timeout_exit",
+                            fired.append)
+        stream = tmp_path / "run.stream.jsonl"
+        telemetry.enable(stream_path=str(stream),
+                         stream_flush_interval_s=0.0)
+        grid, conf, source, query = _toy_pipeline()
+        op = PointPointRangeQuery(conf, grid)
+        drv = WindowedDataflowDriver(dial_deadline_s=0.05)
+
+        def slow_first(win):
+            _time.sleep(0.4)  # the wedge: > deadline on window 1 only
+            return win
+
+        drv.bind(op, slow_first)
+        out = list(drv.run(source()))
+        assert out  # the recorder exit hook let the run complete
+        assert fired == [driver_mod.DIAL_TIMEOUT_EXIT_CODE]
+        telemetry.disable()
+        recs = [json.loads(ln)
+                for ln in stream.read_text().splitlines()]
+        sealed = [r for r in recs if r.get("t") == "epilogue"]
+        # The watchdog's seal wins; disable() cannot double-seal.
+        assert [r["reason"] for r in sealed] == ["dial_timeout"]
+
+    def test_fast_first_window_never_fires(self, monkeypatch):
+        import spatialflink_tpu.driver as driver_mod
+
+        fired = []
+        monkeypatch.setattr(driver_mod, "_dial_timeout_exit",
+                            fired.append)
+        grid, conf, source, query = _toy_pipeline()
+        op = PointPointRangeQuery(conf, grid)
+        drv = WindowedDataflowDriver(dial_deadline_s=5.0)
+        drv.bind(op, lambda win: win)
+        out = list(drv.run(source()))
+        assert out and fired == []
+        assert drv._dialed is True  # later windows never re-arm
 
 
 class TestTransactionalSink:

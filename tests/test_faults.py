@@ -73,6 +73,7 @@ class TestPlanParsing:
             "sink.write", "driver.window",
             "overload.admit", "source.stall",
             "pipeline.ship", "pipeline.fetch", "qserve.register",
+            "dag.node", "dag.commit",
         }
 
 

@@ -684,16 +684,16 @@ def test_v3_cli_json_carries_evidence_chains():
 
 
 def test_lock_discipline_tree_pragmas_are_live():
-    """The three telemetry provider-callback sites (stream seal + the
-    overload and qserve snapshot providers) are real findings held by
-    documented pragmas — if any goes stale (the hazard is fixed or the
-    pass stops seeing it), pragma-staleness fails the tree, so this pin
-    just keeps the justification honest."""
+    """The four telemetry provider-callback sites (stream seal + the
+    overload, qserve, and dag snapshot providers) are real findings
+    held by documented pragmas — if any goes stale (the hazard is fixed
+    or the pass stops seeing it), pragma-staleness fails the tree, so
+    this pin just keeps the justification honest."""
     import re
 
     src = open(os.path.join(
         REPO, "spatialflink_tpu", "telemetry.py")).read()
-    assert len(re.findall(r"sfcheck: ok=lock-discipline", src)) == 3
+    assert len(re.findall(r"sfcheck: ok=lock-discipline", src)) == 4
 
 
 # -- v3 satellite: analyzer-cost telemetry -----------------------------------

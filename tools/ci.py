@@ -28,16 +28,23 @@ One shot, five stages, fail-fast, distinct banners:
 5. **overload smoke** — ``python -m spatialflink_tpu.overload
    --smoke``: a toy burst past a tiny admission budget must shed
    deterministically, step the degradation ladder down AND back up,
-   carry the shed/degradation budgets through the SLO verdict, and
-   seal every overload transition in the ledger stream.
+   carry the shed/degradation budgets through the SLO verdict
+   (including the per-tenant-class budgets), and seal every overload
+   transition in the ledger stream;
+6. **dag smoke** — ``python -m spatialflink_tpu.dag --smoke``: the
+   7-node SNCB DAG (Q1–Q5 + StayTime + qserve on one source/interner/
+   window clock) under an armed overload policy, killed by an
+   ``abort`` fault BETWEEN two sink commits of the atomic unit
+   checkpoint, resumed — every node's exactly-once egress must be
+   byte-identical to the clean run's.
 
 Exit code: the first failing stage's (sfcheck keeps its 0/1/2/3
 contract; pytest and sfprof theirs). ``--skip-tests`` / ``--skip-bench``
-/ ``--skip-chaos`` / ``--skip-overload`` trim stages for quick
-iteration (the chaos and overload smokes are CPU-only and independent
-of the bench stage, so ``--skip-bench`` keeps them); ``--dry-run``
-prints the stage commands without running anything (pinned by
-tests/test_ci.py).
+/ ``--skip-chaos`` / ``--skip-overload`` / ``--skip-dag`` trim stages
+for quick iteration (the chaos/overload/dag smokes are CPU-only and
+independent of the bench stage, so ``--skip-bench`` keeps them);
+``--dry-run`` prints the stage commands without running anything
+(pinned by tests/test_ci.py).
 """
 
 from __future__ import annotations
@@ -90,6 +97,7 @@ def _cpu_env() -> Dict[str, str]:
 def stages(changed: bool, skip_tests: bool, skip_bench: bool,
            skip_chaos: bool = False,
            skip_overload: bool = False,
+           skip_dag: bool = False,
            ledger_path: Optional[str] = None,
            stream_path: Optional[str] = None) \
         -> List[Tuple[str, List[List[str]]]]:
@@ -144,6 +152,14 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
         out.append(("overload-smoke", [
             [py, "-m", "spatialflink_tpu.overload", "--smoke"],
         ]))
+    if not skip_dag:
+        # DAG smoke: the 7-node SNCB pipeline under an armed overload
+        # policy, killed BETWEEN two sink commits of the atomic unit
+        # checkpoint, resumed — byte-identical egress on every node's
+        # sink (spatialflink_tpu/dag.py). CPU-only too.
+        out.append(("dag-smoke", [
+            [py, "-m", "spatialflink_tpu.dag", "--smoke"],
+        ]))
     return out
 
 
@@ -176,6 +192,8 @@ def main(argv=None) -> int:
                     help="skip the kill/resume chaos-smoke stage")
     ap.add_argument("--skip-overload", action="store_true",
                     help="skip the burst/shed/degrade overload-smoke stage")
+    ap.add_argument("--skip-dag", action="store_true",
+                    help="skip the SNCB-DAG kill/resume dag-smoke stage")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the stage commands and exit 0")
     args = ap.parse_args(argv)
@@ -184,7 +202,7 @@ def main(argv=None) -> int:
         ledger = os.path.join(tmpdir, "ledger.json")
         stream = os.path.join(tmpdir, "ledger_stream.jsonl")
         plan = stages(args.changed, args.skip_tests, args.skip_bench,
-                      args.skip_chaos, args.skip_overload,
+                      args.skip_chaos, args.skip_overload, args.skip_dag,
                       ledger_path=ledger, stream_path=stream)
         if args.dry_run:
             for name, cmds in plan:

@@ -70,6 +70,10 @@ INSTANT_EVENT_PREFIXES = (
     # qserve bucket-capacity rung transitions (the suffix names the
     # (kind, k-rung, radius-class) bucket)
     "qserve_rung:",
+    # composed-dataflow per-node failover (dag.py — the suffix names
+    # the node; siblings keep their device path, so recovery stories
+    # need the node name, not just the global `failover` event)
+    "dag_node_failover:",
 )
 
 #: Display groups for the health/recover summaries.
@@ -78,6 +82,7 @@ _GROUPS = (
     ("self-healing", ("driver_retry", "failover")),
     ("circuit", ("circuit_",)),
     ("overload", ("overload_",)),
+    ("dag", ("dag_node_failover:",)),
     ("qserve", ("qserve_",)),
     ("pipeline", ("pipeline_collapsed", "pipeline_resumed")),
     ("slo", ("slo_violation:", "slo_recovered:")),

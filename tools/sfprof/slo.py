@@ -26,6 +26,11 @@ Metric sources in the ledger document:
   controller's counters, spatialflink_tpu/overload.py); a spec
   budgeting them against a ledger with no overload block fails on
   silence too;
+- ``node_budgets`` → snapshot ``dag.nodes.<name>`` block (per-node
+  ``watermark_lag_p99_ms``/``retries``/``failovers``/
+  ``degraded_windows`` — the composed dataflow's per-node counters,
+  spatialflink_tpu/dag.py); a spec naming a node against a ledger with
+  no dag block (or without that node) fails on silence too;
 - ``overflow_budget`` → every ``*overflow*`` counter in the bench block
   and snapshot, summed.
 
@@ -48,7 +53,8 @@ SPEC_KEYS = (
     "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
     "overflow_budget", "recompile_ceiling", "retry_budget",
     "failover_budget", "shed_budget", "degraded_window_budget",
-    "tenant_budgets", "eval_interval_s", "warmup_windows",
+    "tenant_budgets", "node_budgets", "eval_interval_s",
+    "warmup_windows",
 )
 
 
@@ -207,6 +213,35 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
                     f"slo:tenant_degraded_window_budget:{cls}", dw,
                     f"<= {int(dwb)}",
                     dw is not None and dw <= dwb,
+                ))
+
+    nb = spec.get("node_budgets") or {}
+    if isinstance(nb, dict) and nb:
+        # Live-side mirror (slo.SloSpec.node_budgets): per-DAG-node
+        # freshness/health budgets read from the snapshot ``dag`` block
+        # (spatialflink_tpu/dag.py). A ledger with NO dag block — or a
+        # block without the named node — cannot answer a per-node
+        # budget: silence fails (the eps_floor rule).
+        dag_nodes = (snap.get("dag") or {}).get("nodes")
+        for node, b in sorted(nb.items()):
+            if not isinstance(b, dict):
+                continue
+            rec = None if dag_nodes is None else dag_nodes.get(node)
+            for key, head, metric in (
+                ("watermark_lag_p99_ms", "node_watermark_lag_p99_ms",
+                 "watermark_lag_p99_ms"),
+                ("retry_budget", "node_retry_budget", "retries"),
+                ("failover_budget", "node_failover_budget", "failovers"),
+                ("degraded_window_budget", "node_degraded_window_budget",
+                 "degraded_windows"),
+            ):
+                bound = _num(b.get(key))
+                if bound is None:
+                    continue
+                val = None if rec is None else _num(rec.get(metric))
+                rows.append((
+                    f"slo:{head}:{node}", val, f"<= {int(bound)}",
+                    val is not None and val <= bound,
                 ))
 
     budget = _num(spec.get("overflow_budget"))
