@@ -668,7 +668,7 @@ def main() -> None:
 
         ex = PipelinedExecutor(
             throughput_pol, ship=slide_wire, compute=compute,
-            fetch=telemetry.fetch, label="headline",
+            fetch=telemetry.fetch, label="headline", node="headline",
         )
         t0 = time.perf_counter()
         results = [int(v) for v in ex.run(range(1, N_WINDOWS + 1))]
@@ -800,6 +800,7 @@ def main() -> None:
     overlap_ex = PipelinedExecutor(
         overlap_pol, ship=probe_ship, compute=probe_compute,
         fetch=telemetry.fetch, label="pipeline", spans=True,
+        node="headline",
     )
     pipeline_results = [
         int(v) for v in overlap_ex.run(range(1, n_probe + 1))
@@ -899,6 +900,13 @@ def main() -> None:
         # the bench's synthetic stream is in order by construction).
         "telemetry": telemetry.summary(),
     }
+    # Per-node attribution table (telemetry.node_rollup — the pipelined
+    # executors above run under node "headline"): rides the record AND
+    # the ledger snapshot; the smoke contract below asserts the two are
+    # identical (record↔ledger round trip).
+    _nodes = telemetry.node_rollup()
+    if _nodes:
+        out["telemetry"]["nodes"] = _nodes
     # Pipelined-ingest proof block: the executor's counters (overlapped
     # vs collapsed windows, drains) + whether SFT_PIPELINE armed the
     # OPERATOR paths too (the throughput loop and overlap probe always
@@ -980,6 +988,29 @@ def main() -> None:
             telemetry.write_ledger(ledger_path, bench=out)
         except Exception as e:
             sys.stderr.write(f"ledger not written: {e!r}\n")
+        else:
+            if smoke:
+                # Contract: the per-node table printed in the record is
+                # byte-for-byte the one the ledger snapshot carries —
+                # nothing between the print and the ledger write may
+                # touch a node bucket (cost capture is node-blind).
+                with open(ledger_path) as f:
+                    _doc = json.load(f)
+                _rec = out["telemetry"].get("nodes") or {}
+                _led = (_doc.get("snapshot") or {}).get("nodes") or {}
+                if json.dumps(_rec, sort_keys=True) != json.dumps(
+                        _led, sort_keys=True):
+                    raise SystemExit(
+                        "bench smoke: per-node table diverged between "
+                        f"record ({sorted(_rec)}) and ledger "
+                        f"({sorted(_led)})"
+                    )
+                if not _rec:
+                    raise SystemExit(
+                        "bench smoke: no per-node attribution in the "
+                        "record (the headline executors should scope "
+                        "node='headline')"
+                    )
     # A run with only a stream (no SFT_LEDGER_PATH) still seals cleanly;
     # no-op when write_ledger above already sealed it.
     telemetry.seal_stream("complete", bench=out)

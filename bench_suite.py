@@ -766,9 +766,25 @@ def bench_sncb_dag(jax, jnp, grid, quick):
             times.append(time.perf_counter() - t0)
     dag_mod.uninstall()
     qserve_mod.uninstall()
+    extra = {"nodes": len(dag.dag_nodes), "results_per_rep": n_results}
+    # Per-node EPS columns from the attribution buckets (telemetry is
+    # enabled by the suite's capture loop; plain runs skip the column).
+    # Each node's rate is ITS events over ITS accumulated span time, so
+    # the table survives the record↔ledger round trip bit-identically
+    # (the SFT_BENCH_SMOKE contract twin in bench.py).
+    from spatialflink_tpu.telemetry import telemetry
+
+    rollup = telemetry.node_rollup() if telemetry.enabled else {}
+    node_eps = {}
+    for nname, b in rollup.items():
+        span_us = float(b.get("span_us") or 0.0)
+        ev = int(b.get("events") or 0)
+        if nname != "(unscoped)" and span_us > 0 and ev > 0:
+            node_eps[nname] = round(ev / (span_us / 1e6), 1)
+    if node_eps:
+        extra["node_eps"] = node_eps
     return _result(
-        "sncb_dag_7node", reps * n_events, sum(times),
-        {"nodes": len(dag.dag_nodes), "results_per_rep": n_results},
+        "sncb_dag_7node", reps * n_events, sum(times), extra,
         spread=(min(times) * reps, max(times) * reps),
     )
 

@@ -490,6 +490,11 @@ class DataflowDAG:
     :class:`WindowedDataflowDriver` (pass a configured one for
     checkpoint/overload/retry; default = the strict plain loop)."""
 
+    #: Driver-level node-attribution label (driver.bind reads it):
+    #: shared-source/sink/checkpoint work outside the per-node walk
+    #: tags "dag", the walk's inner scopes tag each node.
+    telemetry_node = "dag"
+
     def __init__(self, conf, grid, nodes: Iterable[DagNode], *,
                  out_dir: Optional[str] = None,
                  sinks: Optional[Dict[str, TransactionalFileSink]] = None,
@@ -714,19 +719,28 @@ class DataflowDAG:
                             events=len(win.events)):
             for name in self.dag_nodes:
                 node = self._nodes[name]
-                res = self._run_node(node, win, results)
-                results[name] = res
-                st = self._nstate[name]
-                n = 0
-                sink = self.sink[name]
-                for line in node.render(res, win.start, win.end):
-                    sink.stage(line)
-                    n += 1
-                st["windows"] += 1
-                st["results"] += n
-                counts[name] = n
-                if wm is not None:
-                    st["lag"].observe(float(max(int(wm) - win.end, 0)))
+                # Node-scoped attribution (PR 16): the scope tags every
+                # span/byte/compile/fault inside the walk with this
+                # node, and the `node.<name>` container span is what
+                # attribute_nodes/per-node EPS read. Scope enters FIRST
+                # so the span's own exit is still inside it.
+                with telemetry.scope(name), \
+                        telemetry.span(f"node.{name}", start=win.start,
+                                       events=len(win.events)):
+                    res = self._run_node(node, win, results)
+                    results[name] = res
+                    st = self._nstate[name]
+                    n = 0
+                    sink = self.sink[name]
+                    for line in node.render(res, win.start, win.end):
+                        sink.stage(line)
+                        n += 1
+                    st["windows"] += 1
+                    st["results"] += n
+                    counts[name] = n
+                    if wm is not None:
+                        st["lag"].observe(
+                            float(max(int(wm) - win.end, 0)))
         return DagWindowResult(win.start, win.end, counts)
 
     def _run_node(self, node: DagNode, win, results):
@@ -1080,11 +1094,23 @@ def run_chaos_child(workdir: str) -> int:
     ``workdir``. Resumes automatically when the checkpoint exists.
     ``SFT_OVERLOAD_POLICY``/``SFT_PIPELINE``/``SFT_FAULT_PLAN`` arm via
     env (faults at import; the policy is installed on the driver here
-    with ``source_pausable=False`` so its shed path really sheds)."""
+    with ``source_pausable=False`` so its shed path really sheds).
+
+    ``SFT_LEDGER_STREAM``/``SFT_LEDGER_PATH`` arm telemetry the way
+    bench.py does: per-node attribution from the DAG's node scopes
+    rides the stream's checkpoints, so a kill mid-run leaves a
+    recoverable capture WITH node blocks. Each child invocation needs
+    its OWN stream path — ``enable`` truncates, so a resume reusing the
+    killed child's path would destroy the truncated evidence."""
     import os
 
     from spatialflink_tpu import overload as overload_mod
+    from spatialflink_tpu.telemetry import telemetry
 
+    stream_path = os.environ.get("SFT_LEDGER_STREAM")
+    ledger_path = os.environ.get("SFT_LEDGER_PATH")
+    if stream_path or ledger_path:
+        telemetry.enable(stream_path=stream_path)
     ctrl = None
     spec = os.environ.get("SFT_OVERLOAD_POLICY")
     if spec:
@@ -1106,7 +1132,56 @@ def run_chaos_child(workdir: str) -> int:
     n = 0
     for res in dag.run(source(), driver=driver):
         n += sum(res.counts.values())
+    if ledger_path:
+        telemetry.write_ledger(ledger_path)  # seals "complete"
+    elif stream_path:
+        telemetry.seal_stream("complete")
     return n
+
+
+def run_mesh_child() -> int:
+    """The dag-smoke mesh leg: two collective-bearing sharded kernels
+    on an 8-virtual-device CPU mesh under telemetry, proving the
+    trace-time collective accounting (parallel/sharded.py →
+    ``telemetry.account_collective``) lands in the sealed stream the
+    parent gates on. Exit 0 iff accounted collective bytes > 0."""
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spatialflink_tpu.parallel.mesh import data_mesh
+    from spatialflink_tpu.parallel.sharded import (
+        sharded_range_query,
+        sharded_traj_stats,
+    )
+    from spatialflink_tpu.telemetry import telemetry
+
+    telemetry.enable(stream_path=os.environ.get("SFT_LEDGER_STREAM"))
+    mesh = data_mesh(8)
+    n = 64
+    rng = np.random.default_rng(7)
+    xy = jnp.asarray(rng.random((n, 2)), dtype=jnp.float32)
+    valid = jnp.ones((n,), bool)
+    flags = jnp.ones((n,), bool)
+    q = jnp.asarray(rng.random((4, 2)), dtype=jnp.float32)
+    # (oid, ts)-sorted trajectory slab: 8 oids × 8 points each.
+    oid = jnp.asarray(np.repeat(np.arange(8), 8).astype(np.int32))
+    ts = jnp.asarray(np.tile(np.arange(8), 8).astype(np.int32))
+    with telemetry.scope("meshleg"), telemetry.span("node.meshleg",
+                                                    events=n):
+        keep, _ = sharded_range_query(mesh, xy, valid, flags, q, 0.25)
+        spatial, temporal, count, speed = sharded_traj_stats(
+            mesh, xy, ts, oid, valid, num_segments=8
+        )
+        # True sync: materialize so the programs actually ran.
+        np.asarray(keep), np.asarray(count)
+    gauges = telemetry.collective_gauges()
+    nbytes = int(gauges["bytes"]) if gauges else 0
+    telemetry.seal_stream("complete")
+    print(f"dag-mesh-child: collective bytes {nbytes} "
+          f"across {int(gauges['calls']) if gauges else 0} call(s)")
+    return 0 if nbytes > 0 else 1
 
 
 def chaos_smoke() -> int:
@@ -1115,7 +1190,16 @@ def chaos_smoke() -> int:
     byte-identical. The abort fault fires on the unit commit's SECOND
     sub-append (``dag.commit`` ``at: 2``) — after one sink's bytes are
     durable and before the next sink's, the exact cut the atomic unit
-    checkpoint exists to close. Exit 0 on equality."""
+    checkpoint exists to close. Exit 0 on equality.
+
+    The same smoke is the per-commit attribution gate: every child runs
+    with ``SFT_LEDGER_STREAM`` armed, the clean child's SEALED stream
+    must carry all seven node buckets in its final checkpoint snapshot,
+    the killed child's TRUNCATED stream must recover with its node
+    blocks intact (``tools/sfprof recover`` carries node tags through
+    reconstruction), and the ``--mesh-child`` leg (8-virtual-device CPU
+    mesh) must account nonzero collective bytes into ITS sealed
+    stream."""
     import json
     import os
     import subprocess
@@ -1125,20 +1209,35 @@ def chaos_smoke() -> int:
     env_base = dict(os.environ)
     env_base.pop("SFT_FAULT_PLAN", None)
     env_base.pop("SFT_PIPELINE", None)
+    env_base.pop("SFT_LEDGER_PATH", None)
     # CPU-only, never dial the axon tunnel (the CLAUDE.md outage rule).
     env_base["PALLAS_AXON_POOL_IPS"] = ""
     env_base["JAX_PLATFORMS"] = "cpu"
     env_base["SFT_OVERLOAD_POLICY"] = json.dumps(SMOKE_OVERLOAD_POLICY)
+    # Flush the ledger stream at every window boundary so the killed
+    # child's truncated stream deterministically carries node blocks.
+    env_base["SFT_LEDGER_STREAM_INTERVAL_S"] = "0"
 
-    def child(workdir, plan=None):
+    def child(workdir, plan=None, stream=None):
         env = dict(env_base)
         if plan is not None:
             env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        if stream is not None:
+            env["SFT_LEDGER_STREAM"] = stream
+        else:
+            env.pop("SFT_LEDGER_STREAM", None)
         return subprocess.run(
             [sys.executable, "-m", "spatialflink_tpu.dag",
              "--chaos-child", workdir],
             env=env, capture_output=True, text=True, timeout=600,
         )
+
+    def last_checkpoint_snapshot(stream):
+        from tools.sfprof import stream as stream_mod
+
+        records, _tail = stream_mod.read_records(stream)
+        snaps = [r for r in records if r.get("t") == "checkpoint"]
+        return (snaps[-1].get("snapshot") or {}) if snaps else {}
 
     node_names = ("q1", "q2", "q3", "q4", "q5", "staytime", "qserve")
     with tempfile.TemporaryDirectory(prefix="sft_dag_") as tmp:
@@ -1146,18 +1245,41 @@ def chaos_smoke() -> int:
         chaos_dir = os.path.join(tmp, "chaos")
         os.makedirs(clean_dir)
         os.makedirs(chaos_dir)
-        p = child(clean_dir)
+        clean_stream = os.path.join(tmp, "clean.jsonl")
+        p = child(clean_dir, stream=clean_stream)
         if p.returncode != 0:
             print("dag-smoke: clean run failed\n" + p.stderr[-2000:])
             return 1
+        # Attribution gate: the sealed clean stream's final checkpoint
+        # must carry every DAG node's telemetry bucket.
+        snap_nodes = last_checkpoint_snapshot(clean_stream).get(
+            "nodes") or {}
+        missing = sorted(set(node_names) - set(snap_nodes))
+        if missing:
+            print(f"dag-smoke: sealed stream is missing per-node "
+                  f"attribution for {missing} (has "
+                  f"{sorted(snap_nodes)})")
+            return 1
         # The between-sink-commits cut: sub-commit #2 of a unit commit.
+        chaos_stream = os.path.join(tmp, "chaos_killed.jsonl")
         p = child(chaos_dir,
-                  plan=[{"point": "dag.commit", "kind": "abort", "at": 2}])
+                  plan=[{"point": "dag.commit", "kind": "abort", "at": 2}],
+                  stream=chaos_stream)
         if p.returncode != 137:
             print(f"dag-smoke: expected the armed child to die with exit "
                   f"137, got {p.returncode}\n" + p.stderr[-2000:])
             return 1
-        p = child(chaos_dir)  # resume from the unit checkpoint
+        # The killed child's TRUNCATED stream must recover with node
+        # blocks intact (fresh path for the resume: enable truncates).
+        from tools.sfprof import stream as stream_mod
+
+        _doc, info = stream_mod.recover(chaos_stream)
+        if not info.get("nodes_recovered"):
+            print("dag-smoke: killed child's stream recovered with no "
+                  "per-node attribution")
+            return 1
+        p = child(chaos_dir,
+                  stream=os.path.join(tmp, "chaos_resume.jsonl"))
         if p.returncode != 0:
             print("dag-smoke: resume run failed\n" + p.stderr[-2000:])
             return 1
@@ -1178,8 +1300,33 @@ def chaos_smoke() -> int:
         if total == 0:
             print("dag-smoke: every sink is empty (vacuous pass)")
             return 1
+        # Mesh leg: collective accounting must land nonzero bytes in a
+        # sealed stream on the 8-virtual-device CPU mesh.
+        mesh_stream = os.path.join(tmp, "mesh.jsonl")
+        env = dict(env_base)
+        env["SFT_LEDGER_STREAM"] = mesh_stream
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        p = subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.dag", "--mesh-child"],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        if p.returncode != 0:
+            print("dag-smoke: mesh leg failed\n"
+                  + p.stdout[-500:] + p.stderr[-2000:])
+            return 1
+        coll = last_checkpoint_snapshot(mesh_stream).get(
+            "collectives") or {}
+        if int(coll.get("bytes") or 0) <= 0:
+            print("dag-smoke: mesh leg's sealed stream carries no "
+                  f"collective bytes (got {coll!r})")
+            return 1
     print("dag-smoke: kill-between-sink-commits/resume egress "
-          f"byte-identical on all {len(node_names)} sinks — OK")
+          f"byte-identical on all {len(node_names)} sinks; per-node "
+          "attribution sealed + recovered; mesh collectives "
+          "accounted — OK")
     return 0
 
 
@@ -1194,14 +1341,19 @@ def main(argv=None) -> int:
                     help="run the 7-node SNCB DAG kill/resume smoke")
     ap.add_argument("--chaos-child", metavar="DIR", default=None,
                     help="internal: one SNCB DAG run rooted at DIR")
+    ap.add_argument("--mesh-child", action="store_true",
+                    help="internal: the smoke's 8-device collective-"
+                         "accounting leg")
     args = ap.parse_args(argv)
     if args.chaos_child:
         n = run_chaos_child(args.chaos_child)
         print(f"dag-child: {n} records staged")
         return 0
+    if args.mesh_child:
+        return run_mesh_child()
     if args.smoke:
         return chaos_smoke()
-    ap.error("pass --smoke (or internal --chaos-child)")
+    ap.error("pass --smoke (or internal --chaos-child / --mesh-child)")
     return 2
 
 

@@ -253,6 +253,7 @@ class WindowedDataflowDriver:
         self.dial_deadline_s = resolve_dial_deadline_s(dial_deadline_s)
         self._dialed = False
         self.op = None
+        self._node_label: Optional[str] = None  # set by bind()
         self.process: Optional[Callable] = None
         self.fallback: Optional[Callable] = None
         self.backend = "device"
@@ -288,6 +289,12 @@ class WindowedDataflowDriver:
         skipped building it); ``fallback`` the numpy/native route used
         after device-path failover."""
         self.attach(op)
+        # Node-attribution label for everything this driver processes:
+        # the operator names itself via `telemetry_node` (the DAG says
+        # "dag"); else its class name. Inner scopes (the DAG's per-node
+        # walk) override it — innermost wins.
+        self._node_label = (getattr(op, "telemetry_node", None)
+                            or type(op).__name__)
         self.process = process
         self.fallback = fallback if self.failover else None
         if self.backend == "fallback" and self.fallback is None:
@@ -579,7 +586,11 @@ class WindowedDataflowDriver:
             # The injection point sits INSIDE the dial guard: a
             # hang-kind fault here rehearses exactly the wedge the
             # watchdog bounds (a tunnel stalling the overlapped ship).
-            with self._dial_guard(True):
+            # Scope the dispatch only (never across a yield — a
+            # suspended generator must not leak its node tag to the
+            # consumer's thread-local stack).
+            with telemetry.scope(self._node_label), \
+                    self._dial_guard(True):
                 if faults.armed:  # chaos injection point (faults.py)
                     faults.hit("pipeline.ship")
                 work = pipe["compute"](win)
@@ -600,9 +611,10 @@ class WindowedDataflowDriver:
         ctrl = self.overload
         breaker = ctrl.breaker if ctrl is not None else None
         try:
-            if faults.armed:  # chaos injection point (faults.py)
-                faults.hit("pipeline.fetch")
-            result = pipe["fetch"](work)
+            with telemetry.scope(self._node_label):
+                if faults.armed:  # chaos injection point (faults.py)
+                    faults.hit("pipeline.fetch")
+                result = pipe["fetch"](work)
         except (KeyboardInterrupt, SystemExit):
             raise
         except CheckpointCorruptError:
@@ -635,6 +647,14 @@ class WindowedDataflowDriver:
     # -- per-window processing (retry → failover → crash) ----------------------
 
     def _process_window(self, win):
+        # Operator-level node attribution: everything in the retry →
+        # failover ladder (device bytes, compiles, kernel rows, fault
+        # hits) tags the bound operator's label. The DAG's per-node
+        # scopes nest inside and win (innermost-wins).
+        with telemetry.scope(self._node_label):
+            return self._process_window_inner(win)
+
+    def _process_window_inner(self, win):
         ctrl = self.overload
         breaker = ctrl.breaker if ctrl is not None else None
         # The circuit breaker generalizes the permanent failover below:

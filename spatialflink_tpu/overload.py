@@ -430,6 +430,11 @@ class OverloadController:
         # neither lock is ever requested while the other is held.
         self._lock = threading.RLock()
         self._pending_emits: list = []
+        # Shed charges for telemetry.record_shed (the per-node/global
+        # conservation twin of self.shed) — queued under the lock,
+        # drained after release exactly like _pending_emits: record_shed
+        # takes the telemetry lock, which must never nest inside ours.
+        self._pending_sheds: list = []
         self.breaker = (CircuitBreaker(policy, tel)
                         if policy.breaker_failures > 0
                         or policy.breaker_link_ratio is not None else None)
@@ -548,6 +553,7 @@ class OverloadController:
         rec = self.shed.setdefault(reason, {"events": 0, "bytes": 0})
         rec["events"] += int(n_events)
         rec["bytes"] += int(nbytes)
+        self._pending_sheds.append((int(n_events), int(nbytes)))
         self._sheds_since_fire += 1
         if reason == "admission" and not self._admission_shedding:
             self._admission_shedding = True
@@ -585,6 +591,7 @@ class OverloadController:
                 limit = None if b is None else b.get("max_queries")
                 if limit is not None and rec["queries_live"] >= limit:
                     rec["queries_shed"] += 1
+                    self._pending_sheds.append((1, 0))
                     self._tenant_shed_this_window.add(str(cls))
                     if cls not in self._tenant_shedding:
                         self._tenant_shedding.add(str(cls))
@@ -623,15 +630,21 @@ class OverloadController:
                 if limit is None or n <= limit:
                     return int(n)
                 shed = int(n) - int(limit)
+                shed_delta = shed  # telemetry twin charge (see below)
                 if window_start is not None:
                     prev = self._tenant_window_charge.get(str(cls))
                     if prev is not None and prev[0] == int(window_start):
                         rec["results_shed"] -= prev[1]
                         rec["degraded_windows"] -= 1
+                        # Retry re-charge: the twin must replace too, so
+                        # queue the NET delta (may be negative).
+                        shed_delta = shed - prev[1]
                     self._tenant_window_charge[str(cls)] = (
                         int(window_start), shed,
                     )
                 rec["results_shed"] += shed
+                if shed_delta:
+                    self._pending_sheds.append((shed_delta, 0))
                 rec["degraded_windows"] += 1
                 self._tenant_shed_this_window.add(str(cls))
                 if cls not in self._tenant_shedding:
@@ -821,7 +834,14 @@ class OverloadController:
     def _drain_emits(self):
         while True:
             with self._lock:
+                sheds, self._pending_sheds = self._pending_sheds, []
+            for n_events, nbytes in sheds:
+                # Outside our lock (record_shed takes telemetry's).
+                self.tel.record_shed(n_events, nbytes)
+            with self._lock:
                 if not self._pending_emits:
+                    if self._pending_sheds:
+                        continue  # an emit raced in a shed; re-drain
                     return
                 name, args = self._pending_emits.pop(0)
             if self.tel.enabled:

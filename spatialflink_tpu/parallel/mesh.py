@@ -36,3 +36,20 @@ def data_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     n = num_devices if num_devices is not None else len(devices)
     return make_mesh((n,), ("data",), devices)
+
+
+def payload_nbytes(*arrays) -> int:
+    """Logical payload bytes of the given arrays, from static shape/dtype
+    metadata only — never touches device buffers, so it is safe in
+    per-window host paths (the ``telemetry.account_collective`` feeder;
+    a replicated operand's bytes ARE its broadcast payload)."""
+    total = 0
+    for a in arrays:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * int(
+            np.dtype(dtype).itemsize
+        )
+    return total

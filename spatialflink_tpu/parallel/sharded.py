@@ -24,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 # Handles both the symbol's home and the check_rep→check_vma rename.
@@ -33,6 +34,12 @@ from spatialflink_tpu.ops.distances import point_point_distance
 from spatialflink_tpu.ops.join import JoinResult, join_kernel
 from spatialflink_tpu.ops.knn import KnnResult
 from spatialflink_tpu.ops.range import _emit_mask
+from spatialflink_tpu.parallel.mesh import payload_nbytes
+from spatialflink_tpu.telemetry import telemetry
+
+
+def _itemsize(dtype) -> int:
+    return int(np.dtype(dtype).itemsize)
 
 
 def mesh_from_config(shape):
@@ -115,10 +122,56 @@ def sharded_window_kernel(mesh, kernel, data_idx, n_args, topk=False,
     Wrappers are cached per (mesh, kernel, statics) so repeated windows
     reuse the compiled program.
     """
-    return _cached_sharded_window(
+    fn = _cached_sharded_window(
         mesh, kernel, tuple(data_idx), n_args,
         tuple(sorted(statics.items())), topk, reduce,
     )
+    return _AccountedProgram(fn, tuple(data_idx), topk, reduce,
+                             dict(statics))
+
+
+class _AccountedProgram:
+    """Accounts the generic mesh program's collective footprint at call
+    time (host-side, from the concrete args' static shapes), then calls
+    the cached jitted program. Attribute access forwards to the jit
+    object so ``instrument_jit``'s lower()/cost hooks keep working.
+
+    topk → the kernel's axis_name hook pmin-reduces its per-object
+    minima + representative tables ((num_segments,) each); reduce → a
+    psum of the replicated segment reduction; elementwise → no explicit
+    collective, so the replicated operands' broadcast is the traffic.
+    """
+
+    __slots__ = ("_fn", "_data_idx", "_topk", "_reduce", "_statics")
+
+    def __init__(self, fn, data_idx, topk, reduce, statics):
+        self._fn = fn
+        self._data_idx = frozenset(data_idx)
+        self._topk = topk
+        self._reduce = reduce
+        self._statics = statics
+
+    def __call__(self, *args, **kwargs):
+        if telemetry.enabled:
+            rep = payload_nbytes(*(
+                a for i, a in enumerate(args) if i not in self._data_idx
+            ))
+            if self._topk or self._reduce:
+                nseg = int(self._statics.get("num_segments", 0))
+                ref = (args[min(self._data_idx)]
+                       if self._data_idx and args else None)
+                elem = (_itemsize(ref.dtype)
+                        if ref is not None and hasattr(ref, "dtype") else 8)
+                table = 2 * nseg * elem if nseg else max(rep, elem)
+                telemetry.account_collective(
+                    "pmin" if self._topk else "psum", table, axis="data"
+                )
+            if rep:
+                telemetry.account_collective("broadcast", rep, axis="data")
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
 
 
 def sharded_range_query(
@@ -133,6 +186,11 @@ def sharded_range_query(
     """Data-parallel range query. ``xy``/``valid``/``flags`` shard over
     ``data``; the query set is replicated. Returns (keep, min_dist) sharded
     like the inputs."""
+    # Fully local compute: the replicated query set's broadcast is the
+    # only cross-chip traffic.
+    telemetry.account_collective(
+        "broadcast", payload_nbytes(query_xy), axis="data"
+    )
 
     def local(xy_l, valid_l, flags_l, q):
         d = point_point_distance(xy_l[:, None, :], q[None, :, :])
@@ -162,6 +220,11 @@ def sharded_range_query_2d(
     over the ``query`` axis merges per-slice hits — the collective pattern
     for large query sets (e.g. 1k query polygons sharded across chips).
     Returns (keep sharded over data, min_dist sharded over data)."""
+    # pmin of each data tile's per-point min-dist vector across the
+    # query axis (one lane per point).
+    telemetry.account_collective(
+        "pmin", int(xy.shape[0]) * _itemsize(xy.dtype), axis="query"
+    )
 
     def local(xy_l, valid_l, flags_l, q_l):
         d = point_point_distance(xy_l[:, None, :], q_l[None, :, :])
@@ -197,6 +260,14 @@ def sharded_knn(
     so the (num_segments,) minima table is the only cross-chip traffic —
     one psum-sized all-reduce instead of the reference's windowAll
     re-shuffle of every candidate."""
+    # Two (num_segments,) pmin tables (minima + packed representatives)
+    # plus the replicated query point's broadcast.
+    telemetry.account_collective(
+        "pmin", 2 * int(num_segments) * _itemsize(xy.dtype), axis="data"
+    )
+    telemetry.account_collective(
+        "broadcast", payload_nbytes(query_xy), axis="data"
+    )
 
     from spatialflink_tpu.ops.knn import _topk_from_point_dists
 
@@ -291,6 +362,18 @@ def sharded_knn_multi(
     query sets too large for one chip's flag-table memory. On a 2-D mesh
     Q must divide the query-axis size."""
     query_sharded = "query" in mesh.shape
+    # One batched pmin per query lane (two (num_segments,) tables each);
+    # on 1-D meshes the query batch + flag tables replicate (broadcast).
+    lanes = int(query_xy.shape[0])
+    telemetry.account_collective(
+        "pmin", 2 * lanes * int(num_segments) * _itemsize(xy.dtype),
+        axis="data", calls=lanes,
+    )
+    if not query_sharded:
+        telemetry.account_collective(
+            "broadcast", payload_nbytes(query_xy, flags_tables),
+            axis="data",
+        )
     fn = _cached_knn_multi(mesh, k, num_segments, query_sharded)
     return fn(xy, valid, cell, flags_tables, oid, query_xy, radius)
 
@@ -369,6 +452,18 @@ def sharded_registry_bucket(
     (top-k rows, counts, overflow) are bit-identical to the
     single-device ``registry_bucket_kernel`` (CPU-mesh parity pinned in
     tests/test_qserve.py)."""
+    # Same batched-pmin shape as sharded_knn_multi; the whole standing
+    # bucket (coords, radii, flag tables, validity) replicates.
+    lanes = int(query_xy.shape[0])
+    telemetry.account_collective(
+        "pmin", 2 * lanes * int(num_segments) * _itemsize(xy.dtype),
+        axis="data", calls=lanes,
+    )
+    telemetry.account_collective(
+        "broadcast",
+        payload_nbytes(query_xy, radius, flags_tables, query_valid),
+        axis="data",
+    )
     fn = _cached_registry_bucket(mesh, k, num_segments)
     return fn(xy, valid, cell, flags_tables, oid, query_xy, radius,
               query_valid)
@@ -393,6 +488,17 @@ def sharded_traj_stats(
     the single-device ops.trajectory.traj_stats_kernel.
     """
     from spatialflink_tpu.ops.distances import point_point_distance
+
+    # Ring halo (every shard ships its last xy/ts/oid/valid row) plus
+    # three (num_segments,) psum tables (spatial, temporal, count).
+    ndev = int(mesh.shape["data"])
+    halo = ndev * (2 * _itemsize(xy.dtype) + _itemsize(ts.dtype)
+                   + _itemsize(oid.dtype) + 1)
+    telemetry.account_collective("ppermute", halo, axis="data", calls=4)
+    telemetry.account_collective(
+        "psum", int(num_segments) * (2 * _itemsize(xy.dtype) + 4),
+        axis="data", calls=3,
+    )
 
     def local(xy_l, ts_l, oid_l, valid_l):
         # The ppermute ring needs a STATIC shard count; read it from the
@@ -527,6 +633,16 @@ def sharded_join_window_compact(
     multiple of the data-axis size."""
     n_shards = int(mesh.shape["data"])
     max_pairs = int(max_pairs) + (-int(max_pairs)) % n_shards
+    # Replicated right side broadcast once per window; the compaction
+    # protocol all-reduces three int32 scalars (total, max_local, over).
+    telemetry.account_collective(
+        "broadcast",
+        payload_nbytes(right_xy, right_valid, right_cells,
+                       neighbor_offsets),
+        axis="data",
+    )
+    telemetry.account_collective("psum", 8, axis="data", calls=2)
+    telemetry.account_collective("pmax", 4, axis="data")
     return _cached_sharded_join_compact(mesh, grid_n, cap, max_pairs)(
         left_xy, left_valid, left_cell_xy_idx,
         right_xy, right_valid, right_cells, neighbor_offsets, radius,
@@ -549,6 +665,14 @@ def sharded_join(
 ) -> JoinResult:
     """Grid-hash join with the left side sharded over ``data`` and the
     (smaller) cell-sorted right side replicated."""
+    # Replicated right-side broadcast + the overflow-scalar psum.
+    telemetry.account_collective(
+        "broadcast",
+        payload_nbytes(right_xy_sorted, right_valid_sorted,
+                       right_cells_sorted, right_order, neighbor_offsets),
+        axis="data",
+    )
+    telemetry.account_collective("psum", 4, axis="data")
 
     def local(lxy, lvalid, lci, rxy, rvalid, rcells, rorder, offs):
         res = join_kernel(
@@ -627,6 +751,12 @@ def sharded_point_geometry_join_pruned(
     a shard truncates when its own count exceeds it); both overflow
     counters are psum-replicated. Bit-parity with single-device up to
     pair order (tests/test_join_pruned.py)."""
+    # Replicated geometry batch broadcast + two overflow-scalar psums.
+    telemetry.account_collective(
+        "broadcast", payload_nbytes(gverts, gev, gvalid, gbbox),
+        axis="data",
+    )
+    telemetry.account_collective("psum", 8, axis="data", calls=2)
     return _cached_sharded_pg_join(
         mesh, polygonal, block, cand, max_pairs, pair_cap, approx
     )(pxy, pvalid, gverts, gev, gvalid, gbbox, radius)
@@ -726,8 +856,9 @@ def sharded_traj_stats_pane(
     here re-partitions them into per-shard contiguous slices (sorted
     order makes each oid block a contiguous slice) padded to a common
     bucket. ``num_oids`` must divide by the mesh's ``data`` axis."""
-    import numpy as np
-
+    # Deliberately NO account_collective here: this is the documented
+    # zero-collective kernel (per-oid blocks are fully independent), and
+    # the mesh parity test asserts its accounted bytes are exactly zero.
     from spatialflink_tpu.utils.padding import next_bucket
 
     ndev = int(mesh.shape["data"])
@@ -780,6 +911,12 @@ def sharded_geometry_geometry_join_pruned(
     """Multi-chip grid-pruned geometry ⋈ geometry join — left side (host-
     locality-sorted) sharded over ``data``, right side replicated; same
     contracts as sharded_point_geometry_join_pruned."""
+    # Replicated right geometry batch broadcast + two overflow psums.
+    telemetry.account_collective(
+        "broadcast", payload_nbytes(bverts, bev, bvalid, bbbox),
+        axis="data",
+    )
+    telemetry.account_collective("psum", 8, axis="data", calls=2)
     return _cached_sharded_gg_join(
         mesh, a_polygonal, b_polygonal, block, cand, max_pairs, pair_cap,
         approx,

@@ -208,13 +208,19 @@ class PipelinedExecutor:
                  compute: Callable[[Any, Any], Any],
                  fetch: Callable[[List[Any]], Iterable],
                  label: str = "pipeline",
-                 spans: bool = False):
+                 spans: bool = False,
+                 node: Optional[str] = None):
         self.pol = pol
         self._ship_fn = ship
         self._compute_fn = compute
         self._fetch_fn = fetch
         self.label = label
         self.spans = spans
+        #: Node-attribution tag for the per-item work (None inherits the
+        #: caller's ambient scope — the executor runs on its thread, so
+        #: a driver/DAG scope already propagates; set it for standalone
+        #: pane engines with no driver above them).
+        self.node = node
         self.collapsed = False
 
     # -- stages (fault points live here) ---------------------------------------
@@ -288,7 +294,10 @@ class PipelinedExecutor:
             depth = 1 if self.collapsed else max(1, int(self.pol.depth))
             lag = 0 if self.collapsed else max(0, int(self.pol.fetch_lag))
             out: list = []
-            with maybe_span(f"window.{self.label}"):
+            # Scope covers the item's work only, never a yield — a
+            # suspended generator must not leak its tag to the consumer.
+            with telemetry.scope(self.node), \
+                    maybe_span(f"window.{self.label}"):
                 with maybe_span("ship"):
                     refill(depth)
                 item, staged = shipped.popleft()
@@ -309,7 +318,9 @@ class PipelinedExecutor:
             yield from out
             self._sync_collapse_state()
         if inflight:  # final drain: ONE true sync for the whole tail
-            yield from self._fetch(list(inflight))
+            with telemetry.scope(self.node):
+                tail = list(self._fetch(list(inflight)))
+            yield from tail
             inflight.clear()
 
 

@@ -500,6 +500,32 @@ class QServeOperator(SpatialOperator):
     def registry(self) -> QueryRegistry:
         return self.qserve_registry
 
+    def _eval_bucket(self, kernel, mesh, xy_d, valid_d, cell_d, oid_d,
+                     arrays, key, rung, cap, nseg, dtype):
+        """Dispatch ONE bucket's vmapped program (mesh or single-chip)
+        under its ``compute`` span — the bucket-evaluation unit the
+        node-attribution scope tags."""
+        with telemetry.span("compute", bucket=bucket_key_str(key)):
+            if mesh is not None:
+                from spatialflink_tpu.parallel.sharded \
+                    import sharded_registry_bucket
+
+                return sharded_registry_bucket(
+                    mesh, xy_d, valid_d, cell_d,
+                    arrays["tables"], oid_d,
+                    arrays["qxy"], arrays["radius"],
+                    arrays["qvalid"],
+                    k=rung, num_segments=nseg,
+                )
+            return kernel(
+                xy_d, valid_d, cell_d,
+                arrays["tables"], oid_d,
+                arrays["qxy"], arrays["radius"],
+                arrays["qvalid"],
+                k=rung, num_segments=nseg,
+                query_block=min(cap, 32),
+            )
+
     def _bucket_device_arrays(self, key, qs, cap, dtype):
         """Device-cached bucket operand set, keyed on (registry epoch,
         bucket version, rung, dtype) — churnless windows re-ship
@@ -630,49 +656,40 @@ class QServeOperator(SpatialOperator):
                     )
                     xy_d = self.device_xy(batch, dtype)
                 pending = []
+                # Bucket-level attribution only when STANDALONE: under
+                # the DAG the whole window already carries the "qserve"
+                # node scope, and splintering it per bucket would break
+                # the per-node conservation rollup into bucket shards.
+                standalone = telemetry.current_node() is None
                 for key in sorted(buckets):
                     qs = buckets[key]
-                    cap = pick_capacity(
-                        len(qs), reg.cap_max, minimum=QUERY_RUNG_MIN
-                    )
-                    telemetry.record_compaction(
-                        "qserve_bucket", cap, len(qs)
-                    )
-                    if self._last_rung.get(key) != cap:
-                        # A rung move is one (bounded) XLA compile —
-                        # worth an instant marker in the stream.
-                        self._last_rung[key] = cap
-                        telemetry.emit_instant(
-                            f"qserve_rung:{bucket_key_str(key)}",
-                            capacity=int(cap), live=len(qs),
+                    bucket_node = (f"qserve:{bucket_key_str(key)}"
+                                   if standalone else None)
+                    with telemetry.scope(bucket_node):
+                        cap = pick_capacity(
+                            len(qs), reg.cap_max,
+                            minimum=QUERY_RUNG_MIN
                         )
-                    arrays = self._bucket_device_arrays(
-                        key, qs, cap, dtype
-                    )
-                    rung = int(key[1])
-                    with telemetry.span(
-                        "compute", bucket=bucket_key_str(key)
-                    ):
-                        if mesh is not None:
-                            from spatialflink_tpu.parallel.sharded \
-                                import sharded_registry_bucket
-
-                            res = sharded_registry_bucket(
-                                mesh, xy_d, valid_d, cell_d,
-                                arrays["tables"], oid_d,
-                                arrays["qxy"], arrays["radius"],
-                                arrays["qvalid"],
-                                k=rung, num_segments=nseg,
+                        telemetry.record_compaction(
+                            "qserve_bucket", cap, len(qs)
+                        )
+                        if self._last_rung.get(key) != cap:
+                            # A rung move is one (bounded) XLA compile
+                            # — worth an instant marker in the stream.
+                            self._last_rung[key] = cap
+                            telemetry.emit_instant(
+                                f"qserve_rung:{bucket_key_str(key)}",
+                                capacity=int(cap), live=len(qs),
                             )
-                        else:
-                            res = kernel(
-                                xy_d, valid_d, cell_d,
-                                arrays["tables"], oid_d,
-                                arrays["qxy"], arrays["radius"],
-                                arrays["qvalid"],
-                                k=rung, num_segments=nseg,
-                                query_block=min(cap, 32),
-                            )
+                        arrays = self._bucket_device_arrays(
+                            key, qs, cap, dtype
+                        )
+                        rung = int(key[1])
+                        res = self._eval_bucket(
+                            kernel, mesh, xy_d, valid_d, cell_d,
+                            oid_d, arrays, key, rung, cap, nseg,
+                            dtype,
+                        )
                     pending.append((qs, res))
                 # ONE true sync for ALL buckets (the flush_pending
                 # idiom): every bucket's dispatch is in flight

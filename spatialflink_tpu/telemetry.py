@@ -58,13 +58,18 @@ from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 #: Run-ledger schema version (bump on any breaking change to the document
 #: layout). Twin constant: tools/sfprof/ledger.py:LEDGER_VERSION — the
 #: validator deliberately doesn't import this package, so bump BOTH
-#: (tests/test_sfprof.py cross-pins them).
-LEDGER_VERSION = 1
+#: (tests/test_sfprof.py cross-pins them). v2: per-node attribution
+#: (snapshot ``nodes`` block, kernel-row ``node`` column) + collective
+#: accounting (snapshot ``collectives`` block); v1 documents remain
+#: readable (the new blocks are additive and appear only when scoped).
+LEDGER_VERSION = 2
 
 #: Ledger-STREAM record-layout version (the JSONL segment format behind
 #: ``SFT_LEDGER_STREAM``). Twin constant: tools/sfprof/stream.py:
 #: STREAM_VERSION — same no-cross-import rule, same cross-pin test.
-STREAM_VERSION = 1
+#: v2: checkpoints carry the v2 snapshot blocks above; the grammar
+#: itself is unchanged, so v1 streams still recover.
+STREAM_VERSION = 2
 
 
 def _sanitize_nonfinite(value):
@@ -181,6 +186,31 @@ class _Span:
         return False
 
 
+class _Scope:
+    """Node-attribution scope: pushes a node name onto the emitting
+    thread's scope stack for the duration of the ``with`` block.
+    Innermost wins (``current_node`` reads the top), so the DAG's
+    per-node scopes override the driver's operator-level one."""
+
+    __slots__ = ("_tel", "node")
+
+    def __init__(self, tel: "Telemetry", node: str):
+        self._tel = tel
+        self.node = node
+
+    def __enter__(self):
+        tls = self._tel._tls
+        stack = getattr(tls, "stack", None)
+        if stack is None:
+            stack = tls.stack = []
+        stack.append(self.node)
+        return self
+
+    def __exit__(self, *exc):
+        self._tel._tls.stack.pop()
+        return False
+
+
 class Telemetry:
     """Process-global telemetry registry (the ``ops/counters.py`` idiom:
     one module singleton, ``enable()`` to opt in)."""
@@ -219,6 +249,10 @@ class Telemetry:
         # node_budgets).
         self.dag_provider = None
         self._lock = threading.RLock()
+        # Node-attribution scope stack: THREAD-CONFINED (a scope entered
+        # on the driver thread tags only that thread's emissions) so
+        # concurrent operator threads can never cross-tag each other.
+        self._tls = threading.local()
         self._reset_state()
 
     def _reset_state(self):
@@ -277,6 +311,24 @@ class Telemetry:
         self._pipeline: Dict[str, int] = {}
         # tids already named via a ph:"M" thread_name metadata event.
         self._named_tids: set = set()
+        # Per-node attribution buckets: node name (or None = unscoped) →
+        # counter dict. EVERY accounting site below updates exactly one
+        # bucket, so bucket totals sum EXACTLY to the untagged globals —
+        # the conservation invariant tests/test_dag.py asserts. The
+        # snapshot exports them (None → "(unscoped)") only once a real
+        # node has been seen, keeping un-scoped ledgers byte-compatible
+        # with the v1 reader.
+        self._node_acct: Dict[Optional[str], Dict[str, Any]] = {}
+        # Mesh-collective accounting (account_collective): kind →
+        # {"calls", "bytes"} plus per-axis byte totals — host-side
+        # trace-time estimates from static shapes, never a device
+        # round trip.
+        self._collectives: Dict[str, Dict[str, int]] = {}
+        self._collective_axes: Dict[str, int] = {}
+        # Overload shed accounting (record_shed): global twin of the
+        # per-node "shed_events"/"shed_bytes" bucket columns.
+        self.shed_events = 0
+        self.shed_bytes = 0
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -475,6 +527,62 @@ class Telemetry:
             self._stream_file = None
             self._stream_sealed = True
 
+    # -- node-attribution scope ------------------------------------------------
+
+    def scope(self, node: Optional[str]):
+        """Tag everything emitted by THIS thread inside the ``with``
+        block with ``node``: spans, instant events, h2d/d2h/wire bytes,
+        recompile detections, fault firings, shed counts, collective
+        bytes, and kernel-table rows. ``None`` is a no-op (the qserve
+        standalone-vs-DAG conditional), and an unset scope costs one
+        thread-local read at each accounting site — nothing per event."""
+        if node is None:
+            return _NULL_SPAN
+        return _Scope(self, str(node))
+
+    def current_node(self) -> Optional[str]:
+        """The innermost active scope's node name on this thread."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def _node_bucket(self, node: Optional[str]) -> Dict[str, Any]:
+        """This node's accounting bucket (caller holds the lock)."""
+        b = self._node_acct.get(node)
+        if b is None:
+            b = self._node_acct[node] = {
+                "spans": 0, "span_us": 0, "windows": 0, "events": 0,
+                "window_latency": FixedBucketLatency(),
+                "h2d_bytes": 0, "h2d_transfers": 0,
+                "d2h_bytes": 0, "d2h_transfers": 0,
+                "wire_raw_bytes": 0, "wire_coded_bytes": 0,
+                "wire_panes": 0,
+                "compiles": 0, "instants": 0, "fault_fires": 0,
+                "shed_events": 0, "shed_bytes": 0,
+                "collective_calls": 0, "collective_bytes": 0,
+                "dispatch_ns": 0, "kernel_calls": 0,
+            }
+        return b
+
+    def node_rollup(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-safe per-node counter rollup (the snapshot ``nodes``
+        block): one row per seen node, ``(unscoped)`` for emissions made
+        outside any scope. Empty dict while no real node has been
+        scoped — the byte-compat contract for un-scoped runs."""
+        with self._lock:
+            if not any(k is not None for k in self._node_acct):
+                return {}
+            out: Dict[str, Dict[str, Any]] = {}
+            for node, b in self._node_acct.items():
+                lat = b["window_latency"]
+                p50 = lat.percentile(0.50)
+                p95 = lat.percentile(0.95)
+                row = {k: v for k, v in b.items()
+                       if k != "window_latency"}
+                row["window_latency_p50_ms"] = None if p50 != p50 else p50
+                row["window_latency_p95_ms"] = None if p95 != p95 else p95
+                out[node if node is not None else "(unscoped)"] = row
+        return json_safe(out)
+
     # -- spans ----------------------------------------------------------------
 
     def span(self, name: str, **args):
@@ -488,6 +596,7 @@ class Telemetry:
     def _emit_span(self, name, t0_ns, dur_ns, args):
         if not self.enabled:  # disabled mid-span
             return
+        node = self.current_node()
         ev = {
             "name": name,
             "cat": "telemetry",
@@ -497,9 +606,25 @@ class Telemetry:
             "pid": os.getpid(),
             "tid": threading.get_ident(),
         }
+        if node is not None:
+            args = dict(args or ())
+            args.setdefault("node", node)
         if args:
             ev["args"] = json_safe(args)
         self._emit(ev)
+        with self._lock:
+            b = self._node_bucket(node)
+            b["spans"] += 1
+            b["span_us"] += dur_ns // 1000
+            if name.startswith("node."):
+                # The DAG's per-node container spans: per-node window
+                # count / event count / latency (window.* spans nested
+                # inside would double-count the same wall time).
+                b["windows"] += 1
+                ev_n = (args or {}).get("events")
+                if isinstance(ev_n, (int, float)):
+                    b["events"] += int(ev_n)
+                b["window_latency"].observe(dur_ns / 1e6)
         if name.startswith("window"):
             with self._lock:
                 self.window_latency.observe(dur_ns / 1e6)
@@ -513,6 +638,12 @@ class Telemetry:
         any other out-of-band markers ride this."""
         if not self.enabled:
             return
+        node = self.current_node()
+        if node is not None:
+            args = dict(args)
+            args.setdefault("node", node)
+        with self._lock:
+            self._node_bucket(node)["instants"] += 1
         self._emit({
             "name": name, "cat": "telemetry", "ph": "i",
             "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
@@ -557,6 +688,9 @@ class Telemetry:
         with self._lock:
             self.h2d_bytes += int(nbytes)
             self.h2d_transfers += 1
+            b = self._node_bucket(self.current_node())
+            b["h2d_bytes"] += int(nbytes)
+            b["h2d_transfers"] += 1
             if self._trace_file is not None:
                 self._write_trace({
                     "name": "h2d_bytes", "ph": "C",
@@ -574,6 +708,9 @@ class Telemetry:
         with self._lock:
             self.d2h_bytes += int(nbytes)
             self.d2h_transfers += 1
+            b = self._node_bucket(self.current_node())
+            b["d2h_bytes"] += int(nbytes)
+            b["d2h_transfers"] += 1
             if self._trace_file is not None:
                 self._write_trace({
                     "name": "d2h_bytes", "ph": "C",
@@ -604,11 +741,15 @@ class Telemetry:
         for leaf in jax.tree_util.tree_leaves(out):
             nbytes += getattr(leaf, "nbytes", 0)
         self.account_d2h(nbytes)
+        fetch_args: Dict[str, Any] = {"bytes": int(nbytes)}
+        node = self.current_node()
+        if node is not None:
+            fetch_args["node"] = node
         self._emit({
             "name": "fetch", "cat": "telemetry", "ph": "X",
             "ts": t0 // 1000, "dur": dur_ns // 1000,
             "pid": os.getpid(), "tid": threading.get_ident(),
-            "args": {"bytes": int(nbytes)},
+            "args": fetch_args,
         })
         return out
 
@@ -624,6 +765,7 @@ class Telemetry:
         first-call-only work, e.g. stash avals for cost capture)."""
         if not self.enabled:
             return False
+        node = self.current_node()
         warn_n = None
         with self._lock:
             seen = self._shapes_seen.setdefault(kernel, set())
@@ -631,15 +773,22 @@ class Telemetry:
                 return False
             seen.add(signature)
             self.compile_events.append((kernel, signature))
+            # A compile is charged to the node whose call triggered it
+            # (XLA compiles once per signature, so exactly one bucket
+            # gets it — node compile totals sum to the global count).
+            self._node_bucket(node)["compiles"] += 1
             if (len(seen) >= self.recompile_warn_threshold
                     and kernel not in self._warned_kernels):
                 self._warned_kernels.add(kernel)
                 warn_n = len(seen)
+        compile_args: Dict[str, Any] = {"signature": repr(signature)}
+        if node is not None:
+            compile_args["node"] = node
         self._emit({
             "name": f"compile:{kernel}", "cat": "telemetry", "ph": "i",
             "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
             "tid": threading.get_ident(), "s": "t",
-            "args": {"signature": repr(signature)},
+            "args": compile_args,
         })
         if warn_n is not None:
             warnings.warn(
@@ -674,8 +823,13 @@ class Telemetry:
         the hot path."""
         if not self.enabled:
             return
+        node = self.current_node()
         with self._lock:
-            key = (kernel, signature)
+            # Keyed per (kernel, signature, node): one kernel dispatched
+            # by two DAG nodes gets one row EACH, so per-node dispatch
+            # totals sum to the global table (conservation) instead of
+            # blending into one unattributable row.
+            key = (kernel, signature, node)
             st = self._kernel_stats.get(key)
             if st is None:
                 st = self._kernel_stats[key] = {
@@ -690,6 +844,9 @@ class Telemetry:
                 st["lower"] = lower_ctx
             st["calls"] += 1
             st["dispatch_ns"] += int(dur_ns)
+            b = self._node_bucket(node)
+            b["kernel_calls"] += 1
+            b["dispatch_ns"] += int(dur_ns)
 
     def capture_costs(self):
         """Lazy host-side XLA cost/memory analysis, once per (kernel,
@@ -721,8 +878,9 @@ class Telemetry:
         block (None until ``capture_costs`` runs). Sorted by steady
         dispatch time, heaviest first."""
         with self._lock:
-            rows = [
-                {
+            rows = []
+            for (kernel, sig, node), st in self._kernel_stats.items():
+                row = {
                     "kernel": kernel,
                     "signature": repr(sig),
                     "calls": st["calls"],
@@ -733,8 +891,11 @@ class Telemetry:
                     ),
                     "cost": st["cost"],
                 }
-                for (kernel, sig), st in self._kernel_stats.items()
-            ]
+                if node is not None:
+                    # v2 column, present only on scoped rows — un-scoped
+                    # runs emit the exact v1 row shape.
+                    row["node"] = node
+                rows.append(row)
         rows.sort(key=lambda r: (-r["steady_ns"], -r["dispatch_ns"],
                                  r["kernel"]))
         return json_safe(rows)
@@ -854,6 +1015,10 @@ class Telemetry:
             self.wire_raw_bytes += int(raw_bytes)
             self.wire_coded_bytes += int(coded_bytes)
             self.wire_panes += 1
+            b = self._node_bucket(self.current_node())
+            b["wire_raw_bytes"] += int(raw_bytes)
+            b["wire_coded_bytes"] += int(coded_bytes)
+            b["wire_panes"] += 1
 
     def record_pipeline(self, **counts: int):
         """Accumulate pipelined-executor counters (windows, overlapped,
@@ -883,6 +1048,67 @@ class Telemetry:
                 "ratio": (self.wire_raw_bytes / self.wire_coded_bytes
                           if self.wire_coded_bytes else None),
             }
+
+    # -- mesh-collective accounting (parallel/) --------------------------------
+
+    def account_collective(self, kind: str, nbytes: int,
+                           axis: Optional[str] = None,
+                           calls: int = 1):
+        """Logical bytes one mesh collective moves (psum / pmin / pmax /
+        ppermute / broadcast), accounted HOST-SIDE from static trace-time
+        shapes by the ``parallel/`` wrappers — never a device round trip.
+        These are the all-gather/halo baselines ROADMAP item 2's
+        grid-partitioned scale-out must beat; ``sfprof report`` surfaces
+        them as the ``collective`` phase and roofline signal."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._collectives.setdefault(
+                kind, {"calls": 0, "bytes": 0}
+            )
+            st["calls"] += int(calls)
+            st["bytes"] += int(nbytes)
+            if axis is not None:
+                self._collective_axes[axis] = (
+                    self._collective_axes.get(axis, 0) + int(nbytes)
+                )
+            b = self._node_bucket(self.current_node())
+            b["collective_calls"] += int(calls)
+            b["collective_bytes"] += int(nbytes)
+
+    def collective_gauges(self) -> Optional[Dict[str, Any]]:
+        """Collective summary (None before the first accounted
+        collective): total calls/bytes, per-kind and per-axis splits."""
+        with self._lock:
+            if not self._collectives:
+                return None
+            return json_safe({
+                "calls": sum(s["calls"]
+                             for s in self._collectives.values()),
+                "bytes": sum(s["bytes"]
+                             for s in self._collectives.values()),
+                "by_kind": {k: dict(s)
+                            for k, s in self._collectives.items()},
+                "by_axis": dict(self._collective_axes),
+            })
+
+    # -- overload shed accounting (overload.py) --------------------------------
+
+    def record_shed(self, n_events: int, nbytes: int = 0):
+        """Events the overload controller shed before they reached an
+        assembler. The controller keeps its own per-reason/per-tenant
+        breakdown (snapshot ``overload`` block); this global + per-node
+        twin exists so shed counts obey the same conservation invariant
+        as bytes and dispatch time (DAG sheds happen at the SHARED
+        source, so they land in the ``(unscoped)`` bucket)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.shed_events += int(n_events)
+            self.shed_bytes += int(nbytes)
+            b = self._node_bucket(self.current_node())
+            b["shed_events"] += int(n_events)
+            b["shed_bytes"] += int(nbytes)
 
     # -- watermark / lateness gauges ------------------------------------------
 
@@ -965,6 +1191,7 @@ class Telemetry:
             return
         with self._lock:
             self.fault_fires[point] = self.fault_fires.get(point, 0) + 1
+            self._node_bucket(self.current_node())["fault_fires"] += 1
         self.emit_instant(f"fault_fired:{point}", kind=kind, hit=int(hit))
         self.maybe_flush_stream(force=True)
 
@@ -1050,6 +1277,9 @@ class Telemetry:
             )
             if self.fault_fires:
                 out["faults"] = dict(self.fault_fires)
+            if self.shed_events or self.shed_bytes:
+                out["shed"] = {"events": self.shed_events,
+                               "bytes": self.shed_bytes}
             if self._pipeline:
                 out["pipeline"] = dict(self._pipeline)
             if self.wire_panes:
@@ -1080,6 +1310,15 @@ class Telemetry:
         link = self.link_gauges()
         if link is not None:
             out["link_probe"] = link
+        # v2 blocks, both strictly additive and absent until their
+        # producers run — an un-scoped, collective-free run snapshots
+        # the exact v1 shape (the byte-compat contract for old readers).
+        nodes = self.node_rollup()
+        if nodes:
+            out["nodes"] = nodes
+        coll = self.collective_gauges()
+        if coll is not None:
+            out["collectives"] = coll
         # Ablation taint rides EVERY snapshot — including the ledger-
         # stream checkpoints, so a recovered stream stays tainted and
         # sfprof's gates keep rejecting it after a crash.
@@ -1102,6 +1341,10 @@ def disable():
 
 def span(name: str, **args):
     return telemetry.span(name, **args)
+
+
+def scope(node: Optional[str]):
+    return telemetry.scope(node)
 
 
 def fetch(x):

@@ -32,13 +32,33 @@ def mesh():
     return data_mesh(8)
 
 
+@pytest.fixture
+def collectives():
+    """Enable telemetry around the test and hand back a probe for the
+    logical collective-byte gauge: every sharded wrapper must account its
+    mesh traffic host-side from static shapes (``sharded_traj_stats_pane``
+    is the one documented zero-collective kernel)."""
+    from spatialflink_tpu.telemetry import telemetry
+
+    telemetry.enable()
+
+    def probe():
+        g = telemetry.collective_gauges()
+        return 0 if g is None else int(g["bytes"])
+
+    try:
+        yield probe
+    finally:
+        telemetry.disable()
+
+
 def make_batch(rng, n=1000, bucket=2048):
     xy = rng.uniform(0, 10, size=(n, 2))
     oid = rng.integers(0, 100, n).astype(np.int32)
     return PointBatch.from_arrays(xy, None, oid, bucket=bucket).with_cells(GRID)
 
 
-def test_sharded_range_matches_single(rng, mesh):
+def test_sharded_range_matches_single(rng, mesh, collectives):
     batch = make_batch(rng)
     q = np.array([[5.0, 5.0], [1.0, 9.0]])
     r = 1.5
@@ -54,10 +74,11 @@ def test_sharded_range_matches_single(rng, mesh):
     )
     np.testing.assert_array_equal(np.asarray(keep_s), np.asarray(keep_1))
     np.testing.assert_allclose(np.asarray(dist_s), np.asarray(dist_1), rtol=1e-12)
+    assert collectives() > 0
 
 
 @pytest.mark.parametrize("k", [5, 50])
-def test_sharded_knn_matches_single(rng, mesh, k):
+def test_sharded_knn_matches_single(rng, mesh, k, collectives):
     batch = make_batch(rng)
     q = np.array([5.0, 5.0])
     r = 3.0
@@ -75,9 +96,10 @@ def test_sharded_knn_matches_single(rng, mesh, k):
     np.testing.assert_array_equal(np.asarray(res_s.segment), np.asarray(res_1.segment))
     np.testing.assert_array_equal(np.asarray(res_s.index), np.asarray(res_1.index))
     assert int(res_s.num_valid) == int(res_1.num_valid)
+    assert collectives() > 0
 
 
-def test_sharded_join_matches_single(rng, mesh):
+def test_sharded_join_matches_single(rng, mesh, collectives):
     a = make_batch(rng, n=700, bucket=1024)
     b = make_batch(rng, n=300, bucket=512)
     r = 0.6
@@ -99,6 +121,7 @@ def test_sharded_join_matches_single(rng, mesh):
         np.asarray(res_s.right_index), np.asarray(res_1.right_index)
     )
     assert int(res_s.overflow) == int(res_1.overflow)
+    assert collectives() > 0
 
 
 def test_2d_mesh_construction():
@@ -127,7 +150,7 @@ def test_sharded_knn_under_jit(rng, mesh):
     assert int(res.num_valid) == 10
 
 
-def test_sequence_parallel_traj_stats_matches_single(rng, mesh):
+def test_sequence_parallel_traj_stats_matches_single(rng, mesh, collectives):
     """Halo-exchange (ppermute) sequence parallelism: identical to the
     single-device segment kernel, including cross-shard boundary pairs."""
     from spatialflink_tpu.ops.trajectory import traj_stats_kernel
@@ -156,9 +179,10 @@ def test_sequence_parallel_traj_stats_matches_single(rng, mesh):
     np.testing.assert_array_equal(np.asarray(tp), np.asarray(single.temporal_length))
     np.testing.assert_array_equal(np.asarray(cnt), np.asarray(single.count))
     np.testing.assert_allclose(np.asarray(speed), np.asarray(single.avg_speed), rtol=1e-12)
+    assert collectives() > 0
 
 
-def test_sharded_knn_multi_matches_single(rng):
+def test_sharded_knn_multi_matches_single(rng, collectives):
     """2-D mesh multi-query kNN (points over data, queries over query)
     must equal the single-device knn_multi_query_kernel row for row."""
     from spatialflink_tpu.ops.knn import knn_multi_query_kernel
@@ -197,9 +221,10 @@ def test_sharded_knn_multi_matches_single(rng):
                                np.asarray(single.dist), rtol=5e-16)
     np.testing.assert_array_equal(np.asarray(sharded.num_valid),
                                   np.asarray(single.num_valid))
+    assert collectives() > 0
 
 
-def test_sharded_window_kernel_matches_single(rng, mesh):
+def test_sharded_window_kernel_matches_single(rng, mesh, collectives):
     """The generic mesh dispatcher (sharded_window_kernel) must produce
     bit-identical outputs to the module-cached single-device jit of the
     SAME fused kernel — the parity contract of the operator mesh path."""
@@ -224,9 +249,10 @@ def test_sharded_window_kernel_matches_single(rng, mesh):
     np.testing.assert_array_equal(np.asarray(keep_s), np.asarray(keep_1))
     np.testing.assert_allclose(np.asarray(dist_s), np.asarray(dist_1),
                                rtol=1e-12)
+    assert collectives() > 0
 
 
-def test_sharded_range_query_2d_matches_single(rng):
+def test_sharded_range_query_2d_matches_single(rng, collectives):
     """2-D mesh range query (points over data, queries over query with a
     pmin merge) must equal the single-device kernel — min-of-mins is
     exact, so bit-identical."""
@@ -264,7 +290,7 @@ def _compact_pair_set(res):
     }
 
 
-def test_sharded_join_window_compact_matches_single(rng, mesh):
+def test_sharded_join_window_compact_matches_single(rng, mesh, collectives):
     """Device-compacted sharded join: identical pair SET to the fused
     single-device join_window_compact (per-shard compaction reorders
     pairs; the set and the overflow counter must match exactly)."""
@@ -291,6 +317,7 @@ def test_sharded_join_window_compact_matches_single(rng, mesh):
     # but never under-report the true pair count.
     assert int(res_s.count) >= int(res_1.count)
     assert int(res_s.overflow) == int(res_1.overflow)
+    assert collectives() > 0
 
 
 def _square_polygons(rng, m, size=0.25):
@@ -308,7 +335,8 @@ def _square_polygons(rng, m, size=0.25):
     return out
 
 
-def test_sharded_point_geometry_join_pruned_matches_single(rng, mesh):
+def test_sharded_point_geometry_join_pruned_matches_single(rng, mesh,
+                                                           collectives):
     """Grid-pruned point ⋈ polygon join on the mesh: the point side
     shards contiguously; the pair set must equal the single-device
     pruned kernel (generous cand/max_pairs so both runs are exact)."""
@@ -335,9 +363,11 @@ def test_sharded_point_geometry_join_pruned_matches_single(rng, mesh):
     assert int(res_s.cand_overflow) == 0 and int(res_s.pair_overflow) == 0
     assert _compact_pair_set(res_s) == _compact_pair_set(res_1)
     assert _compact_pair_set(res_1)  # non-trivial window
+    assert collectives() > 0
 
 
-def test_sharded_geometry_geometry_join_pruned_matches_single(rng, mesh):
+def test_sharded_geometry_geometry_join_pruned_matches_single(rng, mesh,
+                                                              collectives):
     """Grid-pruned polygon ⋈ polygon join on the mesh: the left geometry
     batch shards over data (bucket 128 divides the 8-device axis); pair
     set parity with the single-device kernel."""
@@ -370,9 +400,10 @@ def test_sharded_geometry_geometry_join_pruned_matches_single(rng, mesh):
     assert int(res_s.cand_overflow) == 0 and int(res_s.pair_overflow) == 0
     assert _compact_pair_set(res_s) == _compact_pair_set(res_1)
     assert _compact_pair_set(res_1)
+    assert collectives() > 0
 
 
-def test_sharded_traj_stats_pane_matches_single(rng, mesh):
+def test_sharded_traj_stats_pane_matches_single(rng, mesh, collectives):
     """Trajectory-parallel pane tStats: contiguous oid blocks shard over
     data with zero collectives — rows must be bit-identical to the
     single-device pane kernel (x64 parity)."""
@@ -411,6 +442,9 @@ def test_sharded_traj_stats_pane_matches_single(rng, mesh):
                                   np.asarray(single.temporal))
     np.testing.assert_array_equal(np.asarray(sharded.count),
                                   np.asarray(single.count))
+    # The documented zero-collective mesh kernel: its contiguous oid
+    # shards are fully independent, so accounted bytes must be exactly 0.
+    assert collectives() == 0
 
 
 def test_initialize_distributed_noop_single_process(monkeypatch):

@@ -201,12 +201,20 @@ def test_sharded_registry_bucket_matches_single_device(rng):
         static_argnames=("k", "num_segments", "query_block"),
     )(*args, k=8, num_segments=64, query_block=8)
     mesh = make_mesh((8,), ("data",))
-    sres = sharded_registry_bucket(mesh, *args, k=8, num_segments=64)
+    telemetry.enable()
+    try:
+        sres = sharded_registry_bucket(mesh, *args, k=8, num_segments=64)
+        gauges = telemetry.collective_gauges()
+    finally:
+        telemetry.disable()
     for field in ("dist", "segment", "index", "num_valid", "within"):
         np.testing.assert_array_equal(
             np.asarray(getattr(res, field)),
             np.asarray(getattr(sres, field)), err_msg=field,
         )
+    # The mesh path must account its logical collective traffic
+    # (pmin merge + replicated query broadcast) host-side.
+    assert gauges is not None and int(gauges["bytes"]) > 0
 
 
 def test_range_bucket_overflow_counter():

@@ -22,7 +22,7 @@ module rebuilds that containment from the flat event stream:
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 #: slack (µs) for ts/dur each being floored from ns independently.
 _FLOOR_SLACK_US = 1
@@ -102,6 +102,71 @@ def attribute_windows(events: List[dict]) -> Tuple[List[dict], Dict[str, dict]]:
         for name, us in win["phases"].items():
             agg["phases"][name] = agg["phases"].get(name, 0) + us
     return windows, ops
+
+
+def attribute_nodes(events: List[dict]) -> Dict[str, dict]:
+    """Per-node rollup from the DAG's ``node.<name>`` container spans
+    (PR 16 convention: each node's walk inside a ``window.dag`` span is
+    wrapped in ``node.<name>`` under ``telemetry.scope(node)``).
+
+    Returns ``{node: {"windows", "dur_us", "events", "phases",
+    "unattributed_us", "eps"}}`` using the same top-level-children
+    containment as :func:`attribute_windows` — a span nested inside
+    another child is already covered by its parent's dur. The node name
+    comes from the span's ``args.node`` tag (falling back to the name
+    suffix), so renamed scopes and spans can never disagree.
+
+    Conservation: every µs in a node's ``dur_us`` lies inside exactly
+    one ``node.*`` span, and node spans never nest in each other (the
+    DAG walks nodes sequentially), so the rollup's total dur is exactly
+    the time the DAG spent in nodes — the remainder of each
+    ``window.dag`` span is the shared-source/sink residue, reported by
+    :func:`attribute_windows` as usual. The exact-integer conservation
+    of bytes/dispatch/sheds lives in the snapshot ``nodes`` block, not
+    here (spans are floored to µs)."""
+    nodes: Dict[str, dict] = {}
+    for _tid, evs in _by_thread(complete_spans(events)).items():
+        conts = [e for e in evs
+                 if str(e.get("name", "")).startswith("node.")]
+        others = [e for e in evs
+                  if not str(e.get("name", "")).startswith("node.")
+                  and not str(e.get("name", "")).startswith("window.")]
+        for c in conts:
+            c_end = c["ts"] + c["dur"]
+            inside = [
+                e for e in others
+                if e["ts"] >= c["ts"] - _FLOOR_SLACK_US
+                and e["ts"] + e["dur"] <= c_end + _FLOOR_SLACK_US
+            ]
+            top: List[dict] = []
+            frontier = -1.0
+            for e in inside:
+                if e["ts"] >= frontier:
+                    top.append(e)
+                    frontier = e["ts"] + e["dur"]
+            args = c.get("args") or {}
+            name = str(args.get("node")
+                       or str(c.get("name", ""))[len("node."):])
+            agg = nodes.setdefault(name, {
+                "windows": 0, "dur_us": 0, "events": 0,
+                "phases": {}, "unattributed_us": 0,
+            })
+            agg["windows"] += 1
+            agg["dur_us"] += int(c["dur"])
+            ev_n = args.get("events")
+            if isinstance(ev_n, (int, float)):
+                agg["events"] += int(ev_n)
+            attributed = 0
+            for e in top:
+                us = int(e["dur"])
+                phase = str(e.get("name", "?"))
+                agg["phases"][phase] = agg["phases"].get(phase, 0) + us
+                attributed += us
+            agg["unattributed_us"] += max(int(c["dur"]) - attributed, 0)
+    for agg in nodes.values():
+        dur_s = agg["dur_us"] / 1e6
+        agg["eps"] = (agg["events"] / dur_s) if dur_s > 0 else None
+    return nodes
 
 
 def span_range_us(events: List[dict]) -> Optional[float]:

@@ -1,5 +1,5 @@
 """sfprof CLI — ``report`` / ``diff [--gate]`` / ``health [--slo]`` /
-``recover`` / ``trend [--gate]``.
+``recover`` / ``live`` / ``trend [--gate]``.
 
 Run from the repo root: ``python -m tools.sfprof <cmd> ...``. The first
 three subcommands consume run ledgers (``telemetry.write_ledger``);
@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tools.sfprof import attribution
 from tools.sfprof import events as events_mod
 from tools.sfprof import ledger as ledger_mod
+from tools.sfprof import live as live_mod
 from tools.sfprof import roofline as roofline_mod
 from tools.sfprof import slo as slo_mod
 from tools.sfprof import stream as stream_mod
@@ -124,6 +125,14 @@ def cmd_report(args) -> int:
             pct = 100.0 * us / total_us if total_us else 0.0
             print(f"    {phase:<18} {float(pct):6.1f}%  "
                   f"{float(_ms(us)):10.3f} ms")
+
+    node_spans = attribution.attribute_nodes(events)
+    snap_nodes: Dict[str, Any] = {}
+    if doc is not None:
+        snap_nodes = (doc.get("snapshot") or {}).get("nodes") or {}
+    if node_spans or snap_nodes:
+        _print_node_table(node_spans, snap_nodes,
+                          (doc or {}).get("snapshot") or {})
 
     if doc is not None:
         kernels = doc.get("kernels") or []
@@ -212,6 +221,22 @@ def cmd_report(args) -> int:
                   f"{int(qs.get('recompiles') or 0)} compiled bucket "
                   f"signatures (ladder-bounded), "
                   f"{int(qs.get('evicted_total') or 0)} evicted")
+        coll = snap.get("collectives") or {}
+        if coll:
+            kinds = ", ".join(
+                f"{k}={int((v or {}).get('bytes') or 0)}B"
+                f"/{int((v or {}).get('calls') or 0)} call(s)"
+                for k, v in sorted((coll.get("by_kind") or {}).items())
+            ) or "-"
+            print("\n-- mesh collectives "
+                  "(trace-time logical bytes, host-side estimate) --")
+            print(f"{int(coll.get('calls') or 0)} collective call(s), "
+                  f"{int(coll.get('bytes') or 0)} B moved  [{kinds}]")
+            axes = coll.get("by_axis") or {}
+            if axes:
+                print("    by axis: " + ", ".join(
+                    f"{ax}={int(b or 0)}B" for ax, b in sorted(axes.items())
+                ))
         if snap.get("dropped_events"):
             print(f"\nWARNING: {int(snap['dropped_events'])} trace events "
                   "dropped (buffer cap) — attribution above is partial")
@@ -228,6 +253,58 @@ def cmd_report(args) -> int:
     return 0
 
 
+def _print_node_table(node_spans: Dict[str, dict],
+                      snap_nodes: Dict[str, Any],
+                      snap: Dict[str, Any]):
+    """Per-node attribution table (the PR 16 ``node.*`` convention):
+    span-derived windows/EPS/phase split merged with the snapshot
+    ``nodes`` conservation counters. Node totals sum EXACTLY to the
+    untagged globals — the ``(unscoped)`` bucket is the remainder, so
+    the sum line next to the global makes drift visible at a glance."""
+    print("\n-- per-node attribution "
+          "(node totals sum to the untagged globals) --")
+    names = sorted(set(node_spans) | set(snap_nodes))
+    for name in names:
+        sp = node_spans.get(name) or {}
+        sn = snap_nodes.get(name) or {}
+        windows = int(sp.get("windows") or sn.get("windows") or 0)
+        eps = sp.get("eps")
+        eps_s = f"{float(eps):.0f} ev/s" if eps else "-"
+        print(f"{name}: {windows} windows, "
+              f"total {float(_ms(sp.get('dur_us') or 0)):.3f} ms, "
+              f"eps {eps_s}")
+        rows = sorted((sp.get("phases") or {}).items(),
+                      key=lambda kv: -kv[1])
+        if sp.get("unattributed_us"):
+            rows.append(("unattributed", sp["unattributed_us"]))
+        total_us = sp.get("dur_us") or 0
+        for phase, us in rows:
+            pct = 100.0 * us / total_us if total_us else 0.0
+            print(f"    {phase:<18} {float(pct):6.1f}%  "
+                  f"{float(_ms(us)):10.3f} ms")
+        if sn:
+            print(f"    h2d {int(sn.get('h2d_bytes') or 0)} B  "
+                  f"d2h {int(sn.get('d2h_bytes') or 0)} B  "
+                  f"dispatch "
+                  f"{float((sn.get('dispatch_ns') or 0) / 1e6):.3f} ms  "
+                  f"compiles {int(sn.get('compiles') or 0)}  "
+                  f"sheds {int(sn.get('shed_events') or 0)}  "
+                  f"collective {int(sn.get('collective_bytes') or 0)} B")
+    if snap_nodes and snap:
+        # Conservation receipt: bucket sums vs the global counters.
+        for label, bucket_key, snap_key in (
+            ("h2d", "h2d_bytes", "bytes_h2d"),
+            ("d2h", "d2h_bytes", "bytes_d2h"),
+            ("compiles", "compiles", "compiles"),
+        ):
+            total = sum(int((r or {}).get(bucket_key) or 0)
+                        for r in snap_nodes.values())
+            want = int(snap.get(snap_key) or 0)
+            mark = "ok" if total == want else "MISMATCH"
+            print(f"conservation {label}: node-sum {int(total)} "
+                  f"vs global {int(want)} [{mark}]")
+
+
 def _print_roofline(bound: Dict[str, Any]):
     """The bound verdict with its sfcheck-style ``↳`` evidence chain."""
     dom = "" if bound.get("dominant") else " (weak dominance)"
@@ -242,6 +319,15 @@ def _print_roofline(bound: Dict[str, Any]):
               f"(transfer {float(_ms(ph['transfer'])):.3f} ms, "
               f"compute {float(_ms(ph['compute'])):.3f} ms, "
               f"host {float(_ms(ph['host'])):.3f} ms)")
+    per_node = bound.get("per_node") or {}
+    if per_node:
+        print("  per node:")
+        for name, row in sorted(per_node.items()):
+            ph = row["phases_us"]
+            print(f"    {name}: {row['verdict']}  "
+                  f"(transfer {float(_ms(ph['transfer'])):.3f} ms, "
+                  f"compute {float(_ms(ph['compute'])):.3f} ms, "
+                  f"host {float(_ms(ph['host'])):.3f} ms)")
 
 
 def _report_json(args, doc, events, bound) -> int:
@@ -249,6 +335,7 @@ def _report_json(args, doc, events, bound) -> int:
     as one JSON document on stdout (exit code unchanged)."""
     windows, ops = attribution.attribute_windows(events)
     gaps = attribution.host_gaps(events)
+    node_spans = attribution.attribute_nodes(events)
     out: Dict[str, Any] = {
         "path": args.path,
         "ledger": None,
@@ -263,12 +350,20 @@ def _report_json(args, doc, events, bound) -> int:
                 }
                 for name, agg in sorted(ops.items())
             },
+            "nodes": node_spans,
         },
         "host_gaps": gaps[:args.top],
         "roofline": bound,
     }
     if doc is not None:
         snap = doc.get("snapshot") or {}
+        # Per-node conservation counters + collective gauges, lifted to
+        # the top level (they also ride ledger.snapshot) so machine
+        # consumers need not know the snapshot layout.
+        if snap.get("nodes"):
+            out["nodes"] = snap["nodes"]
+        if snap.get("collectives"):
+            out["collectives"] = snap["collectives"]
         out["ledger"] = {
             "ledger_version": int(doc.get("ledger_version", 0)),
             "env": doc.get("env") or {},
@@ -594,6 +689,9 @@ def cmd_health(args) -> int:
                 "qserve": snap.get("qserve") or {},
                 "pipeline": snap.get("pipeline") or {},
                 "faults": snap.get("faults") or {},
+                "dag": snap.get("dag") or {},
+                "nodes": snap.get("nodes") or {},
+                "collectives": snap.get("collectives") or {},
                 "instant_events": events_mod.notable_event_counts(
                     doc.get("events") or []),
             },
@@ -664,6 +762,39 @@ def cmd_health(args) -> int:
               f"{int(qs.get('evicted_total') or 0)} evicted) "
               f"buckets={len(qs.get('buckets') or {})} "
               f"recompiles={int(qs.get('recompiles') or 0)}")
+    # Worst-offender per-node lines (informational): the DAG provider's
+    # watermark-lag p99 names the node dragging the frontier, and the
+    # telemetry per-node buckets name the slowest node per event —
+    # budget either via an --slo spec's node_budgets to make it gate.
+    dag_nodes = (snap.get("dag") or {}).get("nodes") or {}
+    if dag_nodes:
+        worst_name, worst_rec = max(
+            dag_nodes.items(),
+            key=lambda kv: float(
+                (kv[1] or {}).get("watermark_lag_p99_ms") or 0),
+        )
+        print(f"note worst-node watermark lag: {worst_name} "
+              f"p99={float((worst_rec or {}).get('watermark_lag_p99_ms') or 0):.1f} ms "
+              f"(backend={(worst_rec or {}).get('backend')}, "
+              f"retries={int((worst_rec or {}).get('retries') or 0)}, "
+              f"failovers={int((worst_rec or {}).get('failovers') or 0)})")
+    node_eps = []
+    for nname, rec in (snap.get("nodes") or {}).items():
+        rec = rec or {}
+        span_us = float(rec.get("span_us") or 0)
+        ev = float(rec.get("events") or 0)
+        if span_us > 0 and ev > 0:
+            node_eps.append((nname, ev / (span_us / 1e6)))
+    if node_eps:
+        slow_name, slow_eps = min(node_eps, key=lambda kv: kv[1])
+        print(f"note worst-node EPS: {slow_name} at "
+              f"{float(slow_eps):.0f} ev/s "
+              f"({len(node_eps)} attributed node(s))")
+    coll = snap.get("collectives") or {}
+    if coll:
+        print(f"note mesh collectives: {int(coll.get('calls') or 0)} "
+              f"call(s), {int(coll.get('bytes') or 0)} B "
+              "(trace-time logical estimate)")
     # Pipelined-ingest visibility (informational, the overload idiom):
     # a collapse means the circuit breaker forced the executor back to
     # the synchronous cadence mid-run — a stalled pipeline, worth a
@@ -728,6 +859,11 @@ def cmd_recover(args) -> int:
             print(f"dropped a half-written tail line "
                   f"({int(info['skipped_bytes'])} bytes, "
                   f"{int(info['skipped_lines'])} later lines)")
+    if info.get("nodes_recovered"):
+        print("per-node attribution recovered: "
+              + ", ".join(info["nodes_recovered"])
+              + f" (collective bytes "
+              f"{int(info.get('collective_bytes_recovered') or 0)})")
     # The crash story, by registered event name (events.py): what the
     # recovered run was doing when it died — sheds, circuit flips,
     # fault firings — without grepping the stream by hand.
@@ -954,6 +1090,8 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output ledger path (default: "
                           "<stream>.recovered.json)")
     rec.set_defaults(fn=cmd_recover)
+
+    live_mod.add_parser(sub)
 
     trd = sub.add_parser(
         "trend", help="per-config time series over a whole capture "

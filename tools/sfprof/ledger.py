@@ -29,7 +29,12 @@ from typing import Any, Dict, List, Optional, Tuple
 # configures jax). Bump BOTH constants together; the cross-pin lives in
 # tests/test_sfprof.py (ledger schema test writes with the telemetry
 # constant and validates with this one).
-LEDGER_VERSION = 1
+LEDGER_VERSION = 2
+
+# Versions this reader still accepts: v1 documents predate the per-node
+# attribution / collective blocks (both additive), and the trend gate's
+# history is full of them — rejecting v1 would orphan every trajectory.
+SUPPORTED_LEDGER_VERSIONS = (1, 2)
 
 REQUIRED_BLOCKS: Tuple[Tuple[str, type], ...] = (
     ("ledger_version", int),
@@ -109,9 +114,10 @@ def validate(doc: Any) -> List[str]:
                 f"block {key} has type {type(doc[key]).__name__}"
             )
     ver = doc.get("ledger_version")
-    if isinstance(ver, int) and ver != LEDGER_VERSION:
+    if isinstance(ver, int) and ver not in SUPPORTED_LEDGER_VERSIONS:
         problems.append(
-            f"ledger_version {ver} != supported {LEDGER_VERSION}"
+            f"ledger_version {ver} not in supported "
+            f"{SUPPORTED_LEDGER_VERSIONS}"
         )
     snap = doc.get("snapshot")
     if isinstance(snap, dict):

@@ -205,6 +205,21 @@ def classify(doc: Optional[Dict[str, Any]], events: List[dict],
         f"{float(_pct(host_us, wall_us)):.1f}% of wall"
     )
 
+    # -- collectives: trace-time mesh traffic (parallel/ wrappers) ----------
+    coll = snap.get("collectives") or {}
+    collective_bytes = float(coll.get("bytes") or 0)
+    if collective_bytes:
+        kinds = ", ".join(
+            f"{k}: {int((v or {}).get('bytes') or 0)} B"
+            for k, v in sorted((coll.get("by_kind") or {}).items())
+        )
+        evidence.append(
+            f"collectives: {int(collective_bytes)} logical B across "
+            f"{int(coll.get('calls') or 0)} mesh collective(s) "
+            f"({kinds}) — the all-gather/halo baseline scale-out must "
+            "beat (trace-time estimate, not wire measurement)"
+        )
+
     # -- dispatch: steady kernel time, split by the machine model -----------
     (dispatch_us, est_compute_us, est_memory_us, est_device_us,
      flops_total, bytes_total, calls_total) = _kernel_signals(
@@ -277,6 +292,8 @@ def classify(doc: Optional[Dict[str, Any]], events: List[dict],
         )
 
     per_operator = _per_operator(ops)
+    per_node = _per_node(attribution.attribute_nodes(events),
+                         snap.get("nodes") or {})
     return {
         "verdict": verdict,
         "dominant": bool(dominant),
@@ -288,6 +305,7 @@ def classify(doc: Optional[Dict[str, Any]], events: List[dict],
             "overhead_us": float(overhead_us),
             "est_compute_us": float(est_compute_us),
             "est_memory_us": float(est_memory_us),
+            "collective_bytes": float(collective_bytes),
         },
         "fractions": {
             k: (float(v) if v is not None else None)
@@ -296,6 +314,7 @@ def classify(doc: Optional[Dict[str, Any]], events: List[dict],
         "machine_model": model,
         "evidence": evidence,
         "per_operator": per_operator,
+        "per_node": per_node,
     }
 
 
@@ -326,4 +345,45 @@ def _per_operator(ops: Dict[str, dict]) -> Dict[str, dict]:
             "phases_us": {"transfer": link, "compute": compute,
                           "host": host, "total": total},
         }
+    return out
+
+
+def _per_node(nodes: Dict[str, dict],
+              snap_nodes: Dict[str, dict]) -> Dict[str, dict]:
+    """Per-DAG-node bound verdict: the :func:`_per_operator` phase split
+    over each node's ``node.*`` container spans, refined with the
+    snapshot ``nodes`` block's exact byte/dispatch counters (a node with
+    heavy h2d/d2h traffic but thin ship/fetch spans — e.g. panes shipped
+    by the shared source — still shows its boundary bytes). A link-bound
+    q3 next to a compute-bound qserve is exactly the verdict split the
+    chip-capture campaign needs."""
+    out: Dict[str, dict] = {}
+    for name, agg in sorted(nodes.items()):
+        phases = agg.get("phases") or {}
+        link = float(sum(us for p, us in phases.items()
+                         if any(p == lp or p.startswith(lp + ".")
+                                for lp in _LINK_PHASES)))
+        host = float(agg.get("unattributed_us") or 0)
+        compute = float(sum(us for p, us in phases.items())) - link
+        total = float(agg.get("dur_us") or 0)
+        shares = {"link-bound": link, "dispatch-bound": compute,
+                  "host-bound": host}
+        verdict = max(shares, key=lambda k: shares[k]) \
+            if total > 0 and max(shares.values()) > 0 else "inconclusive"
+        counters = snap_nodes.get(name) or {}
+        row = {
+            "verdict": verdict,
+            "windows": int(agg.get("windows") or 0),
+            "events": int(agg.get("events") or 0),
+            "eps": agg.get("eps"),
+            "phases_us": {"transfer": link, "compute": compute,
+                          "host": host, "total": total},
+            "bytes_h2d": int(counters.get("h2d_bytes") or 0),
+            "bytes_d2h": int(counters.get("d2h_bytes") or 0),
+            "dispatch_ns": int(counters.get("dispatch_ns") or 0),
+            "compiles": int(counters.get("compiles") or 0),
+            "collective_bytes": int(
+                counters.get("collective_bytes") or 0),
+        }
+        out[name] = row
     return out

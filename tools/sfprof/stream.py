@@ -40,7 +40,13 @@ from tools.sfprof.ledger import LEDGER_VERSION
 #: Mirror of spatialflink_tpu/telemetry.py:STREAM_VERSION — kept as a
 #: literal so the CLI never imports spatialflink_tpu (whose import
 #: configures jax). Bump BOTH; tests/test_ledger_stream.py cross-pins.
-STREAM_VERSION = 1
+#: v2: checkpoints carry the per-node/collective snapshot blocks.
+STREAM_VERSION = 2
+
+#: Versions recover still accepts: the v1→v2 change is additive
+#: (checkpoint snapshots grew blocks; the grammar is identical), and a
+#: chip capture stranded by the r3–r5 loss mode must stay recoverable.
+SUPPORTED_STREAM_VERSIONS = (1, 2)
 
 #: Snapshot skeleton for a stream killed before its first checkpoint:
 #: every key ``ledger.validate`` requires, zeroed — plus an explicit
@@ -114,9 +120,10 @@ def recover(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         raise ValueError(f"{path}: no ledger-stream prologue")
     prologue = records[0]
     ver = prologue.get("stream_version")
-    if ver != STREAM_VERSION:
+    if ver not in SUPPORTED_STREAM_VERSIONS:
         raise ValueError(
-            f"{path}: stream_version {ver} != supported {STREAM_VERSION}"
+            f"{path}: stream_version {ver} not in supported "
+            f"{SUPPORTED_STREAM_VERSIONS}"
         )
 
     events: List[dict] = []
@@ -168,6 +175,14 @@ def recover(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         "skipped_lines": tail["skipped_lines"],
         "skipped_bytes": tail["skipped_bytes"],
         "snapshot_synthesized": checkpoint is None,
+        # Per-node attribution survives reconstruction via the last
+        # checkpoint's snapshot (tests pin this over a killed DAG
+        # capture) — name the recovered nodes so a truncated 7-node
+        # stream that lost its node blocks is visibly wrong.
+        "nodes_recovered": sorted((snapshot.get("nodes") or {})),
+        "collective_bytes_recovered": int(
+            ((snapshot.get("collectives") or {}).get("bytes")) or 0
+        ),
         "last_seq": last_seq,
         "last_checkpoint_unix": (checkpoint or {}).get("unix"),
         "loss_bound": (
