@@ -269,8 +269,11 @@ class FaultInjector:
         return self._fire(rule, count)
 
     def _fire(self, rule: FaultRule, count: int) -> Optional[str]:
+        # WHETHER a fault fires is the deterministic hit-count rule; the
+        # wall timestamp below only annotates the fired-fault telemetry
+        # record, and nothing downstream feeds egress/checkpoint bytes.
         rec = {"point": rule.point, "kind": rule.kind, "hit": count,
-               "unix": time.time()}
+               "unix": time.time()}  # sfcheck: ok=replay-determinism -- annotation only
         with self._lock:
             self.fired.append(rec)
         self._telemetry_fired(rule.point, rule.kind, count)

@@ -780,6 +780,24 @@ class TJoinQuery(SpatialOperator):
             territory, and correctness beats overlap."""
             if pipe_pol is None or mesh is not None or n_slides <= 1:
                 ts_dev = jnp.asarray(np.arange(n_slides, dtype=np.int32))
+                if mesh is not None:
+                    # Mesh scans route through the ACCOUNTED parallel/
+                    # entry: its host side feeds the all-gather/psum
+                    # footprint to telemetry.account_collective from
+                    # static shapes (the collective-accounting
+                    # invariant), then runs the same cached program.
+                    from spatialflink_tpu.parallel.sharded import (
+                        sharded_tjoin_pane_scan,
+                    )
+
+                    return sharded_tjoin_pane_scan(
+                        mesh, carry, ts_dev,
+                        tuple(jnp.asarray(a) for a in lfields),
+                        tuple(jnp.asarray(a) for a in rfields),
+                        radius,
+                        **{k: v for k, v in statics.items()
+                           if k != "mesh"},
+                    )
                 return scan(
                     carry, ts_dev,
                     tuple(jnp.asarray(a) for a in lfields),

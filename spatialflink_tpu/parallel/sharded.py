@@ -921,3 +921,75 @@ def sharded_geometry_geometry_join_pruned(
         mesh, a_polygonal, b_polygonal, block, cand, max_pairs, pair_cap,
         approx,
     )(averts, aev, avalid, abbox, bverts, bev, bvalid, bbbox, radius)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_tjoin_pane_scan(mesh, grid_n, cap_w, layers, ppw, num_ids,
+                            pair_sel, cap_c):
+    from spatialflink_tpu.ops.tjoin_panes import tjoin_pane_scan
+    from spatialflink_tpu.telemetry import instrument_jit
+
+    def fn(carry, ts, lps, rps, radius, lps_expire, rps_expire):
+        return tjoin_pane_scan(
+            carry, ts, lps, rps, radius, grid_n=grid_n, cap_w=cap_w,
+            layers=layers, ppw=ppw, num_ids=num_ids, pair_sel=pair_sel,
+            cap_c=cap_c, lps_expire=lps_expire, rps_expire=rps_expire,
+            mesh=mesh,
+        )
+
+    # Same recompile-detector label convention as window_program's mesh
+    # path, so bucket churn on the pane scan stays visible.
+    return instrument_jit(jax.jit(fn), name="sharded:tjoin_pane_scan")
+
+
+def sharded_tjoin_pane_scan(
+    mesh: Mesh,
+    carry,
+    ts,
+    lps,
+    rps,
+    radius,
+    lps_expire=None,
+    rps_expire=None,
+    *,
+    grid_n: int,
+    cap_w: int,
+    layers: int,
+    ppw: int,
+    num_ids: int,
+    pair_sel: int,
+    cap_c: int = 0,
+):
+    """Accounted mesh entry for ``ops/tjoin_panes.tjoin_pane_scan``.
+
+    Probe-parallel: pane POINTS shard over ``data``; per slide each
+    shard probes its chunk against the replicated window planes, then
+    the 8 pane field arrays of BOTH sides and the (flat idx, dist)
+    contribution pairs of both probe directions all-gather so every
+    shard applies the identical digest scatter, and the 4 overflow
+    scalars psum (tjoin_pane_step's axis_name hooks). Bit-identical to
+    the single-device scan (tests/test_parallel_operators.py).
+
+    The collective footprint is computed HERE, host-side from static
+    shapes, per scan invocation — the ``telemetry.account_collective``
+    feeder contract (PARITY.md "Observability"): per slide, both panes'
+    fields (x, y at the field dtype; xi/yi/cell/rank/oid int32; valid
+    bool) plus ``2·PC·pair_sel`` gathered contribution lanes, and four
+    int32 psums.
+    """
+    n_slides = int(ts.shape[0])
+    pc = int(lps[0].shape[1])
+    fb = _itemsize(lps[0].dtype)
+    per_side = pc * (2 * fb + 5 * 4 + 1)
+    contrib = 2 * pc * pair_sel * (4 + fb)
+    telemetry.account_collective(
+        "all_gather", n_slides * (2 * per_side + contrib), axis="data",
+        calls=n_slides * 20,
+    )
+    telemetry.account_collective(
+        "psum", n_slides * 16, axis="data", calls=n_slides * 4,
+    )
+    fn = _cached_tjoin_pane_scan(
+        mesh, grid_n, cap_w, layers, ppw, num_ids, pair_sel, cap_c,
+    )
+    return fn(carry, ts, lps, rps, radius, lps_expire, rps_expire)

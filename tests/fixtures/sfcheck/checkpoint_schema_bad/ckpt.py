@@ -1,0 +1,30 @@
+"""Fixture mini-repo: checkpoint publish/restore pairs violating every
+checkpoint-schema rule (analyzed with --project-root at this root)."""
+
+
+class WindowOperator:
+    def state(self):
+        payload = {"carry": self.carry, "watermark": self.wm}
+        if self.compaction is not None:
+            # conditionally published: old checkpoints lack the key
+            payload["compaction_rung"] = self.compaction
+        return payload
+
+    def restore(self, state):
+        self.carry = state["carry"]
+        self.wm = state["watermark"]
+        # rule 3: conditionally-published key, bare unconditional read —
+        # a pre-compaction checkpoint KeyErrors here mid-resume
+        self.compaction = state["compaction_rung"]
+        # rule 1: no publisher ever writes this key
+        self.retries = state["retry_budget"]
+
+
+class DroppedStateOperator:
+    def state(self):
+        # rule 2: "interner" is checkpointed but never read back —
+        # silently dropped on every resume
+        return {"carry": self.carry, "interner": self.table}
+
+    def restore(self, state):
+        self.carry = state["carry"]

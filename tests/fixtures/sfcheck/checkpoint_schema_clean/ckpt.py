@@ -1,0 +1,40 @@
+"""Fixture mini-repo: the sanctioned checkpoint publish/restore idioms
+(clean twin of checkpoint_schema_bad)."""
+
+
+class WindowOperator:
+    def state(self):
+        payload = {"carry": self.carry, "watermark": self.wm}
+        if self.compaction is not None:
+            payload["compaction_rung"] = self.compaction
+        return payload
+
+    def restore(self, state):
+        self.carry = state["carry"]
+        self.wm = state["watermark"]
+        # legacy default: checkpoints older than the rung lack the key
+        self.compaction = state.get("compaction_rung", None)
+        # guarded read of an optional key is the sanctioned residue idiom
+        if "retry_budget" in state:
+            self.retries = state["retry_budget"]
+
+
+class DelegatorOperator:
+    def state(self):
+        # pure delegator: zero literal writes, nothing statically
+        # checkable — the pair is skipped
+        return self.inner.snapshot()
+
+    def restore(self, state):
+        self.inner.load(state["inner_blob"])
+
+
+class DynamicOperator:
+    def state(self):
+        return {"carry": self.carry, "counters": dict(self.counters)}
+
+    def restore(self, state):
+        # payload-map iteration consumes every key dynamically — the
+        # never-restored rule cannot claim a drop
+        for key, value in state.items():
+            setattr(self, key, value)

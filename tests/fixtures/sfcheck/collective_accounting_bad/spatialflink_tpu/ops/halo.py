@@ -1,0 +1,11 @@
+"""Fixture: a shard_map-body kernel whose collectives no accounted
+parallel/ wrapper reaches — its ICI traffic is invisible to the
+per-node collective ledger."""
+
+from jax import lax
+
+
+def halo_exchange_kernel(x, axis_name):
+    g = lax.all_gather(x, axis_name)       # finding: unaccounted
+    total = lax.psum(x, axis_name)         # finding: unaccounted
+    return g, total

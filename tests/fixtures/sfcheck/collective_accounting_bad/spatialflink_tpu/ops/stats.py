@@ -1,0 +1,9 @@
+"""Fixture: a collective kernel that IS covered by the accounted
+wrapper in parallel/wrap.py — must stay clean while halo.py is
+flagged."""
+
+from jax import lax
+
+
+def stats_kernel(x, axis_name):
+    return lax.psum(x, axis_name)

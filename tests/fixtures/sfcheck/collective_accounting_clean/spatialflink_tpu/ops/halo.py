@@ -1,0 +1,10 @@
+"""Fixture (clean twin): the same halo kernel, now reachable from an
+accounted parallel/ wrapper."""
+
+from jax import lax
+
+
+def halo_exchange_kernel(x, axis_name):
+    g = lax.all_gather(x, axis_name)
+    total = lax.psum(x, axis_name)
+    return g, total

@@ -1,0 +1,25 @@
+"""Fixture mini-repo: nondeterminism reachable from egress / checkpoint
+decision roots (analyzed with --project-root at this root)."""
+
+import random
+import time
+
+
+def _stamp():
+    # wall-clock two hops from the egress root: the evidence chain must
+    # name the commit -> _stamp edge
+    return time.time()
+
+
+class FileSink:
+    def commit(self, rows):
+        # set-iteration straight into egress bytes: the hash seed, not
+        # the data, decides output order — resume diverges
+        for oid in {r.oid for r in rows}:
+            self.fh.write(f"{oid}\n")
+        self.fh.write(f"footer {_stamp()}\n")
+
+
+def shard_state():
+    # unseeded global RNG draw inside a checkpoint publisher
+    return {"salt": random.random()}

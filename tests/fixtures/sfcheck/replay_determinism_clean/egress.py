@@ -1,0 +1,16 @@
+"""Fixture mini-repo: the deterministic twins of every
+replay_determinism_bad violation."""
+
+
+class FileSink:
+    def commit(self, rows):
+        # sorted() launders set order into a data-determined order
+        for oid in sorted({r.oid for r in rows}):
+            self.fh.write(f"{oid}\n")
+        # event time (the watermark clock), not wall time
+        self.fh.write(f"footer {self.watermark}\n")
+
+
+def shard_state(rng):
+    # caller-supplied seeded generator, checkpointed with the operator
+    return {"salt": rng.random()}

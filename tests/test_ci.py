@@ -68,6 +68,22 @@ def test_changed_flag_passes_through(capsys):
     assert "--changed" in capsys.readouterr().out
 
 
+def test_github_actions_switches_sfcheck_format(monkeypatch):
+    """Under Actions the sfcheck stage emits ::error annotations; locally
+    it stays human. Exit codes are format-invariant, so the gate verdict
+    is identical either way."""
+    def sfcheck_argv():
+        (cmds,) = [c for name, c in ci.stages(
+            False, True, True, skip_chaos=True, skip_overload=True,
+            skip_dag=True) if name == "sfcheck"]
+        return cmds[0]
+
+    monkeypatch.delenv("GITHUB_ACTIONS", raising=False)
+    assert "--format=github" not in sfcheck_argv()
+    monkeypatch.setenv("GITHUB_ACTIONS", "true")
+    assert "--format=github" in sfcheck_argv()
+
+
 def test_fail_fast_propagates_stage_exit(monkeypatch):
     calls = []
 

@@ -91,6 +91,19 @@ def test_pragma_suppresses():
     assert _lint("""
         import time
         def host_tally():
+            return time.time()  # sfcheck: ok=hotpath -- host-side tally
+    """) == []
+
+
+def test_legacy_pragma_spelling_still_honored():
+    # In-tree code uses only the canonical `# sfcheck: ok=<pass> -- why`
+    # spelling, but the shim's legacy_pragma regex keeps the pre-sfcheck
+    # form working for out-of-tree callers of lint_hotpath — this pin is
+    # the contract (tests/fixtures/sfcheck/pragmas_ok.py carries the
+    # fixture twin).
+    assert _lint("""
+        import time
+        def host_tally():
             return time.time()  # hotpath: ok
     """) == []
 
@@ -110,7 +123,7 @@ def test_pragma_suppresses_on_any_line_of_a_multiline_call():
         import jax.numpy as jnp
         PAD = jnp.full(
             (8,), 0.0,
-        )  # hotpath: ok
+        )  # sfcheck: ok=hotpath -- module-level pad constant
     """) == []
 
 

@@ -447,6 +447,69 @@ def test_sharded_traj_stats_pane_matches_single(rng, mesh, collectives):
     assert collectives() == 0
 
 
+def test_sharded_tjoin_pane_scan_matches_single(rng, mesh, collectives):
+    """The accounted mesh entry for the pane-carry tJoin scan
+    (parallel/sharded.py:sharded_tjoin_pane_scan): probe-parallel pane
+    points over the data axis must be BIT-identical to the
+    single-device scan, and — unlike the zero-collective tStats pane
+    kernel — its per-slide all-gather/psum footprint must land on the
+    collective ledger, fed host-side from static shapes."""
+    from spatialflink_tpu.ops.tjoin_panes import (
+        pane_cell_ranks,
+        tjoin_pane_init,
+        tjoin_pane_scan,
+    )
+    from spatialflink_tpu.parallel.sharded import sharded_tjoin_pane_scan
+    from spatialflink_tpu.telemetry import telemetry
+
+    S, pc, num_ids, ppw, cap_w, pair_sel = 6, 16, 8, 3, 32, 32
+    radius = 0.6
+    layers = GRID.candidate_layers(radius)
+
+    def mk_fields():
+        x = rng.uniform(0.2, 9.8, (S, pc))
+        y = rng.uniform(0.2, 9.8, (S, pc))
+        xi = np.floor((x - GRID.min_x) / GRID.cell_length).astype(np.int32)
+        yi = np.floor((y - GRID.min_y) / GRID.cell_length).astype(np.int32)
+        cell = (xi * GRID.n + yi).astype(np.int32)
+        oid = rng.integers(0, num_ids, (S, pc)).astype(np.int32)
+        valid = rng.random((S, pc)) < 0.9
+        pane = np.repeat(np.arange(S), pc)
+        rank = pane_cell_ranks(
+            pane, cell.ravel(), valid=valid.ravel()
+        ).reshape(S, pc).astype(np.int32)
+        return tuple(jnp.asarray(a)
+                     for a in (x, y, xi, yi, cell, rank, oid, valid))
+
+    lps, rps = mk_fields(), mk_fields()
+    ts = jnp.arange(S, dtype=jnp.int32)
+    statics = dict(grid_n=GRID.n, cap_w=cap_w, layers=layers, ppw=ppw,
+                   num_ids=num_ids, pair_sel=pair_sel)
+
+    def fresh():
+        return tjoin_pane_init(GRID.num_cells, cap_w, ppw, num_ids,
+                               jnp.dtype(jnp.float64))
+
+    single_final, single_w = tjoin_pane_scan(
+        fresh(), ts, lps, rps, radius, **statics
+    )
+    sharded_final, sharded_w = sharded_tjoin_pane_scan(
+        mesh, fresh(), ts, lps, rps, radius, **statics
+    )
+    np.testing.assert_array_equal(np.asarray(sharded_w),
+                                  np.asarray(single_w))
+    assert np.isfinite(np.asarray(single_w)).any(), "degenerate: no pairs"
+    for counter in ("cap_overflow", "sel_overflow", "cmp_overflow"):
+        assert int(getattr(sharded_final, counter)) \
+            == int(getattr(single_final, counter)) == 0
+    # The host-side accounting: all-gathered contributions + overflow
+    # psums, from static shape metadata only (never a device op).
+    assert collectives() > 0
+    by_kind = telemetry.collective_gauges()["by_kind"]
+    assert by_kind["all_gather"]["bytes"] > 0
+    assert by_kind["psum"]["bytes"] > 0
+
+
 def test_initialize_distributed_noop_single_process(monkeypatch):
     from spatialflink_tpu.parallel.multihost import initialize_distributed
 

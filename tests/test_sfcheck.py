@@ -53,7 +53,7 @@ def _cli(*args):
 
 # -- the analyzer itself -----------------------------------------------------
 
-def test_all_fourteen_passes_registered():
+def test_all_seventeen_passes_registered():
     assert set(PASS_NAMES) == {
         # file passes
         "hotpath", "trace-hygiene", "fixed-shape", "sync-discipline",
@@ -64,6 +64,9 @@ def test_all_fourteen_passes_registered():
         # v3: concurrency discipline + cross-module contracts
         "lock-discipline", "module-singleton", "env-registry",
         "contract-twin",
+        # v4: checkpoint/replay/collective contract analysis
+        "checkpoint-schema", "replay-determinism",
+        "collective-accounting",
     }
     for p in ALL_PASSES + PROJECT_PASSES:
         assert p.description and p.invariant
@@ -718,7 +721,7 @@ def test_json_carries_timings_and_cache_stats(tmp_path, monkeypatch):
 
 
 def test_changed_warm_one_file_edit_stays_subsecond(tmp_path, monkeypatch):
-    """The satellite pin: with all fourteen passes registered, a warm
+    """The satellite pin: with all seventeen passes registered, a warm
     --changed run (everything cached) stays sub-second."""
     import time as _time
 
@@ -810,6 +813,176 @@ def test_cache_roundtrip_preserves_v3_facts(tmp_path, monkeypatch):
         by_pass.setdefault(f.pass_name, []).append(f)
     assert len(by_pass.get("env-registry", [])) == 1
     assert len(by_pass.get("lock-discipline", [])) == 1
+
+
+# -- v4: checkpoint-schema / replay-determinism / collective-accounting ------
+
+
+def test_checkpoint_schema_fixture_repo():
+    bad = _mini_repo("checkpoint_schema_bad", "checkpoint-schema")
+    assert len(bad) == 3, "\n".join(f.format() for f in bad)
+    msgs = "\n".join(f.message for f in bad)
+    # all three rules, one finding each
+    assert "has no published producer" in msgs
+    assert "is never restored" in msgs
+    assert "published conditionally but read without a legacy default" \
+        in msgs
+    assert all(f.evidence for f in bad)
+    # the publish-without-legacy-default pair: evidence names BOTH halves
+    rule3 = next(f for f in bad if "legacy default" in f.message)
+    ev = "\n".join(rule3.evidence)
+    assert "writes 'compaction_rung' inside a conditional branch" in ev
+    assert "bare unconditional" in ev
+    assert _mini_repo("checkpoint_schema_clean", "checkpoint-schema") == []
+
+
+def test_replay_determinism_fixture_repo():
+    bad = _mini_repo("replay_determinism_bad", "replay-determinism")
+    assert len(bad) == 3, "\n".join(f.format() for f in bad)
+    msgs = "\n".join(f.message for f in bad)
+    assert "wall-clock read" in msgs
+    assert "global unseeded RNG draw" in msgs
+    # the set-iteration-into-commit egress repro, root named in evidence
+    setf = next(f for f in bad if "set" in f.message
+                and "hash seed" in f.message)
+    assert "exactly-once egress commit" in setf.evidence[0]
+    # the cross-function leg resolves the commit -> _stamp call step
+    wall = next(f for f in bad if "wall-clock" in f.message)
+    assert len(wall.evidence) >= 3
+    assert any("`commit` calls `_stamp" in e for e in wall.evidence)
+    # the checkpoint-publisher root class is also covered
+    rng = next(f for f in bad if "RNG" in f.message)
+    assert "checkpoint publisher" in rng.evidence[0]
+    assert _mini_repo("replay_determinism_clean", "replay-determinism") \
+        == []
+
+
+def test_collective_accounting_fixture_repo():
+    bad = _mini_repo("collective_accounting_bad", "collective-accounting")
+    assert len(bad) == 2, "\n".join(f.format() for f in bad)
+    msgs = "\n".join(f.message for f in bad)
+    assert "lax.all_gather" in msgs and "lax.psum" in msgs
+    # the wrapper-covered stats_kernel stays clean; only halo.py flags
+    assert all(f.path.endswith("halo.py") for f in bad)
+    ev = "\n".join(e for f in bad for e in f.evidence)
+    assert "unreachable from all 1 accounting wrapper(s)" in ev
+    assert all(f.evidence for f in bad)
+    assert _mini_repo("collective_accounting_clean",
+                      "collective-accounting") == []
+
+
+@pytest.mark.parametrize("fixture,pass_name,expect", [
+    ("checkpoint_schema_bad", "checkpoint-schema", 3),
+    ("replay_determinism_bad", "replay-determinism", 3),
+    ("collective_accounting_bad", "collective-accounting", 2),
+])
+def test_v4_cli_json_project_root_evidence(fixture, pass_name, expect):
+    """The --project-root CLI leg per new pass: exit 1, per-pass count,
+    and a resolved evidence chain on every finding."""
+    root = os.path.join(FIXTURES, fixture)
+    res = _cli("--no-cache", "--pass", pass_name,
+               "--project-root", root, "--json", root)
+    assert res.returncode == 1, res.stdout + res.stderr
+    data = json.loads(res.stdout)
+    assert data["counts"][pass_name] == expect
+    assert all(f["evidence"] for f in data["findings"])
+    assert any(len(f["evidence"]) >= 2 for f in data["findings"])
+
+
+def test_cache_roundtrip_preserves_v4_facts(tmp_path, monkeypatch):
+    """Cache-invalidation legs for the v4 fact kinds: checkpoint payload
+    writes/reads and nondeterminism sites ride the JSON cache, and an
+    edit that adds new instances re-analyzes exactly the edited file
+    with both verdicts updating."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    op = proj / "op.py"
+    op.write_text(
+        "class Op:\n"
+        "    def state(self):\n"
+        '        return {"carry": self.carry}\n'
+        "    def restore(self, state):\n"
+        '        self.carry = state["carry"]\n'
+    )
+    (proj / "sink.py").write_text(
+        "class FileSink:\n"
+        "    def commit(self, rows):\n"
+        "        for r in sorted({x.oid for x in rows}):\n"
+        "            self.fh.write(str(r))\n"
+    )
+    monkeypatch.setattr(core, "default_targets", lambda: [str(proj)])
+    monkeypatch.setattr(core, "relpath_of", lambda p: os.path.relpath(
+        os.path.abspath(p), str(proj)).replace(os.sep, "/"))
+    cache_path = str(tmp_path / "cache.json")
+    for _ in range(2):  # cold fill, then fully-cached verdict
+        report = driver.run(changed=True, cache_path=cache_path)
+        assert report.findings == [], "\n".join(
+            f.format() for f in report.findings)
+    # edit: a bare read of a key the publisher never writes + a
+    # wall-clock read inside the publisher
+    op.write_text(
+        "import time\n"
+        "class Op:\n"
+        "    def state(self):\n"
+        '        return {"carry": self.carry, "at": time.time()}\n'
+        "    def restore(self, state):\n"
+        '        self.carry = state["carry"]\n'
+        '        self.wm = state["watermark"]\n'
+    )
+    report = driver.run(changed=True, cache_path=cache_path)
+    assert report.cache_misses == 1 and report.cache_hits == 1
+    by_pass = {}
+    for f in report.findings:
+        by_pass.setdefault(f.pass_name, []).append(f)
+    assert len(by_pass.get("checkpoint-schema", [])) >= 1
+    assert len(by_pass.get("replay-determinism", [])) == 1
+
+
+# -- v4 satellite: --format=github + per-pass summary counts -----------------
+
+
+def test_cli_github_format_emits_workflow_commands(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import jax.numpy as jnp\nX = jnp.zeros(3)\n")
+    res = _cli("--no-cache", "--pass", "hotpath", "--format=github",
+               str(dirty))
+    assert res.returncode == 1, res.stdout + res.stderr  # codes unchanged
+    lines = [ln for ln in res.stdout.splitlines()
+             if ln.startswith("::error ")]
+    assert len(lines) == 1
+    assert "line=2" in lines[0] and "title=hotpath" in lines[0]
+    # same input, human mode: identical exit, no workflow commands
+    res_h = _cli("--no-cache", "--pass", "hotpath", str(dirty))
+    assert res_h.returncode == 1
+    assert "::error" not in res_h.stdout
+    # clean input exits 0 with no commands either way
+    clean = tmp_path / "clean.py"
+    clean.write_text("import numpy as np\nX = np.zeros(3)\n")
+    res_c = _cli("--no-cache", "--pass", "hotpath", "--format=github",
+                 str(clean))
+    assert res_c.returncode == 0 and "::error" not in res_c.stdout
+
+
+def test_cli_github_format_escapes_evidence_chain():
+    """Project-pass findings carry the ↳ chain inside the annotation,
+    %0A-escaped — one single-line workflow command per finding."""
+    root = os.path.join(FIXTURES, "replay_determinism_bad")
+    res = _cli("--no-cache", "--pass", "replay-determinism",
+               "--project-root", root, "--format=github", root)
+    assert res.returncode == 1, res.stdout + res.stderr
+    errors = [ln for ln in res.stdout.splitlines()
+              if ln.startswith("::error ")]
+    assert len(errors) == 3
+    assert all("%0A↳" in ln for ln in errors)
+    assert all("title=replay-determinism" in ln for ln in errors)
+
+
+def test_cli_summary_line_prints_per_pass_counts():
+    root = os.path.join(FIXTURES, "checkpoint_schema_bad")
+    res = _cli("--no-cache", "--pass", "checkpoint-schema",
+               "--project-root", root, root)
+    assert res.returncode == 1
+    assert "(checkpoint-schema 3)" in res.stdout
 
 
 # -- targeted regressions for the violations fixed in this tree --------------

@@ -107,6 +107,10 @@ def stages(changed: bool, skip_tests: bool, skip_bench: bool,
     sfcheck = [py, "-m", "tools.sfcheck"]
     if changed:
         sfcheck.append("--changed")
+    if os.environ.get("GITHUB_ACTIONS"):
+        # Under Actions the findings double as PR diff annotations
+        # (::error workflow commands); exit codes are format-invariant.
+        sfcheck.append("--format=github")
     out.append(("sfcheck", [sfcheck]))
     if not skip_tests:
         out.append(("pytest-quick", [[

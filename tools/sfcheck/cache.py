@@ -25,7 +25,10 @@ from tools.sfcheck.project import FileFacts, facts_from_dict
 
 #: v2: FileFacts gained the v3 concurrency/contract fact kinds (lock
 #: spans, env reads, emit sites, constants, main guard).
-SCHEMA_VERSION = 2
+#: v3: the v4 checkpoint/determinism fact kinds (ckpt_writes/ckpt_reads/
+#: ckpt_dynamic, nondet_sites) — cached v2 facts lack them, so
+#: ``--changed`` must re-extract everything once.
+SCHEMA_VERSION = 3
 
 _SFCHECK_DIR = os.path.dirname(os.path.abspath(__file__))
 

@@ -14,8 +14,13 @@ the cache; ``--no-cache`` touches no cache at all.
 Exit codes: 0 clean, 1 findings, 2 usage error, 3 internal crash
 (findings-vs-crash are distinct so CI can tell a regression from a
 broken analyzer). Human mode prints ``path:line: [pass] message`` plus
-indented ``↳`` evidence-chain lines; ``--json`` carries the evidence
-chain per finding and a per-pass count breakdown. Survives ``| head``.
+indented ``↳`` evidence-chain lines and a per-pass count breakdown on
+the summary line; ``--json`` carries the evidence chain per finding and
+the per-pass counts; ``--format=github`` emits one ``::error
+file=…,line=…,title=<pass>::…`` workflow command per finding (the
+evidence chain rides the annotation %0A-escaped) with identical exit
+codes, and ``tools.ci`` switches to it automatically when
+``GITHUB_ACTIONS`` is set. Survives ``| head``.
 """
 
 from __future__ import annotations
@@ -63,9 +68,36 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output with per-pass counts "
                          "and per-finding evidence chains")
+    ap.add_argument("--format", choices=("human", "github"),
+                    default="human",
+                    help="finding format: human (default) or GitHub "
+                         "workflow commands (::error file=…,line=…,"
+                         "title=<pass>::message — annotates the PR "
+                         "diff; exit codes unchanged)")
     ap.add_argument("--list-passes", action="store_true",
                     help="list passes and the invariant each enforces")
     return ap
+
+
+def _gh_escape(s: str, prop: bool = False) -> str:
+    """GitHub workflow-command escaping: data %-escapes newlines so a
+    multi-line annotation survives; properties additionally escape the
+    `,`/`:` delimiters."""
+    s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if prop:
+        s = s.replace(":", "%3A").replace(",", "%2C")
+    return s
+
+
+def _gh_line(f) -> str:
+    """One ``::error`` workflow command per finding. The evidence chain
+    rides the message as %0A-escaped lines, so the PR annotation shows
+    the same resolved chain the terminal does."""
+    msg = f.message + "".join(f"\n↳ {e}" for e in f.evidence)
+    return (f"::error file={_gh_escape(f.path, prop=True)},"
+            f"line={f.lineno},endLine={f.end_lineno},"
+            f"title={_gh_escape(f.pass_name, prop=True)}::"
+            f"{_gh_escape(msg)}")
 
 
 def _detach_stdout():
@@ -135,10 +167,17 @@ def _run(args) -> int:
             }, indent=2))
         else:
             for f in report.findings:
-                print(f.format())
+                print(_gh_line(f) if args.format == "github"
+                      else f.format())
             if report.findings:
+                # per-pass breakdown (only the nonzero passes): the
+                # one-line triage map for a multi-pass failure
+                per = ", ".join(
+                    f"{name} {n}" for name, n
+                    in sorted(report.counts().items()) if n
+                )
                 print(f"sfcheck: {len(report.findings)} finding(s) "
-                      f"across {report.files} file(s)")
+                      f"across {report.files} file(s) ({per})")
             if report.default_mode:
                 # Whole-tree runs (the gate) always print the cost
                 # summary; targeted runs stay quiet-when-clean.

@@ -9,6 +9,10 @@ descriptor so ``--list-passes``/``--pass`` see it.
 
 from __future__ import annotations
 
+from tools.sfcheck.passes.checkpoint_schema import CheckpointSchemaPass
+from tools.sfcheck.passes.collective_accounting import (
+    CollectiveAccountingPass,
+)
 from tools.sfcheck.passes.contract_twin import ContractTwinPass
 from tools.sfcheck.passes.donation_safety import DonationSafetyPass
 from tools.sfcheck.passes.env_registry import EnvRegistryPass
@@ -20,6 +24,7 @@ from tools.sfcheck.passes.lock_discipline import LockDisciplinePass
 from tools.sfcheck.passes.mesh_parity import MeshParityPass
 from tools.sfcheck.passes.module_singleton import ModuleSingletonPass
 from tools.sfcheck.passes.recompile_surface import RecompileSurfacePass
+from tools.sfcheck.passes.replay_determinism import ReplayDeterminismPass
 from tools.sfcheck.passes.sync_discipline import SyncDisciplinePass
 from tools.sfcheck.passes.trace_hygiene import TraceHygienePass
 
@@ -56,6 +61,10 @@ PROJECT_PASSES = (
     ModuleSingletonPass(),
     EnvRegistryPass(),
     ContractTwinPass(),
+    # v4: checkpoint/replay/collective contract analysis
+    CheckpointSchemaPass(),
+    ReplayDeterminismPass(),
+    CollectiveAccountingPass(),
 )
 
 STALENESS = PragmaStalenessRule()

@@ -1,0 +1,263 @@
+"""checkpoint-schema pass — publish/restore payload key-set agreement.
+
+Invariant (the PR 3 ``counts``-carry bug class, generalized): **every
+checkpoint payload key a restorer reads must have a producer, every key
+a publisher writes must have a consumer, and a key that is published
+CONDITIONALLY must be read behind a legacy default** — because a
+checkpoint written by an older build simply does not have the new key,
+and a bare ``state["k"]`` read turns every old checkpoint into a
+``KeyError`` at the worst possible moment (mid-resume on the chip).
+
+Pairing is driven from the restorers, using the repo's (very regular)
+naming convention plus the framed-CRC entry points:
+
+- ``restore`` ↔ ``state``, ``restore_substate`` ↔ ``substate`` (methods,
+  same class);
+- ``restore_<stem>`` ↔ ``<stem>_state`` with prefix matching, so
+  ``restore_kafka_source_offsets`` pairs ``kafka_source_state``;
+- a function calling ``load_checkpoint`` pairs the same-class (else
+  same-module) function calling ``save_checkpoint`` — the driver's
+  ``_load`` ↔ ``_commit``.
+
+Payload facts come from the project model (project.py's v4 extraction):
+string dict-literal keys, bare-name subscript stores, and
+``save_checkpoint(p, k=…)`` kwargs on the publish side; bare
+``state["k"]`` subscripts (incl. literal-string loop vars — the
+restore_dag counter-loop idiom), ``.get("k"[, d])``, and ``"k" in
+state`` guards on the restore side. ``self.…``-rooted and dotted
+receivers are excluded on both sides (``self.stats["windows"]`` is
+driver bookkeeping, not payload). A publisher with ZERO literal writes
+is a pure delegator (``wire_pane_assembler_state``) — nothing is
+statically checkable, so the pair is skipped; a publisher flagged
+``ckpt_dynamic`` (``.update(…)``/``**unpack``) skips only the
+missing-producer check (its key set is open).
+
+The three rules:
+
+1. **missing producer** — a bare, UNCONDITIONAL ``state["k"]`` read of a
+   key the paired publisher never writes (a guarded or defaulted read of
+   an unpublished key is the sanctioned legacy-residue idiom and stays
+   legal);
+2. **never restored** — a published literal key no read of any kind
+   consumes (dropped state: silently lost on every resume), unless the
+   restorer iterates the payload dynamically (``state.items()``);
+3. **no legacy default** — a CONDITIONALLY-published key read by a bare
+   ``state["k"]`` at an unconditional site with no ``"k" in state`` /
+   ``.get`` anywhere in the restorer: old checkpoints lack the key.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import (
+    MODULE_FN,
+    CKPT_LOAD_TERMINALS,
+    CKPT_SAVE_TERMINALS,
+    FileFacts,
+    FunctionFacts,
+    is_ckpt_restorer_name,
+    is_test_relpath,
+)
+
+#: Payload-map iteration terminals: a restorer walking ``state.items()``
+#: consumes every key dynamically — rule 2 cannot claim a key is dropped.
+_DYNAMIC_READ_TERMINALS = frozenset({"items", "keys", "values"})
+
+
+def _calls_terminal(fn: FunctionFacts, terminals) -> bool:
+    return any(c.target.split(".")[-1] in terminals for c in fn.calls)
+
+
+def _payload_recv(recv: Optional[str]) -> bool:
+    """Payload facts live on dict literals (recv None) and bare local
+    names; ``self.…`` / dotted receivers are object bookkeeping."""
+    return recv is None or ("." not in recv and recv != "self")
+
+
+class CheckpointSchemaPass(ProjectPass):
+    name = "checkpoint-schema"
+    description = ("checkpoint publish/restore payloads agree: no "
+                   "consumer-less published key, no producer-less bare "
+                   "read, and conditionally-published keys restore "
+                   "behind a legacy default")
+    invariant = ("old checkpoints stay loadable: a newly-published key "
+                 "is read via state.get(k, default) or a 'k' in state "
+                 "guard, and no key silently drops on resume")
+
+    def in_scope(self, relpath: str) -> bool:
+        return not is_test_relpath(relpath)
+
+    # -- pairing --------------------------------------------------------------
+
+    def _publisher_pools(self, facts: FileFacts, fn: FunctionFacts) \
+            -> List[List[FunctionFacts]]:
+        """Candidate publishers, nearest scope first: same class, then
+        same-module top level."""
+        same_class: List[FunctionFacts] = []
+        module_level: List[FunctionFacts] = []
+        for cand in facts.functions.values():
+            if cand.qualname in (fn.qualname, MODULE_FN):
+                continue
+            if fn.cls is not None and cand.cls == fn.cls:
+                same_class.append(cand)
+            elif cand.cls is None and cand.nested_in is None:
+                module_level.append(cand)
+        return [same_class, module_level] if fn.cls is not None \
+            else [module_level]
+
+    def _find_publisher(self, facts: FileFacts, fn: FunctionFacts) \
+            -> Optional[FunctionFacts]:
+        pools = self._publisher_pools(facts, fn)
+        if _calls_terminal(fn, CKPT_LOAD_TERMINALS):
+            for pool in pools:
+                for cand in pool:
+                    if _calls_terminal(cand, CKPT_SAVE_TERMINALS):
+                        return cand
+        if not is_ckpt_restorer_name(fn.name):
+            return None
+        stem = "" if fn.name == "restore" else fn.name[len("restore_"):]
+        want = "state" if stem == "" else (
+            stem if stem == "substate" else f"{stem}_state")
+        for pool in pools:
+            for cand in pool:
+                if cand.name == want:
+                    return cand
+        if stem and stem != "substate":
+            # prefix fallback: restore_kafka_source_offsets pairs
+            # kafka_source_state (longest publisher-stem match wins)
+            best: Optional[FunctionFacts] = None
+            best_len = -1
+            for pool in pools:
+                for cand in pool:
+                    if not cand.name.endswith("_state"):
+                        continue
+                    gstem = cand.name[:-len("_state")]
+                    if (stem == gstem or stem.startswith(gstem + "_")) \
+                            and len(gstem) > best_len:
+                        best, best_len = cand, len(gstem)
+                if best is not None:
+                    return best
+        return None
+
+    # -- the pass -------------------------------------------------------------
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel, facts, fn in project.iter_functions():
+            if fn.qualname == MODULE_FN:
+                continue
+            if not (is_ckpt_restorer_name(fn.name)
+                    or _calls_terminal(fn, CKPT_LOAD_TERMINALS)):
+                continue
+            pub = self._find_publisher(facts, fn)
+            if pub is None:
+                continue
+            findings.extend(
+                self._check_pair(rel, fn, pub, in_scope))
+        findings.sort(key=lambda f: (f.path, f.lineno))
+        return findings
+
+    def _check_pair(self, rel: str, restorer: FunctionFacts,
+                    publisher: FunctionFacts, in_scope) -> List[Finding]:
+        writes = [w for w in publisher.ckpt_writes
+                  if _payload_recv(w.get("recv"))]
+        if not writes:
+            return []  # pure delegator — nothing statically checkable
+        pub: Dict[str, dict] = {}
+        for w in writes:
+            e = pub.setdefault(w["key"], {"conditional": True,
+                                          "lineno": w["lineno"]})
+            if not w["conditional"]:
+                e["conditional"] = False
+        reads = [r for r in restorer.ckpt_reads
+                 if _payload_recv(r.get("recv"))]
+        read_keys = {r["key"] for r in reads}
+        guarded = {r["key"] for r in reads
+                   if r["how"] in ("contains", "get", "get_default")}
+        dynamic_reads = restorer.ckpt_dynamic or any(
+            c.target.split(".")[-1] in _DYNAMIC_READ_TERMINALS
+            and len([p for p in c.target.split(".") if p]) >= 2
+            for c in restorer.calls)
+
+        pair_note = (f"(publisher `{publisher.name}` at {rel}:"
+                     f"{publisher.lineno} ↔ restorer `{restorer.name}` "
+                     f"at {rel}:{restorer.lineno})")
+        out: List[Finding] = []
+        seen = set()
+
+        # 1. bare unconditional read with no producer
+        for r in reads:
+            if r["how"] != "getitem" or r["conditional"]:
+                continue
+            k = r["key"]
+            if k in pub or publisher.ckpt_dynamic:
+                continue
+            if ("producer", k) in seen or not in_scope(rel):
+                continue
+            seen.add(("producer", k))
+            out.append(Finding(
+                rel, r["lineno"], r["lineno"], self.name,
+                f"restored key {k!r} has no published producer: "
+                f"`{restorer.name}` reads it with a bare subscript but "
+                f"`{publisher.name}` never writes it " + pair_note,
+                evidence=(
+                    f"{rel}:{r['lineno']}: bare `[{k!r}]` read in "
+                    f"`{restorer.name}` (raises KeyError on every "
+                    f"restore)",
+                    f"{rel}:{publisher.lineno}: paired publisher "
+                    f"`{publisher.name}` writes only: "
+                    f"{', '.join(sorted(pub)) or '(nothing)'}",
+                ),
+            ))
+
+        # 2. published key never restored
+        if not dynamic_reads:
+            for k, e in sorted(pub.items()):
+                if k in read_keys or ("restored", k) in seen \
+                        or not in_scope(rel):
+                    continue
+                seen.add(("restored", k))
+                out.append(Finding(
+                    rel, e["lineno"], e["lineno"], self.name,
+                    f"published key {k!r} is never restored: "
+                    f"`{publisher.name}` checkpoints it but "
+                    f"`{restorer.name}` never reads it back — the state "
+                    f"silently drops on every resume " + pair_note,
+                    evidence=(
+                        f"{rel}:{e['lineno']}: `{publisher.name}` "
+                        f"publishes {k!r}",
+                        f"{rel}:{restorer.lineno}: paired restorer "
+                        f"`{restorer.name}` reads only: "
+                        f"{', '.join(sorted(read_keys)) or '(nothing)'}",
+                    ),
+                ))
+
+        # 3. conditionally-published key read without a legacy default
+        for r in reads:
+            if r["how"] != "getitem" or r["conditional"]:
+                continue
+            k = r["key"]
+            e = pub.get(k)
+            if e is None or not e["conditional"] or k in guarded:
+                continue
+            if ("default", k) in seen or not in_scope(rel):
+                continue
+            seen.add(("default", k))
+            out.append(Finding(
+                rel, r["lineno"], r["lineno"], self.name,
+                f"key {k!r} is published conditionally but read without "
+                f"a legacy default — a checkpoint written before the key "
+                f"existed raises KeyError on restore; use "
+                f"`state.get({k!r}, default)` or guard with "
+                f"`{k!r} in state` " + pair_note,
+                evidence=(
+                    f"{rel}:{e['lineno']}: `{publisher.name}` writes "
+                    f"{k!r} inside a conditional branch (older "
+                    f"checkpoints lack it)",
+                    f"{rel}:{r['lineno']}: bare unconditional "
+                    f"`[{k!r}]` read in `{restorer.name}`",
+                ),
+            ))
+        return out

@@ -1,0 +1,200 @@
+"""collective-accounting pass — every device collective is reachable
+from an accounted parallel/ wrapper.
+
+Invariant (PARITY.md "Observability", the dagmon conservation
+contract): **every ``jax.lax.p*``/shard_map-body collective in
+``spatialflink_tpu/`` has its ICI traffic fed to
+``telemetry.account_collective`` from STATIC shape/dtype metadata by a
+``parallel/`` wrapper.** The conservation tests prove the accounted
+numbers sum exactly; this pass proves the SET is complete — a
+halo-exchange kernel that lands with an unaccounted ``ppermute`` makes
+the per-node collective ledger silently undercount, which no dynamic
+test can notice (zero is a valid reading).
+
+Mechanics:
+
+- a **collective site** is a call whose terminal is a known collective
+  (``psum``/``pmin``/``ppermute``/``all_gather``/…) spelled through
+  ``lax`` (``jax.lax.psum``, ``lax.psum``) or import-resolved from
+  ``jax.lax``;
+- a **wrapper** is any ``parallel/`` function whose nest-closure group
+  directly calls ``account_collective`` — accounting and shard_map body
+  live in one nest (``sharded_traj_stats``), or the accounting rides a
+  host-side ``__call__`` (``_AccountedProgram``);
+- **coverage** walks from every wrapper's nest-root group over call
+  edges, closure nesting (shard_map bodies are nested defs), and
+  function-NAME arguments (a kernel handed to ``jitted``/``shard_map``
+  by a covered function is executed by it);
+- kernels passed by name into the generic mesh dispatchers
+  (``window_program`` / ``sharded_window_kernel``) are covered at the
+  call site: that path's accounting is ``_AccountedProgram.__call__``,
+  which computes the footprint from the concrete args and cannot be
+  linked to the kernel statically — the dispatcher IS the documented
+  accounting point.
+
+A collective site in ``spatialflink_tpu/`` whose enclosing function no
+wrapper reaches is a finding. ``sharded_traj_stats_pane`` is the
+documented ZERO-collective kernel — it stays clean precisely because it
+contains no collective calls, not via any exemption.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import is_test_relpath
+
+FnKey = Tuple[str, str]
+
+#: jax.lax collective primitives that move bytes over the mesh axis.
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter",
+})
+
+#: Generic mesh dispatchers: kernels handed to these by name execute
+#: under ``_AccountedProgram.__call__``'s per-call accounting.
+DISPATCH_TERMINALS = frozenset({"window_program", "sharded_window_kernel"})
+
+ACCOUNT_TERMINAL = "account_collective"
+
+
+def _in_parallel(rel: str) -> bool:
+    return "parallel" in rel.split("/")[:-1]
+
+
+def _is_collective_call(facts, target: str) -> bool:
+    parts = target.split(".")
+    term = parts[-1]
+    if term not in COLLECTIVES:
+        return False
+    if len(parts) >= 2:
+        return "lax" in parts[:-1]
+    imp = facts.imports.get(term)
+    return (imp is not None and imp["kind"] == "object"
+            and (imp["target"] == "jax.lax"
+                 or imp["target"].endswith(".lax")))
+
+
+class CollectiveAccountingPass(ProjectPass):
+    name = "collective-accounting"
+    description = ("every jax.lax collective in spatialflink_tpu/ is "
+                   "reachable from a parallel/ wrapper that feeds "
+                   "telemetry.account_collective")
+    invariant = ("dagmon conservation cannot silently undercount: a "
+                 "collective's ICI traffic is accounted from static "
+                 "shape metadata by its parallel/ wrapper "
+                 "(PARITY.md \"Observability\")")
+
+    def in_scope(self, relpath: str) -> bool:
+        return (relpath.startswith("spatialflink_tpu/")
+                and not is_test_relpath(relpath))
+
+    # -- coverage -------------------------------------------------------------
+
+    def _nest_children(self, project) -> Dict[FnKey, List[FnKey]]:
+        kids: Dict[FnKey, List[FnKey]] = {}
+        for rel, facts, fn in project.iter_functions():
+            if fn.nested_in is not None:
+                kids.setdefault((rel, fn.nested_in), []).append(
+                    (rel, fn.qualname))
+        return kids
+
+    def _nest_root(self, project, rel: str, fn) -> FnKey:
+        facts = project.files[rel]
+        q = fn
+        while q.nested_in is not None:
+            parent = facts.functions.get(q.nested_in)
+            if parent is None:
+                break
+            q = parent
+        return (rel, q.qualname)
+
+    def _covered(self, project, graph) -> Tuple[Set[FnKey], List[FnKey]]:
+        """(covered function keys, wrapper nest-root keys)."""
+        kids = self._nest_children(project)
+        wrappers: List[FnKey] = []
+        seeds: Set[FnKey] = set()
+        for rel, facts, fn in project.iter_functions():
+            if not _in_parallel(rel) or is_test_relpath(rel):
+                continue
+            if any(c.target.split(".")[-1] == ACCOUNT_TERMINAL
+                   for c in fn.calls):
+                root = self._nest_root(project, rel, fn)
+                if root not in seeds:
+                    seeds.add(root)
+                    wrappers.append(root)
+            # kernels handed by name to the generic dispatchers are
+            # executed under _AccountedProgram.__call__'s accounting
+        for rel, facts, fn in project.iter_functions():
+            if is_test_relpath(rel):
+                continue
+            for call in fn.calls:
+                if call.target.split(".")[-1] not in DISPATCH_TERMINALS:
+                    continue
+                for name in list(call.args) + list(call.kw_args.values()):
+                    if not name or "." in name:
+                        continue
+                    for ref in graph.resolve(facts, fn, name):
+                        seeds.add(ref)
+
+        covered: Set[FnKey] = set()
+        stack = list(seeds)
+        while stack:
+            key = stack.pop()
+            if key in covered:
+                continue
+            covered.add(key)
+            for kid in kids.get(key, ()):          # traced closures
+                stack.append(kid)
+            for ref, _ in graph.edges.get(key, ()):  # call edges
+                stack.append(ref)
+            fn = graph.functions.get(key)
+            if fn is None:
+                continue
+            facts = project.files.get(key[0])
+            if facts is None:
+                continue
+            for call in fn.calls:                  # fn-name arguments
+                for name in list(call.args) + list(call.kw_args.values()):
+                    if not name or "." in name:
+                        continue
+                    for ref in graph.resolve(facts, fn, name):
+                        stack.append(ref)
+        return covered, wrappers
+
+    # -- the pass -------------------------------------------------------------
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        covered, wrappers = self._covered(project, graph)
+        findings: List[Finding] = []
+        for rel, facts, fn in project.iter_functions():
+            if not rel.startswith("spatialflink_tpu/") \
+                    or is_test_relpath(rel) or not in_scope(rel):
+                continue
+            if (rel, fn.qualname) in covered:
+                continue
+            for call in fn.calls:
+                if not _is_collective_call(facts, call.target):
+                    continue
+                findings.append(Finding(
+                    rel, call.lineno, call.end_lineno, self.name,
+                    f"collective `{call.target}(…)` is not reachable "
+                    f"from any parallel/ wrapper that feeds "
+                    f"telemetry.account_collective — its ICI traffic is "
+                    f"invisible to the per-node collective ledger "
+                    f"(dagmon conservation undercounts silently); route "
+                    f"it through an accounted parallel/ entry",
+                    evidence=(
+                        f"{rel}:{call.lineno}: `{call.target}(…)` moves "
+                        f"bytes over a mesh axis",
+                        f"{rel}:{fn.lineno}: enclosing `{fn.name}` is "
+                        f"unreachable from all {len(wrappers)} "
+                        f"accounting wrapper(s) in parallel/ (call, "
+                        f"closure-nesting, and kernel-name-argument "
+                        f"edges searched)",
+                    ),
+                ))
+        findings.sort(key=lambda f: (f.path, f.lineno))
+        return findings

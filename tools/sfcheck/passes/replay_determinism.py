@@ -1,0 +1,188 @@
+"""replay-determinism pass — no nondeterminism reachable from egress,
+checkpoint, or shed-decision code.
+
+Invariant (the static twin of the chaos matrix's byte-identical-resume
+contract, PARITY.md "Fault tolerance"): **everything that decides egress
+bytes, checkpoint payloads, or shed/degrade transitions must be a pure
+function of the replayed event stream.** The dynamic tier proves it
+after the fact — kill -9, resume, diff the sinks; this pass proves it
+before commit by tainting nondeterminism SOURCES and walking the strict
+call graph from the decision roots:
+
+- **wall-clock** — ``time.time()``/``perf_counter()``/
+  ``datetime.now()``-family reads: a resumed run re-executes the window
+  at a different wall time, so any egress/shed decision derived from it
+  diverges (event-time via the watermark clock is the sanctioned
+  replacement — ``fromtimestamp``/``strptime`` are pure conversions and
+  stay legal);
+- **unseeded random** — module-level ``random.*``/``np.random.*`` draws
+  and zero-arg ``default_rng()``/``RandomState()``/``Random()``
+  constructors (a seeded generator checkpointed with the operator is
+  deterministic; the ambient singletons are not);
+- **set-iteration** — ``for x in {…}`` / iterating a set-typed local or
+  ``set(…)`` result: CPython set order varies across processes (hash
+  randomization), so iteration order leaks into output order unless
+  wrapped in ``sorted(…)``;
+- **fs-order** — ``os.listdir``/``scandir``/``glob``/``iterdir``/
+  ``rglob`` results are filesystem-order, not sorted; resume on another
+  host (or after a compaction) reorders them;
+- **id-order** — ``key=id`` sorts and ``d[id(x)]`` keying: CPython
+  addresses are allocation-order artifacts and never replay-stable.
+
+Roots are the decision surfaces named by the contract: checkpoint
+publishers (``state``/``*_state`` shapes and ``save_checkpoint``
+callers), ``commit`` on sink classes, ``render*`` egress formatters, and
+every ``OverloadController`` method (shed triggers are event-time
+deterministic BY DESIGN — PARITY.md "Overload & degradation").
+
+Telemetry/bench timing is measurement, not decision: ``telemetry.py``,
+``bench*`` modules, and ``tools/`` are exempt — traversal never enters
+them (the established allowlist mechanism). Findings anchor at the
+nondeterminism SITE, so one ``# sfcheck: ok=replay-determinism`` pragma
+there covers every root that reaches it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tools.sfcheck.core import Finding, ProjectPass
+from tools.sfcheck.project import (
+    MODULE_FN,
+    CKPT_SAVE_TERMINALS,
+    is_ckpt_publisher_name,
+    is_test_relpath,
+)
+
+FnKey = Tuple[str, str]
+
+#: The one controller class whose every method is a shed/degrade
+#: decision surface (PARITY.md "Overload & degradation").
+_CONTROLLER_CLASSES = frozenset({"OverloadController"})
+
+_KIND_FIX = {
+    "wall-clock": ("derive the value from event time / the watermark "
+                   "clock, or move the read behind telemetry"),
+    "unseeded-random": ("seed an explicit generator and checkpoint it "
+                        "with the operator state"),
+    "set-iteration": ("wrap the iterable in `sorted(…)` before "
+                      "iterating"),
+    "fs-order": ("wrap the listing in `sorted(…)`"),
+    "id-order": ("key by a stable identity (objID, name, index) "
+                 "instead of `id()`"),
+}
+
+
+def _exempt_rel(rel: str) -> bool:
+    """Measurement-plane files: traversal never enters them and sites
+    inside them are never findings."""
+    base = rel.split("/")[-1]
+    return (base == "telemetry.py" or base.startswith("bench")
+            or rel.startswith("tools/") or is_test_relpath(rel))
+
+
+def _root_kind(rel: str, facts, fn) -> Optional[str]:
+    """Human description when this function is a decision root."""
+    if fn.qualname == MODULE_FN:
+        return None
+    if is_ckpt_publisher_name(fn.name) or any(
+            c.target.split(".")[-1] in CKPT_SAVE_TERMINALS
+            for c in fn.calls):
+        return "checkpoint publisher"
+    if fn.cls is not None and fn.cls in _CONTROLLER_CLASSES:
+        return "shed/degrade trigger"
+    if fn.name == "commit" and fn.cls is not None and "Sink" in fn.cls:
+        return "exactly-once egress commit"
+    if fn.name == "render" or fn.name.startswith("render_"):
+        return "egress render path"
+    return None
+
+
+class ReplayDeterminismPass(ProjectPass):
+    name = "replay-determinism"
+    description = ("no wall-clock, unseeded random, set/dict-order, "
+                   "fs-order, or id()-keyed nondeterminism reachable "
+                   "from egress commit / render, checkpoint publish, or "
+                   "overload shed-decision code")
+    invariant = ("kill-anywhere resume stays byte-identical: egress "
+                 "bytes, checkpoint payloads, and shed transitions are "
+                 "pure functions of the replayed event stream")
+
+    def in_scope(self, relpath: str) -> bool:
+        return not is_test_relpath(relpath)
+
+    # -- per-function reachable-site summaries (strict-edge fixpoint) ---------
+
+    def _build_summaries(self, project, graph):
+        strict_edges: Dict[FnKey, List[Tuple[FnKey, int]]] = {}
+        reach: Dict[FnKey, Dict[Tuple, List[str]]] = {}
+        for rel, facts, fn in project.iter_functions():
+            key = (rel, fn.qualname)
+            out = []
+            if not _exempt_rel(rel):
+                for call in fn.calls:
+                    for ref in graph.resolve(facts, fn, call.target,
+                                             strict=True):
+                        if not _exempt_rel(ref[0]):
+                            out.append((ref, call.lineno))
+            strict_edges[key] = out
+            reach[key] = {} if _exempt_rel(rel) else {
+                (rel, s["lineno"], s["kind"]): [
+                    s, f"{rel}:{s['lineno']}: {s['desc']}"]
+                for s in fn.nondet_sites
+            }
+        changed = True
+        guard = 0
+        while changed and guard < 50:
+            changed = False
+            guard += 1
+            for key, edges in strict_edges.items():
+                for ref, lineno in edges:
+                    if ref == key:
+                        continue
+                    callee = graph.functions.get(ref)
+                    if callee is None:
+                        continue
+                    step = (f"{key[0]}:{lineno}: "
+                            f"`{graph.functions[key].name}` calls "
+                            f"`{callee.name}(…)`")
+                    for sid, chain in reach.get(ref, {}).items():
+                        if sid not in reach[key]:
+                            reach[key][sid] = [chain[0], step] \
+                                + chain[1:]
+                            changed = True
+        return reach
+
+    # -- the pass -------------------------------------------------------------
+
+    def run_project(self, project, graph, in_scope) -> List[Finding]:
+        reach = self._build_summaries(project, graph)
+        findings: List[Finding] = []
+        seen_sites = set()
+        for rel, facts, fn in project.iter_functions():
+            if _exempt_rel(rel):
+                continue
+            root_desc = _root_kind(rel, facts, fn)
+            if root_desc is None:
+                continue
+            head = (f"{rel}:{fn.lineno}: `{fn.name}` is a "
+                    f"replay-determinism root ({root_desc})")
+            for sid, chain in sorted(
+                    reach.get((rel, fn.qualname), {}).items(),
+                    key=lambda kv: (kv[0][0], kv[0][1])):
+                s_rel, s_line, kind = sid
+                if sid in seen_sites or not in_scope(s_rel):
+                    continue
+                seen_sites.add(sid)
+                site = chain[0]
+                findings.append(Finding(
+                    s_rel, s_line, site.get("end_lineno", s_line),
+                    self.name,
+                    f"{site['desc']} is reachable from {root_desc} "
+                    f"`{fn.name}` — a resumed run replays this path "
+                    f"with a different {kind} outcome, breaking "
+                    f"byte-identical resume; {_KIND_FIX[kind]}",
+                    evidence=tuple([head] + chain[1:]),
+                ))
+        findings.sort(key=lambda f: (f.path, f.lineno))
+        return findings

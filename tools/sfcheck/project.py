@@ -41,6 +41,17 @@ passes need:
   twin-contract surfaces: version pins, ``SPEC_KEYS``,
   ``INJECTION_POINTS``, the chaos ``MATRIX``, ``ENV_VARS``).
 
+- **checkpoint & determinism facts** (the v4 passes): per-function
+  checkpoint payload writes (string dict-literal keys, ``out["k"] = …``
+  subscript stores, ``save_checkpoint(p, k=…)`` kwargs — each with a
+  CONDITIONAL flag from enclosing If/except context) and reads (bare
+  ``state["k"]`` subscripts incl. literal-string loop vars,
+  ``.get("k"[, default])``, ``"k" in state`` guards), kept only for
+  publisher/restorer-shaped functions; and ``nondet_sites`` — wall-clock
+  reads, global unseeded RNG draws, set-order iteration, unsorted
+  filesystem enumeration, ``id()``-keyed ordering — for every function
+  (replay-determinism's taint sources).
+
 Facts round-trip through JSON (``to_dict``/``facts_from_dict``) so the
 incremental cache can skip re-parsing unchanged files entirely.
 
@@ -107,6 +118,40 @@ EMIT_NAME_TERMINALS = frozenset({
     "emit_instant", "_emit_locked", "_telemetry_instant",
 })
 
+#: The framed-CRC checkpoint publish/load entry points
+#: (spatialflink_tpu/checkpoint.py) — a function calling one is a
+#: checkpoint publisher/restorer even without the naming convention.
+CKPT_SAVE_TERMINALS = frozenset({"save_checkpoint"})
+CKPT_LOAD_TERMINALS = frozenset({"load_checkpoint"})
+
+#: Module-level ``random`` draws that consult the shared, unseeded
+#: global generator (the seeded ``random.Random(seed)`` / ``np.random.
+#: default_rng(seed)`` instance idiom is NOT matched — receivers are
+#: local names, not the module).
+NONDET_RANDOM_FNS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "betavariate", "gammavariate", "triangular",
+    "vonmisesvariate", "paretovariate", "weibullvariate", "getrandbits",
+    "randbytes",
+})
+NONDET_NP_RANDOM_FNS = frozenset({
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "choice", "shuffle", "permutation", "uniform", "normal",
+    "standard_normal", "poisson", "exponential", "beta", "binomial",
+    "gamma", "bytes",
+})
+#: RNG constructors that are only deterministic when SEEDED — a
+#: zero-argument call is a nondeterminism source.
+NONDET_RNG_CTORS = frozenset({"default_rng", "RandomState", "Random"})
+#: Filesystem enumerations whose order is filesystem-dependent unless
+#: wrapped in ``sorted(…)``.
+NONDET_FS_FNS = frozenset({"listdir", "scandir", "iterdir", "glob",
+                           "iglob", "rglob"})
+#: ``datetime``/``date`` classmethods that read the wall clock
+#: (``fromtimestamp``/``strptime`` are pure conversions — not listed).
+NONDET_DATETIME_FNS = frozenset({"now", "utcnow", "today"})
+
 _ENV_NAME_RE = None  # compiled lazily (module import stays light)
 
 
@@ -170,6 +215,21 @@ class FunctionFacts:
     #: instant-event emit sites: {"name": literal name or f-string head
     #: or None (dynamic), "prefix": bool, "via", "lineno", "end_lineno"}
     emit_sites: List[dict] = dataclasses.field(default_factory=list)
+    #: checkpoint payload writes (v4, kept only for publisher/restorer-
+    #: shaped functions): {"key", "lineno", "conditional": bool,
+    #: "recv": dotted receiver of a subscript store or None (dict
+    #: literal / save_checkpoint kwarg)}
+    ckpt_writes: List[dict] = dataclasses.field(default_factory=list)
+    #: checkpoint payload reads (v4): {"key", "how": getitem|get|
+    #: get_default|contains, "lineno", "conditional": bool, "recv"}
+    ckpt_reads: List[dict] = dataclasses.field(default_factory=list)
+    #: the payload is built/consumed dynamically (``.update(…)``,
+    #: ``**unpack``, ``save_checkpoint(p, **comps)``) — key-set checks
+    #: that need the FULL set must not run against this side
+    ckpt_dynamic: bool = False
+    #: nondeterminism sites (v4): {"kind": wall-clock|unseeded-random|
+    #: set-iteration|fs-order|id-order, "desc", "lineno", "end_lineno"}
+    nondet_sites: List[dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -258,6 +318,18 @@ class _Extractor(ast.NodeVisitor):
         self.loop_stack: List[Tuple[int, int, bool]] = []  # (start, end, window)
         self.tainted_stack: List[set] = []
         self.names_used: set = set()
+        #: depth of enclosing If/IfExp/except-handler within the current
+        #: function — a checkpoint write/read at depth > 0 is CONDITIONAL
+        #: (the legacy-default schema analysis keys on this)
+        self._cond = 0
+        #: per-function: loop var bound to a literal string tuple/list
+        #: (``for key in ("a", "b"): st[key]`` reads both keys)
+        self._str_loopvars: Dict[str, List[str]] = {}
+        #: per-function set-taint: local name -> why it holds a set
+        self._set_taint_stack: List[dict] = []
+        #: ast node ids sanctioned by an enclosing ``sorted(…)`` — an
+        #: fs-order/set source fed straight into sorted is deterministic
+        self._sorted_args: set = set()
         module_fn = FunctionFacts(MODULE_FN, MODULE_FN, 1, 10 ** 9)
         facts.functions[MODULE_FN] = module_fn
         self.fn_stack.append(module_fn)
@@ -375,11 +447,19 @@ class _Extractor(ast.NodeVisitor):
         self.facts.functions[qual] = fn
         self.fn_stack.append(fn)
         self.tainted_stack.append({})
+        self._set_taint_stack.append({})
         saved_loops = self.loop_stack
+        saved_cond = self._cond
+        saved_slv = self._str_loopvars
         self.loop_stack = []
+        self._cond = 0          # a nested def runs unconditionally
+        self._str_loopvars = {}  # relative to its own entry
         for stmt in node.body:
             self.visit(stmt)
         self.loop_stack = saved_loops
+        self._cond = saved_cond
+        self._str_loopvars = saved_slv
+        self._set_taint_stack.pop()
         self.tainted_stack.pop()
         self.fn_stack.pop()
 
@@ -415,6 +495,38 @@ class _Extractor(ast.NodeVisitor):
         for name in node.names:
             if name not in self.fn.global_decls:
                 self.fn.global_decls.append(name)
+
+    # -- conditional context (checkpoint-schema's legacy-default rule) -------
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self._cond += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._cond -= 1
+
+    def visit_IfExp(self, node):
+        self.visit(node.test)
+        self._cond += 1
+        self.visit(node.body)
+        self.visit(node.orelse)
+        self._cond -= 1
+
+    def visit_Try(self, node):
+        # The try body is the MAIN path (a publish inside ``try`` is
+        # attempted unconditionally); only handlers/orelse branch.
+        for stmt in node.body:
+            self.visit(stmt)
+        self._cond += 1
+        for handler in node.handlers:
+            self.visit(handler)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._cond -= 1
+        for stmt in node.finalbody:
+            self.visit(stmt)
 
     # -- lock scopes ---------------------------------------------------------
 
@@ -486,6 +598,17 @@ class _Extractor(ast.NodeVisitor):
         self.fn.loops.append(span)
         if window:
             self.fn.window_loops.append(span)
+        # ``for key in ("a", "b"):`` binds a literal-string loop var —
+        # later ``rec[key]`` subscripts read every listed key (the
+        # restore_dag counter-loop idiom).
+        if isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, (ast.Tuple, ast.List)) \
+                and node.iter.elts and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in node.iter.elts):
+            self._str_loopvars[node.target.id] = [
+                e.value for e in node.iter.elts]
+        self._check_iter_nondet(node.iter)
         self.visit(node.iter)
         # Loop indices over runtime collections are data-dependent ints.
         if self.tainted_stack and isinstance(node.iter, ast.Call):
@@ -506,6 +629,43 @@ class _Extractor(ast.NodeVisitor):
         for stmt in node.orelse:
             self.visit(stmt)
 
+    # -- nondeterminism sites (replay-determinism) ---------------------------
+
+    def _nondet(self, kind: str, desc: str, node: ast.AST):
+        self.fn.nondet_sites.append({
+            "kind": kind, "desc": desc, "lineno": node.lineno,
+            "end_lineno": getattr(node, "end_lineno", None) or node.lineno,
+        })
+
+    def _check_iter_nondet(self, it: ast.AST):
+        """Iterating a set is order-nondeterministic (hash-seed order);
+        ``sorted(…)`` wrappers are deterministic by construction."""
+        if id(it) in self._sorted_args:
+            return
+        why = self._set_valued(it)
+        if why is None and isinstance(it, ast.Name):
+            reason = self._set_taint().get(it.id)
+            if reason:
+                why = f"`{it.id}` holds {reason}"
+        if why:
+            self._nondet("set-iteration",
+                         f"iteration over {why} — element order follows "
+                         f"the hash seed, not the data", it)
+
+    def _visit_comp(self, node):
+        # SetComp output is itself unordered — re-collecting a set from
+        # a set adds no ordering dependency, so only list/dict/generator
+        # comprehensions check their sources.
+        if not isinstance(node, ast.SetComp):
+            for gen in node.generators:
+                self._check_iter_nondet(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
     def visit_While(self, node):
         span = (node.lineno, node.end_lineno or node.lineno)
         self.fn.loops.append(span)
@@ -523,7 +683,30 @@ class _Extractor(ast.NodeVisitor):
         self.visit(node.value)
         for t in node.targets:
             self._record_store_taint(t, node.value)
+            self._record_set_taint(t, node.value)
             self.visit(t)
+
+    def _set_taint(self) -> dict:
+        return self._set_taint_stack[-1] if self._set_taint_stack else {}
+
+    def _set_valued(self, value: ast.AST) -> Optional[str]:
+        """Why ``value`` is a set (order-unstable container), or None."""
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            return "a set literal"
+        if isinstance(value, ast.Call):
+            d = dotted(value.func)
+            if d in ("set", "frozenset"):
+                return f"a `{d}(…)` result"
+        return None
+
+    def _record_set_taint(self, target: ast.AST, value: ast.AST):
+        if not self._set_taint_stack or not isinstance(target, ast.Name):
+            return
+        why = self._set_valued(value)
+        if why:
+            self._set_taint_stack[-1][target.id] = why
+        else:
+            self._set_taint_stack[-1].pop(target.id, None)
 
     def visit_AugAssign(self, node):
         self.visit(node.value)
@@ -537,7 +720,48 @@ class _Extractor(ast.NodeVisitor):
         if node.value is not None:
             self.visit(node.value)
             self._record_store_taint(node.target, node.value)
+            self._record_set_taint(node.target, node.value)
         self.visit(node.target)
+
+    # -- checkpoint payload facts (checkpoint-schema) ------------------------
+
+    def visit_Dict(self, node):
+        # String-literal dict keys are checkpoint payload writes when
+        # the enclosing function is publisher-shaped (pruned otherwise).
+        for k in node.keys:
+            if k is None:
+                # ``{**base, …}`` unpacking: the key set is dynamic
+                self.fn.ckpt_dynamic = True
+            elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                self.fn.ckpt_writes.append({
+                    "key": k.value, "lineno": k.lineno,
+                    "conditional": self._cond > 0, "recv": None,
+                })
+        self.generic_visit(node)
+
+    def _check_ckpt_subscript(self, node: ast.Subscript, d: Optional[str]):
+        if d and (d == "environ" or d.endswith(".environ")):
+            return  # env access, not checkpoint payload
+        keys = None
+        if isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            keys = [node.slice.value]
+        elif isinstance(node.slice, ast.Name):
+            keys = self._str_loopvars.get(node.slice.id)
+        if not keys:
+            return
+        if isinstance(node.ctx, ast.Store):
+            for k in keys:
+                self.fn.ckpt_writes.append({
+                    "key": k, "lineno": node.lineno,
+                    "conditional": self._cond > 0, "recv": d,
+                })
+        else:
+            for k in keys:
+                self.fn.ckpt_reads.append({
+                    "key": k, "how": "getitem", "lineno": node.lineno,
+                    "conditional": self._cond > 0, "recv": d,
+                })
 
     # -- names ---------------------------------------------------------------
 
@@ -595,7 +819,136 @@ class _Extractor(ast.NodeVisitor):
         self._check_shape_sink(node, d)
         self._check_env_access(node, d)
         self._check_emit_site(node, d)
+        self._check_ckpt_call(node, d)
+        self._check_nondet(node, d)
+        if d is not None and d.split(".")[-1] == "sorted":
+            # arguments fed straight into sorted() are order-laundered
+            for a in node.args:
+                self._sorted_args.add(id(a))
         self.generic_visit(node)
+
+    def _check_ckpt_call(self, node: ast.Call, d: Optional[str]):
+        """Checkpoint payload facts carried by calls: ``X.get("k"[, dflt])``
+        defaulted reads, ``X.update(…)`` dynamic builds, and
+        ``save_checkpoint(path, comp=…)`` kwarg publishes."""
+        if d is None:
+            return
+        parts = d.split(".")
+        term = parts[-1]
+        if term in ("get", "pop") and len(parts) >= 2 and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            recv = ".".join(parts[:-1])
+            if recv == "os" or recv == "environ" \
+                    or recv.endswith(".environ"):
+                return  # env access is its own fact kind
+            how = "get" if len(node.args) == 1 else "get_default"
+            if term == "pop" and len(node.args) == 1:
+                how = "getitem"  # .pop(k) raises like a bare subscript
+            self.fn.ckpt_reads.append({
+                "key": node.args[0].value, "how": how,
+                "lineno": node.lineno, "conditional": self._cond > 0,
+                "recv": recv,
+            })
+        elif term == "update" and len(parts) >= 2:
+            self.fn.ckpt_dynamic = True
+        elif term in CKPT_SAVE_TERMINALS:
+            for kw in node.keywords:
+                if kw.arg:
+                    self.fn.ckpt_writes.append({
+                        "key": kw.arg, "lineno": node.lineno,
+                        "conditional": self._cond > 0, "recv": None,
+                    })
+                else:
+                    self.fn.ckpt_dynamic = True
+
+    def _check_nondet(self, node: ast.Call, d: Optional[str]):
+        if self.b.wall_clock_call(node.func) is not None:
+            self._nondet("wall-clock",
+                         f"wall-clock read `{d or '…'}(…)`", node)
+            return
+        if d is None:
+            return
+        parts = d.split(".")
+        term = parts[-1]
+        if term in NONDET_DATETIME_FNS and any(
+                p in ("datetime", "date") for p in parts[:-1]):
+            self._nondet("wall-clock", f"wall-clock read `{d}(…)`", node)
+            return
+        imp = self.facts.imports.get(parts[0])
+        # module-level random draws: ``random.shuffle`` / bare
+        # ``shuffle`` via ``from random import shuffle``
+        if len(parts) == 2 and term in NONDET_RANDOM_FNS \
+                and imp is not None and imp["kind"] == "module" \
+                and imp["target"] == "random":
+            self._nondet("unseeded-random",
+                         f"global unseeded RNG draw `{d}(…)`", node)
+            return
+        if len(parts) == 1 and imp is not None \
+                and imp["kind"] == "object" and imp["target"] == "random" \
+                and imp["attr"] in NONDET_RANDOM_FNS:
+            self._nondet("unseeded-random",
+                         f"global unseeded RNG draw `random.{imp['attr']}(…)`",
+                         node)
+            return
+        # numpy global draws: np.random.shuffle etc.
+        if term in NONDET_NP_RANDOM_FNS and len(parts) >= 2 \
+                and parts[-2] == "random":
+            root_is_np = parts[0] in self.b.np_modules \
+                or parts[0] == "numpy" \
+                or (imp is not None and imp["kind"] == "module"
+                    and imp["target"] == "numpy")
+            if (len(parts) == 2 and imp is not None
+                    and imp["kind"] == "object"
+                    and imp["target"] == "numpy") or \
+                    (len(parts) == 3 and root_is_np):
+                self._nondet("unseeded-random",
+                             f"global unseeded RNG draw `{d}(…)`", node)
+                return
+        # unseeded RNG constructors: default_rng() / Random() with no seed
+        if term in NONDET_RNG_CTORS and not node.args and not node.keywords:
+            rng_root = (len(parts) >= 2 and (
+                parts[-2] == "random"
+                or (imp is not None and imp["kind"] == "module"
+                    and imp["target"] in ("random", "numpy")))) \
+                or (len(parts) == 1 and imp is not None
+                    and imp["kind"] == "object"
+                    and imp["target"] in ("random", "numpy.random"))
+            if rng_root:
+                self._nondet("unseeded-random",
+                             f"unseeded RNG constructor `{d}()` — pass an "
+                             f"explicit seed", node)
+                return
+        # filesystem enumeration order
+        if term in NONDET_FS_FNS and id(node) not in self._sorted_args:
+            fs_root = (len(parts) == 2 and (
+                parts[0] in ("os", "glob")
+                or (imp is not None and imp["kind"] == "module"
+                    and imp["target"] in ("os", "glob")))) \
+                or (len(parts) == 1 and imp is not None
+                    and imp["kind"] == "object"
+                    and imp["target"] in ("os", "glob")) \
+                or term in ("iterdir", "rglob")
+            if fs_root:
+                self._nondet("fs-order",
+                             f"unsorted filesystem enumeration `{d}(…)` — "
+                             f"wrap in sorted(…)", node)
+                return
+        # id()-keyed ordering: sorted(xs, key=id)
+        if term in ("sorted", "sort", "min", "max"):
+            for kw in node.keywords:
+                if kw.arg != "key":
+                    continue
+                kd = dotted(kw.value)
+                uses_id = kd == "id" or (
+                    isinstance(kw.value, ast.Lambda) and any(
+                        isinstance(n, ast.Call) and dotted(n.func) == "id"
+                        for n in ast.walk(kw.value)))
+                if uses_id:
+                    self._nondet("id-order",
+                                 f"`{d}(…, key=id)` orders by object "
+                                 f"address (ASLR-reshuffled per process)",
+                                 node)
 
     def _check_env_access(self, node: ast.Call, d: Optional[str]):
         """os.environ.get / os.getenv / environ.setdefault reads and
@@ -669,6 +1022,13 @@ class _Extractor(ast.NodeVisitor):
                         "lineno": node.lineno,
                         "end_lineno": node.end_lineno or node.lineno,
                     })
+            elif isinstance(node.left.value, str):
+                # ``"key" in state`` — the legacy-default guard idiom
+                self.fn.ckpt_reads.append({
+                    "key": node.left.value, "how": "contains",
+                    "lineno": node.lineno,
+                    "conditional": self._cond > 0, "recv": d,
+                })
         self.generic_visit(node)
 
     def visit_Subscript(self, node):
@@ -685,6 +1045,15 @@ class _Extractor(ast.NodeVisitor):
                     "lineno": node.lineno,
                     "end_lineno": node.end_lineno or node.lineno,
                 })
+        self._check_ckpt_subscript(node, d)
+        # ``table[id(obj)] = …`` — id()-keyed maps iterate in address
+        # order, which ASLR reshuffles every process
+        if isinstance(node.slice, ast.Call) \
+                and dotted(node.slice.func) == "id":
+            self._nondet("id-order",
+                         "`id(…)`-keyed container — key order follows "
+                         "object addresses (ASLR-reshuffled per process)",
+                         node)
         self.generic_visit(node)
 
     def _check_eager_jnp(self, node: ast.Call):
@@ -849,6 +1218,37 @@ def is_test_relpath(relpath: str) -> bool:
     return parts[0] == "tests" or parts[-1].startswith("test_")
 
 
+def is_ckpt_publisher_name(name: str) -> bool:
+    """The repo's checkpoint-publish naming convention: ``state`` /
+    ``substate`` methods and ``<stem>_state`` functions."""
+    return name in ("state", "substate") or (
+        name.endswith("_state") and not name.startswith("restore"))
+
+
+def is_ckpt_restorer_name(name: str) -> bool:
+    return name == "restore" or name.startswith("restore_")
+
+
+def _calls_ckpt_io(fn: FunctionFacts) -> bool:
+    for call in fn.calls:
+        term = call.target.split(".")[-1]
+        if term in CKPT_SAVE_TERMINALS or term in CKPT_LOAD_TERMINALS:
+            return True
+    return False
+
+
+def _prune_ckpt(fn: FunctionFacts):
+    """Checkpoint payload facts only matter for publisher/restorer-shaped
+    functions — dict literals and ``.get("k")`` calls are everywhere
+    else, and keeping them would bloat every cache entry."""
+    if is_ckpt_publisher_name(fn.name) or is_ckpt_restorer_name(fn.name) \
+            or _calls_ckpt_io(fn):
+        return
+    fn.ckpt_writes = []
+    fn.ckpt_reads = []
+    fn.ckpt_dynamic = False
+
+
 def _prune_books(fn: FunctionFacts):
     """Keep load/store lines only for names the donation-safety pass can
     ever ask about — positional call arguments and names stored on a
@@ -880,6 +1280,7 @@ def extract_facts(relpath: str, tree: ast.AST, source: str,
         else []
     for fn in facts.functions.values():
         _prune_books(fn)
+        _prune_ckpt(fn)
         _pair_lock_acquires(fn)
     _module_scan(facts, tree)
     facts.pragmas = scan_pragmas(source)
