@@ -1434,6 +1434,279 @@ def bench_tknn(jax, jnp, grid, quick):
                    spread=(t_min, t_max), resident=(pps_r, r_min, r_max))
 
 
+# -- grid-partitioned halo configs (8-device CPU mesh, subprocess) -----------
+
+HALO_SHARDS = 8
+_HALO_CONFIGS = ("range_8shard_halo", "tjoin_8shard_halo")
+
+
+def _halo_child_range(quick: bool) -> dict:
+    """``range_8shard_halo`` child body: the grid-partitioned range
+    kernel (parallel/halo.py:sharded_range_halo) on the 8-device CPU
+    mesh vs the replicated ``sharded_range_query`` on the SAME windows.
+    EPS comes from the halo path; the accounted collective bytes of
+    BOTH paths come from the telemetry snapshot, so the record stamps
+    measured halo vs broadcast/all-gather traffic."""
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.parallel.halo import sharded_range_halo
+    from spatialflink_tpu.parallel.mesh import data_mesh
+    from spatialflink_tpu.parallel.partition import plan_partition
+    from spatialflink_tpu.parallel.sharded import sharded_range_query
+    from spatialflink_tpu.telemetry import telemetry
+
+    grid = UniformGrid(1024, min_x=115.5, max_x=117.6, min_y=39.6,
+                       max_y=41.1)
+    radius = 0.002  # ≈ one cell → 1-layer halo, boundary region ≈ 1.6%
+    win_pts = 8_192 if quick else 16_384
+    n_win = 2 if quick else 4
+    nq = 4_096
+    rng = np.random.default_rng(47)
+    total = win_pts * n_win
+    xy = np.stack([rng.uniform(115.5, 117.6, total),
+                   rng.uniform(39.6, 41.1, total)], axis=1)
+    qxy = np.stack([rng.uniform(115.6, 117.5, nq),
+                    rng.uniform(39.7, 41.0, nq)], axis=1)
+    cell = grid.assign_cells_np(xy)
+    qcell = grid.assign_cells_np(qxy)
+    valid = np.ones(win_pts, bool)
+    qok = np.ones(nq, bool)
+    mesh = data_mesh(HALO_SHARDS)
+    plan = plan_partition(grid, HALO_SHARDS, radius)
+
+    def halo_pass():
+        hits = 0
+        for i in range(n_win):
+            sl = slice(i * win_pts, (i + 1) * win_pts)
+            keep, _ = sharded_range_halo(
+                mesh, plan, xy[sl], valid, cell[sl], qxy, qcell, qok,
+                radius,
+            )
+            hits += int(keep.sum())
+        return hits
+
+    hits = halo_pass()  # compile every rung signature outside the clock
+    reps = 3
+    telemetry.enable()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        halo_pass()
+        times.append(time.perf_counter() - t0)
+    snap = telemetry.snapshot()
+    telemetry.disable()
+    coll = snap.get("collectives") or {}
+    halo_b = int(((coll.get("by_kind") or {}).get("ppermute") or {})
+                 .get("bytes") or 0) // reps
+    halo_state = int(coll.get("halo_state_bytes") or 0) // reps
+
+    # The replicated path on the same windows: its accounted collective
+    # is the whole-query-set broadcast (every shard receives all nq
+    # queries; the halo path ships only boundary-cell query panes).
+    table = grid.neighbor_flags(radius, [int(c) for c in qcell])
+    telemetry.enable()
+    for i in range(n_win):
+        sl = slice(i * win_pts, (i + 1) * win_pts)
+        keep, _ = sharded_range_query(
+            mesh, xy[sl], valid, table[cell[sl]], qxy, radius,
+        )
+        np.asarray(keep)
+    legacy = (telemetry.snapshot().get("collectives") or {})
+    telemetry.disable()
+    return {
+        "points": n_win * win_pts,
+        "times": times,
+        "halo_collective_bytes": halo_b,
+        "halo_state_bytes": halo_state,
+        "replicated_collective_bytes": int(legacy.get("bytes") or 0),
+        "extra": {"hits": hits, "queries": nq},
+    }
+
+
+def _halo_child_tjoin(quick: bool) -> dict:
+    """``tjoin_8shard_halo`` child body: the grid-partitioned tjoin pane
+    scan (parallel/halo.py:sharded_tjoin_panes_halo) vs the replicated
+    ``sharded_tjoin_pane_scan`` over the SAME panes — the legacy scan
+    all-gathers every pane field + contribution lanes per slide, the
+    halo path ships only boundary-cell window panes."""
+    import jax
+    import jax.numpy as jnp
+
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.operators.base import center_coords
+    from spatialflink_tpu.ops.tjoin_panes import (
+        pane_cell_ranks,
+        tjoin_pane_init,
+    )
+    from spatialflink_tpu.parallel.halo import sharded_tjoin_panes_halo
+    from spatialflink_tpu.parallel.mesh import data_mesh
+    from spatialflink_tpu.parallel.partition import plan_partition
+    from spatialflink_tpu.parallel.sharded import sharded_tjoin_pane_scan
+    from spatialflink_tpu.telemetry import telemetry
+
+    grid = UniformGrid(256, min_x=115.5, max_x=117.6, min_y=39.6,
+                       max_y=41.1)
+    radius = 0.005
+    ppw = 4
+    slide_pts = 1_024 if quick else 2_048
+    n_slides = 5 if quick else 8
+    n_obj = 64
+    total = slide_pts * n_slides
+
+    def mk_side(seed):
+        r = np.random.default_rng(seed)
+        sxy = np.stack([r.uniform(115.5, 117.6, total),
+                        r.uniform(39.6, 41.1, total)], axis=1)
+        return sxy, grid.assign_cells_np(sxy), \
+            r.integers(0, n_obj, total).astype(np.int32)
+
+    lxy, lcell, loid = mk_side(53)
+    rxy, rcell, roid = mk_side(54)
+    ok = np.ones(slide_pts, bool)
+
+    def panes_of(sxy, scell):
+        return [
+            (sxy[i * slide_pts:(i + 1) * slide_pts], ok,
+             scell[i * slide_pts:(i + 1) * slide_pts])
+            for i in range(n_slides)
+        ]
+
+    panes_l, panes_r = panes_of(lxy, lcell), panes_of(rxy, rcell)
+    ts = np.arange(n_slides, dtype=np.int64) * 1000
+    mesh = data_mesh(HALO_SHARDS)
+    plan = plan_partition(grid, HALO_SHARDS, radius)
+
+    def halo_pass():
+        res = sharded_tjoin_panes_halo(
+            mesh, plan, ts, panes_l, panes_r, radius, ppw, 65_536)
+        assert sum(r[4] for r in res) == 0, "pair budget overflow"
+        return sum(r[3] for r in res)
+
+    pairs = halo_pass()  # compile every rung signature outside the clock
+    reps = 3
+    telemetry.enable()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        halo_pass()
+        times.append(time.perf_counter() - t0)
+    snap = telemetry.snapshot()
+    telemetry.disable()
+    coll = snap.get("collectives") or {}
+    halo_b = int(((coll.get("by_kind") or {}).get("ppermute") or {})
+                 .get("bytes") or 0) // reps
+    halo_state = int(coll.get("halo_state_bytes") or 0) // reps
+
+    # The replicated scan on the same panes (probe-parallel legacy
+    # path): per slide it all-gathers both sides' 8 pane field arrays
+    # plus the contribution lanes, and psums the overflow scalars.
+    layers = grid.candidate_layers(radius)
+    cap_w = 16
+
+    def side_fields(sxy, scell, soid):
+        cxy = center_coords(grid, sxy, np.float32)
+        ci = grid.cell_xy_indices_np(sxy)
+        ing = scell < grid.num_cells
+        pane_of = np.repeat(np.arange(n_slides), slide_pts)
+        rank = pane_cell_ranks(pane_of, scell, valid=ing)
+        sh = (n_slides, slide_pts)
+        host = (
+            cxy[:, 0].astype(np.float32), cxy[:, 1].astype(np.float32),
+            ci[:, 0], ci[:, 1],
+            np.where(ing, scell, 0).astype(np.int32),
+            rank.astype(np.int32), soid, ing,
+        )
+        return tuple(jnp.asarray(a.reshape(sh)) for a in host)
+
+    lps = side_fields(lxy, lcell, loid)
+    rps = side_fields(rxy, rcell, roid)
+    telemetry.enable()
+    carry0 = tjoin_pane_init(grid.num_cells, cap_w, ppw, n_obj,
+                             jnp.float32)
+    fin, wmins = sharded_tjoin_pane_scan(
+        mesh, carry0, jnp.arange(n_slides, dtype=jnp.int32), lps, rps,
+        np.float32(radius), grid_n=grid.n, cap_w=cap_w, layers=layers,
+        ppw=ppw, num_ids=n_obj, pair_sel=16,
+    )
+    jax.device_get(wmins)
+    legacy = (telemetry.snapshot().get("collectives") or {})
+    telemetry.disable()
+    return {
+        "points": 2 * total,
+        "times": times,
+        "halo_collective_bytes": halo_b,
+        "halo_state_bytes": halo_state,
+        "replicated_collective_bytes": int(legacy.get("bytes") or 0),
+        "extra": {"ppw": ppw, "traj_pairs": int(pairs)},
+    }
+
+
+def run_halo_child(name: str, quick: bool):
+    """``--halo-child`` entry: runs inside the subprocess the parent
+    config spawns with the 8-device CPU mesh env, prints ONE JSON
+    record on stdout."""
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < HALO_SHARDS:
+        raise SystemExit(
+            f"--halo-child needs {HALO_SHARDS} CPU devices: run via the "
+            "parent config (bench_halo_config pins JAX_PLATFORMS=cpu + "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{HALO_SHARDS})"
+        )
+    fn = {"range_8shard_halo": _halo_child_range,
+          "tjoin_8shard_halo": _halo_child_tjoin}[name]
+    print(json.dumps(fn(quick)))
+
+
+def bench_halo_config(name: str, quick: bool):
+    """Configs ``range_8shard_halo`` / ``tjoin_8shard_halo``: the
+    grid-partitioned halo kernels on an 8-device CPU mesh. The 8
+    virtual devices need XLA_FLAGS *before* jax initializes — which the
+    suite process can't change once its own backend is up — so the
+    measurement runs in a ``--halo-child`` subprocess pinned to the CPU
+    backend. The child's record stamps the accounted collective bytes
+    of the halo path AND the replicated legacy kernel on the same
+    workload; ``halo_vs_replicated`` is the measured traffic ratio."""
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={HALO_SHARDS}",
+    }
+    env.pop("SFT_FAULT_PLAN", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--halo-child",
+           name]
+    if quick:
+        cmd.append("--quick")
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"halo child {name} failed (exit {proc.returncode}):\n"
+            + proc.stderr[-2000:]
+        )
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    times = rec["times"]
+    halo_b = int(rec["halo_collective_bytes"])
+    legacy_b = int(rec["replicated_collective_bytes"])
+    extra = {
+        "shards": HALO_SHARDS,
+        "halo_collective_bytes": halo_b,
+        "halo_state_bytes": int(rec["halo_state_bytes"]),
+        "replicated_collective_bytes": legacy_b,
+        "halo_vs_replicated":
+            round(halo_b / legacy_b, 4) if legacy_b else None,
+    }
+    extra.update(rec.get("extra") or {})
+    return _result(name, rec["points"], float(np.median(times)), extra,
+                   spread=(min(times), max(times)))
+
+
 def run_ablation(benches, top_n=6, ledger_dir=None):
     """The measured kernel-ablation sweep (``--ablate``;
     ``spatialflink_tpu/ablation.py``): per config, a clean baseline run
@@ -1536,7 +1809,17 @@ def main():
              "tunnel day: capture configs one at a time instead of "
              "risking the whole suite on one dial.",
     )
+    ap.add_argument(
+        "--halo-child", default=None, choices=_HALO_CONFIGS,
+        metavar="CONFIG",
+        help="internal: run one halo config's measurement body in THIS "
+             "process (the parent spawns it with the 8-device CPU-mesh "
+             "env, which must be set before jax initializes)",
+    )
     args = ap.parse_args()
+    if args.halo_child:
+        run_halo_child(args.halo_child, args.quick)
+        return
     if args.cpu_baseline and args.configs:
         ap.error(
             "--configs cannot combine with --cpu-baseline: the baseline "
@@ -1605,6 +1888,10 @@ def main():
          lambda: bench_qserve(jax, jnp, grid, args.quick)),
         ("sncb_dag_7node",
          lambda: bench_sncb_dag(jax, jnp, grid, args.quick)),
+        ("range_8shard_halo",
+         lambda: bench_halo_config("range_8shard_halo", args.quick)),
+        ("tjoin_8shard_halo",
+         lambda: bench_halo_config("tjoin_8shard_halo", args.quick)),
     ]
     if args.configs:
         wanted = [w.strip() for w in args.configs.split(",") if w.strip()]

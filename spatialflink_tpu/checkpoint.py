@@ -32,6 +32,10 @@ Snapshottable components:
     ``dag`` component — published atomically with the shared assembler,
     interner, source position, and the MultiSink marker map (the atomic
     unit checkpoint of the composed SNCB pipeline);
+  - PartitionPlan (parallel/partition.py): the grid-partitioned
+    placement map — per-shard contiguous flat-cell bounds + halo width —
+    published with the operator state it placed so a resume re-dispatches
+    onto the SAME placement (restore validates the shard count);
   - Interner: the objID vocabulary (so dense ids stay stable on resume);
   - WireKafkaSource: per-partition consumed offsets (kafka_source_state)
     — Flink's checkpointed Kafka-consumer role, so kill-and-resume
@@ -160,6 +164,12 @@ def operator_state(op) -> Dict[str, Any]:
                 "counts", [1] * len(wire_pane["digests"])
             )],
         }
+    pplan = getattr(op, "partition_plan", None)
+    if pplan is not None:  # grid-partitioned placement (parallel/partition.py)
+        # The per-shard partition map rides the SAME framed-CRC unit
+        # publish as the operator state it placed — resume validates the
+        # shard count against the restoring mesh before any dispatch.
+        out["partition"] = pplan.to_dict()
     qreg = getattr(op, "qserve_registry", None)
     if qreg is not None:  # qserve standing-query registry (qserve.py)
         out["qserve"] = qreg.state()
@@ -240,6 +250,21 @@ def restore_operator(op, state: Dict[str, Any]) -> None:
         # _adopt_assembler) so resumed nodes see their backend/substate
         # before the first replayed window fires.
         op.restore_dag(state["dag"])
+    if "partition" in state:  # pre-halo checkpoints carry no plan
+        # Lazy import: partition.py is jax-free numpy, so restoring a
+        # plan never touches the device runtime.
+        from spatialflink_tpu.parallel.partition import PartitionPlan
+
+        plan = PartitionPlan.from_dict(state["partition"])
+        current = getattr(op, "partition_plan", None)
+        if current is not None and current.n_shards != plan.n_shards:
+            raise ValueError(
+                f"checkpoint partition plan is for {plan.n_shards} "
+                f"shard(s) but the resuming operator is configured for "
+                f"{current.n_shards} — re-plan and re-checkpoint "
+                f"instead of resuming across a shard-count change"
+            )
+        op.partition_plan = plan
     if "qserve" in state and getattr(op, "qserve_registry", None) \
             is not None:
         # Flag tables are derived (rebuilt from the grid inside

@@ -844,6 +844,76 @@ def run_chaos_child(workdir: str) -> int:
     return n
 
 
+def run_chaos_sharded_child(workdir: str) -> int:
+    """One (possibly fault-armed) GRID-PARTITIONED pipeline run on the
+    8-device CPU mesh: ``run_partitioned`` (parallel/halo.py halo
+    exchange) → exactly-once CSV egress + checkpoint, with the partition
+    plan riding the framed unit publish. The ``shard.exchange`` chaos
+    point fires once per window inside the halo wrapper, so an armed
+    abort kills the process mid-exchange; a resume must re-dispatch onto
+    the checkpointed placement and converge byte-identically
+    (tests/test_chaos_matrix.py).
+
+    Needs 8 CPU devices (the parent sets
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from spatialflink_tpu.grid import UniformGrid
+    from spatialflink_tpu.models.objects import Point
+    from spatialflink_tpu.operators.query_config import (
+        QueryConfiguration,
+        QueryType,
+    )
+    from spatialflink_tpu.operators.range_query import PointPointRangeQuery
+    from spatialflink_tpu.parallel.mesh import data_mesh
+    from spatialflink_tpu.streams.sinks import TransactionalFileSink
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "chaos-sharded-child needs 8 devices — set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(and JAX_PLATFORMS=cpu) in the child env"
+        )
+    # Finer grid than _toy_pipeline's 8×8: every one of the 8 shards
+    # must span at least the halo width in flat cells
+    # (parallel/partition.py's single-hop contract), which the toy grid
+    # cannot give at any useful radius.
+    grid = UniformGrid(128, 0.0, 8.0, 0.0, 8.0)
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=2.0,
+                              slide_step=1.0)
+    n_events = 160
+    rng = np.random.default_rng(7)
+    xs = rng.uniform(0.0, 8.0, n_events)
+    ys = rng.uniform(0.0, 8.0, n_events)
+
+    def source():
+        for i in range(n_events):
+            yield Point(obj_id=f"o{i % 13}", timestamp=100 * i,
+                        x=float(xs[i]), y=float(ys[i]))
+
+    queries = [Point(obj_id="q0", x=4.0, y=4.0),
+               Point(obj_id="q1", x=1.0, y=6.5)]
+    sink = TransactionalFileSink(os.path.join(workdir, "egress.csv"))
+    driver = WindowedDataflowDriver(
+        checkpoint_path=os.path.join(workdir, "ckpt.bin"),
+        checkpoint_every=2, sink=sink,
+        retry=RetryPolicy(max_retries=1, backoff_s=0.0),
+        failover=False,  # chaos wants crash-and-resume, not degradation
+    )
+    op = PointPointRangeQuery(conf, grid)
+    mesh = data_mesh(8)
+    n = 0
+    for res in op.run_partitioned(source(), queries, 0.9, mesh,
+                                  driver=driver):
+        for line in render_range_result(res):
+            sink.stage(line)
+            n += 1
+    return n
+
+
 def chaos_smoke() -> int:
     """Clean run vs (killed-by-abort-fault → resumed) run: egress must be
     byte-identical. Exit 0 on equality. Each leg is a fresh subprocess —
@@ -921,10 +991,17 @@ def main(argv=None) -> int:
                     help="run the kill/resume egress-equality smoke")
     ap.add_argument("--chaos-child", metavar="DIR", default=None,
                     help="internal: one pipeline run rooted at DIR")
+    ap.add_argument("--chaos-sharded-child", metavar="DIR", default=None,
+                    help="internal: one grid-partitioned (8-shard halo) "
+                         "pipeline run rooted at DIR")
     args = ap.parse_args(argv)
     if args.chaos_child:
         n = run_chaos_child(args.chaos_child)
         print(f"chaos-child: {n} records staged")
+        return 0
+    if args.chaos_sharded_child:
+        n = run_chaos_sharded_child(args.chaos_sharded_child)
+        print(f"chaos-sharded-child: {n} records staged")
         return 0
     if args.chaos_smoke:
         return chaos_smoke()

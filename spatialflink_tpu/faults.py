@@ -96,6 +96,10 @@ INJECTION_POINTS: Dict[str, str] = {
                   "inside the atomic unit commit (`at: 2` lands BETWEEN "
                   "one sink's commit and the next — the cut the unit "
                   "checkpoint must survive)",
+    "shard.exchange": "parallel/halo.py — grid-partitioned halo "
+                      "exchange dispatch (boundary-cell pane ppermute; "
+                      "the kill-mid-exchange point the sharded "
+                      "kill/resume leg cuts at)",
 }
 
 #: Points whose callers implement the cooperative ``partial_write`` kind.

@@ -304,6 +304,74 @@ class _PointStreamRangeQuery(SpatialOperator):
 
         return process
 
+    def run_partitioned(
+        self,
+        stream: Iterable[Point],
+        query_set: Sequence[SpatialObject],
+        radius: float,
+        mesh,
+        dtype=np.float64,
+        driver=None,
+    ) -> Iterator[RangeResult]:
+        """Grid-partitioned scale-out route (parallel/halo.py): window
+        state lives sharded by contiguous flat-cell range and only
+        boundary-cell query panes halo-exchange — no per-window
+        broadcast of the query set. Point query sets only (the per-pair
+        layer math needs a cell per query lane).
+
+        The partition plan is placed on the operator BEFORE the driver
+        attaches, so a ``--checkpoint`` resume restores the CHECKPOINTED
+        plan (checkpoint.py validates the shard count) and re-dispatches
+        onto the same placement. Results are decoded exactly like
+        ``run()``'s; distances come from the per-pair kernel
+        (ops/halo.py — PARITY.md "Grid-partitioned placement" notes the
+        measure-zero radius-tie deviation from the flag-table path).
+        """
+        if self.query_kind != "point":
+            raise ValueError(
+                "run_partitioned supports point query sets only "
+                f"(operator query_kind is {self.query_kind!r})"
+            )
+        from spatialflink_tpu.driver import strict_driver
+        from spatialflink_tpu.parallel.halo import sharded_range_halo
+        from spatialflink_tpu.parallel.partition import plan_partition
+
+        if not isinstance(query_set, (list, tuple)):
+            query_set = [query_set]
+        n_shards = int(mesh.shape["data"])
+        self.partition_plan = plan_partition(self.grid, n_shards, radius)
+        drv = driver if driver is not None else strict_driver()
+        drv.attach(self)  # may adopt a checkpointed plan (same shards)
+        plan = self.partition_plan
+        q_xy = pack_query_points(query_set, np.float64)
+        q_cell = self.grid.assign_cells_np(q_xy)
+        q_valid = np.ones(len(query_set), bool)
+        approx = self.conf.approximate_query
+
+        def process(win) -> RangeResult:
+            with telemetry.span(
+                "window.range_halo", start=win.start,
+                events=len(win.events),
+            ):
+                batch = self.point_batch(win.events)
+                n = len(win.events)
+                ts = np.fromiter(
+                    (e.timestamp for e in win.events), np.int64, count=n,
+                )
+                keep, dist = sharded_range_halo(
+                    mesh, plan, batch.xy[:n].astype(dtype),
+                    batch.valid[:n], batch.cell[:n],
+                    q_xy.astype(dtype), q_cell, q_valid, radius,
+                    approximate=approx, ts=ts,
+                )
+                idx = np.nonzero(keep)[0]
+                return RangeResult(
+                    win.start, win.end, [win.events[i] for i in idx],
+                    dist[idx], n,
+                )
+
+        drv.bind(self, process)
+        yield from drv.run(stream)
 
     def run_soa(
         self,

@@ -1,0 +1,212 @@
+"""Grid-partitioned probe kernels — per-PAIR flag activity from flat cells.
+
+The flag-table kernels (ops/range.py, ops/query_registry.py) need a
+(num_cells + 1,) uint8 table per query, which is exactly the state the
+replicated mesh path must broadcast. The grid-partitioned path derives
+the SAME layer math per (point, query) pair from the two flat cell ids
+alone::
+
+    xi = cell // n,  yi = cell % n
+    cheb = max(|Δxi|, |Δyi|)
+    pair candidate  ⇔  cheb ≤ L_c        (grid.candidate_layers)
+    pair guaranteed ⇔  cheb ≤ L_g        (grid.guaranteed_layers; −1 → none)
+
+so a shard holding only its own rows plus its neighbors' boundary-cell
+pane lanes (parallel/partition.py halo math) evaluates every active pair
+with no table and no broadcast. Reductions mask inactive pairs to the
+dtype max, so the reduced values are independent of lane order/count —
+the mesh variants (parallel/halo.py) are bit-identical to these kernels.
+
+Deliberate deviation from the table kernels (PARITY.md
+"Grid-partitioned placement"): the table path's candidate check uses the
+min distance over ALL query lanes, the per-pair path over ACTIVE pairs
+only. An inactive pair sits ≥ L_c·cell ≥ radius away, so the two differ
+only when an inactive pair ties the radius *exactly* — a measure-zero
+boundary case.
+
+All kernels are pure, fixed-shape, mask-don't-compact, and safe under
+jit/vmap/shard_map (CLAUDE.md "Architecture invariants").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spatialflink_tpu.ops.distances import point_point_distance
+
+__all__ = [
+    "pair_layers",
+    "range_partitioned_kernel",
+    "join_partitioned_kernel",
+    "registry_bucket_partitioned_kernel",
+]
+
+
+def pair_layers(cell_a: jnp.ndarray, cell_b: jnp.ndarray, grid_n: int):
+    """Chebyshev ring number between two flat cell ids, broadcasting —
+    the vectorized HelperClass.getCellLayerWRTQueryCell
+    (grid.py:cell_layer). Out-of-grid sentinel cells (== n²) produce
+    garbage layers; callers mask them via the in-grid check."""
+    ax, ay = cell_a // grid_n, cell_a % grid_n
+    bx, by = cell_b // grid_n, cell_b % grid_n
+    return jnp.maximum(jnp.abs(ax - bx), jnp.abs(ay - by))
+
+
+def _pair_active(cell, valid, q_cell, q_valid, grid_n: int, layers: int):
+    """(N, Q) bool — pair within ``layers`` Chebyshev rings, both lanes
+    live and in-grid."""
+    num_cells = grid_n * grid_n
+    cheb = pair_layers(cell[:, None], q_cell[None, :], grid_n)
+    return (
+        valid[:, None] & q_valid[None, :]
+        & (cell[:, None] < num_cells) & (q_cell[None, :] < num_cells)
+        & (cheb <= layers)
+    )
+
+
+def range_partitioned_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    query_cell: jnp.ndarray,
+    query_valid: jnp.ndarray,
+    radius,
+    *,
+    grid_n: int,
+    layers: int,
+    guaranteed: int,
+    approximate: bool = False,
+):
+    """Point stream vs point query set, per-pair grid pruning.
+
+    ``xy``: (N, 2); ``cell``: (N,) flat ids; ``query_xy``: (Q, 2) with
+    per-lane cells/validity (padding lanes are simply inactive).
+    Returns (keep (N,) bool, dist (N,)) where ``dist`` is the min over
+    ACTIVE pairs (dtype max when none) — emission semantics match
+    ops/range.py:_emit_mask per-pair: guaranteed pairs emit with no
+    distance check, candidate pairs emit iff within radius
+    (``approximate`` drops the distance check, mirroring the reference's
+    approximateQuery flag).
+    """
+    d = point_point_distance(xy[:, None, :], query_xy[None, :, :])
+    cand = _pair_active(cell, valid, query_cell, query_valid, grid_n, layers)
+    big = jnp.asarray(jnp.finfo(d.dtype).max, d.dtype)
+    if approximate:
+        keep = valid & jnp.any(cand, axis=1)
+    else:
+        guar = (
+            _pair_active(cell, valid, query_cell, query_valid, grid_n,
+                         guaranteed)
+            if guaranteed >= 0 else jnp.zeros_like(cand)
+        )
+        keep = valid & (
+            jnp.any(guar, axis=1) | jnp.any(cand & (d <= radius), axis=1)
+        )
+    dist = jnp.min(jnp.where(cand, d, big), axis=1)
+    return keep, dist
+
+
+def join_partitioned_kernel(
+    left_xy: jnp.ndarray,
+    left_valid: jnp.ndarray,
+    left_cell: jnp.ndarray,
+    right_xy: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    right_cell: jnp.ndarray,
+    radius,
+    *,
+    grid_n: int,
+    layers: int,
+    budget: int,
+):
+    """Grid-pruned point ⋈ point join over flat cells.
+
+    Emits every (left, right) pair within ``layers`` Chebyshev rings AND
+    within ``radius``, compacted to ``budget`` lanes (−1 padding).
+    Returns (left_idx, right_idx, dist, count, overflow) with LOCAL lane
+    indices — the mesh wrapper maps them through its global-id panes.
+    ``count`` is the true hit count; ``overflow = max(count − budget,
+    0)`` drives the caller's retry-with-doubled-budget contract (same as
+    ops/join.py's compact path).
+    """
+    d = point_point_distance(left_xy[:, None, :], right_xy[None, :, :])
+    act = _pair_active(left_cell, left_valid, right_cell, right_valid,
+                       grid_n, layers)
+    hitm = act & (d <= radius)
+    flat = hitm.reshape(-1)
+    (hit,) = jnp.nonzero(flat, size=budget, fill_value=-1)
+    found = hit >= 0
+    hc = jnp.maximum(hit, 0)
+    m = right_xy.shape[0]
+    left_idx = jnp.where(found, (hc // m).astype(jnp.int32), -1)
+    right_idx = jnp.where(found, (hc % m).astype(jnp.int32), -1)
+    dist = jnp.where(found, d.reshape(-1)[hc], jnp.inf)
+    count = jnp.sum(flat.astype(jnp.int32))
+    overflow = jnp.maximum(count - budget, 0)
+    return left_idx, right_idx, dist, count, overflow
+
+
+def registry_bucket_partitioned_kernel(
+    xy: jnp.ndarray,
+    valid: jnp.ndarray,
+    cell: jnp.ndarray,
+    oid: jnp.ndarray,
+    query_xy: jnp.ndarray,
+    query_cell: jnp.ndarray,
+    radius: jnp.ndarray,
+    query_valid: jnp.ndarray,
+    *,
+    grid_n: int,
+    layers: int,
+    k: int,
+    num_segments: int,
+    query_block: int = 32,
+):
+    """Standing-query bucket (qserve) with per-pair grid pruning.
+
+    Per query lane: per-object min distance over active pairs within the
+    query's radius (``.at[].min`` into a (num_segments,) table — the
+    canonical segment indexing makes lane order irrelevant, so the mesh
+    variant's local+halo lane set reduces to the SAME table bitwise),
+    then top-k over the table. ``layers`` is the bucket's radius-class
+    ceiling (qserve buckets by radius class, so one static halo width
+    covers every query in the bucket). Returns (dist (Q, k),
+    segment (Q, k) int32 — −1 beyond ``within`` — num_valid (Q,),
+    within (Q,)).
+    """
+    big = jnp.asarray(jnp.finfo(xy.dtype).max, xy.dtype)
+    seg = jnp.clip(oid.astype(jnp.int32), 0, num_segments - 1)
+
+    def one(q_xy, q_cell, rad, q_ok):
+        d = point_point_distance(xy, q_xy[None, :])
+        act = _pair_active(cell, valid, q_cell[None], q_ok[None], grid_n,
+                           layers)[:, 0]
+        dm = jnp.where(act & (d <= rad), d, big)
+        table = jnp.full((num_segments,), big, dm.dtype).at[seg].min(dm)
+        neg_top, seg_idx = jax.lax.top_k(-table, k)
+        top_d = -neg_top
+        top_seg = jnp.where(top_d < big, seg_idx.astype(jnp.int32), -1)
+        within = jnp.sum((table < big).astype(jnp.int32))
+        return top_d, top_seg, jnp.minimum(within, k), within
+
+    # Same query blocking as registry_bucket_kernel: vmap only ``block``
+    # lanes at a time under lax.map so peak memory stays O(block × N).
+    q_total = query_xy.shape[0]
+    block = next(b for b in (query_block, 16, 8, 4, 2, 1)
+                 if q_total % b == 0)
+
+    def blk(args):
+        return jax.vmap(one)(*args)
+
+    res = jax.lax.map(
+        blk,
+        (
+            query_xy.reshape(-1, block, 2),
+            query_cell.reshape(-1, block),
+            radius.reshape(-1, block),
+            query_valid.reshape(-1, block),
+        ),
+    )
+    return tuple(x.reshape((q_total,) + x.shape[2:]) for x in res)

@@ -325,6 +325,16 @@ class Telemetry:
         # round trip.
         self._collectives: Dict[str, Dict[str, int]] = {}
         self._collective_axes: Dict[str, int] = {}
+        # Grid-partitioned halo accounting (parallel/halo.py): unpadded
+        # boundary-state bytes the halo exchanges existed to move — the
+        # denominator of sfprof's replication-ratio line (accounted
+        # collective bytes ÷ boundary-state bytes).
+        self._halo_state_bytes = 0
+        # Cross-shard watermark coordination (parallel/halo.py /
+        # operators' partitioned paths): shard id → max event-time seen.
+        # The merged min over shards is the source-clock watermark the
+        # composed DAG may safely advance to.
+        self._shard_watermarks: Dict[int, int] = {}
         # Overload shed accounting (record_shed): global twin of the
         # per-node "shed_events"/"shed_bytes" bucket columns.
         self.shed_events = 0
@@ -1076,13 +1086,26 @@ class Telemetry:
             b["collective_calls"] += int(calls)
             b["collective_bytes"] += int(nbytes)
 
+    def account_halo_state(self, nbytes: int):
+        """Unpadded boundary-state bytes one halo exchange shipped
+        (parallel/halo.py) — the true lanes behind the padded ppermute
+        payload, and the denominator of sfprof's replication-ratio line.
+        Host-side static metadata, same contract as
+        :meth:`account_collective`."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._halo_state_bytes += int(nbytes)
+
     def collective_gauges(self) -> Optional[Dict[str, Any]]:
         """Collective summary (None before the first accounted
-        collective): total calls/bytes, per-kind and per-axis splits."""
+        collective): total calls/bytes, per-kind and per-axis splits,
+        plus the halo boundary-state bytes once a halo kernel has run
+        (absent otherwise — the additive-keys compat contract)."""
         with self._lock:
             if not self._collectives:
                 return None
-            return json_safe({
+            out = {
                 "calls": sum(s["calls"]
                              for s in self._collectives.values()),
                 "bytes": sum(s["bytes"]
@@ -1090,7 +1113,10 @@ class Telemetry:
                 "by_kind": {k: dict(s)
                             for k, s in self._collectives.items()},
                 "by_axis": dict(self._collective_axes),
-            })
+            }
+            if self._halo_state_bytes:
+                out["halo_state_bytes"] = self._halo_state_bytes
+            return json_safe(out)
 
     # -- overload shed accounting (overload.py) --------------------------------
 
@@ -1123,6 +1149,37 @@ class Telemetry:
             self.watermark_lag.observe(float(lag_ms))
             if lag_ms > self.max_watermark_lag_ms:
                 self.max_watermark_lag_ms = int(lag_ms)
+
+    def record_shard_watermark(self, shard: int, watermark_ms: int):
+        """Per-shard event-time high-water mark on the grid-partitioned
+        path (parallel/halo.py feeds it from each window's owned rows).
+        The MERGED watermark — min over shards — is what the source
+        clock may advance to: one straggling shard holds the whole
+        partitioned pipeline's event time, which is exactly what these
+        gauges make visible."""
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self._shard_watermarks.get(int(shard))
+            if prev is None or int(watermark_ms) > prev:
+                self._shard_watermarks[int(shard)] = int(watermark_ms)
+
+    def shard_watermark_gauges(self) -> Optional[Dict[str, Any]]:
+        """Cross-shard watermark summary (None before the first
+        partitioned window): per-shard high-water marks (sorted string
+        keys — the JSON-stable shape), the merged min-watermark, and the
+        shard count."""
+        with self._lock:
+            if not self._shard_watermarks:
+                return None
+            return json_safe({
+                "per_shard": {
+                    str(s): self._shard_watermarks[s]
+                    for s in sorted(self._shard_watermarks)
+                },
+                "merged_min": min(self._shard_watermarks.values()),
+                "shards": len(self._shard_watermarks),
+            })
 
     # -- link-health probe gauges ----------------------------------------------
 
@@ -1319,6 +1376,9 @@ class Telemetry:
         coll = self.collective_gauges()
         if coll is not None:
             out["collectives"] = coll
+        shard_wm = self.shard_watermark_gauges()
+        if shard_wm is not None:
+            out["shard_watermarks"] = shard_wm
         # Ablation taint rides EVERY snapshot — including the ledger-
         # stream checkpoints, so a recovered stream stays tainted and
         # sfprof's gates keep rejecting it after a crash.

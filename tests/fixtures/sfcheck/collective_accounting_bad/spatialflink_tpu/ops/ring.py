@@ -1,0 +1,8 @@
+"""Fixture: a ppermute halo kernel no accounted parallel/ wrapper
+reaches — boundary-pane exchange traffic invisible to the ledger."""
+
+from jax import lax
+
+
+def ring_shift_kernel(x, axis_name):
+    return lax.ppermute(x, axis_name, [(0, 1)])  # finding: unaccounted

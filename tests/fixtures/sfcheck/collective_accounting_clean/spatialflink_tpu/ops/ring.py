@@ -1,0 +1,8 @@
+"""Fixture (clean twin): the same ppermute halo kernel, reachable from
+an accounted parallel/ wrapper."""
+
+from jax import lax
+
+
+def ring_shift_kernel(x, axis_name):
+    return lax.ppermute(x, axis_name, [(0, 1)])

@@ -9,3 +9,10 @@ def sharded_halo_exchange(mesh, x):
     telemetry.account_collective("all_gather", 8, axis="data")
     telemetry.account_collective("psum", 8, axis="data")
     return halo_exchange_kernel(x, axis_name="data")
+
+
+def sharded_ring_shift(mesh, x):
+    from spatialflink_tpu.ops.ring import ring_shift_kernel
+
+    telemetry.account_collective("ppermute", 8, axis="data")
+    return ring_shift_kernel(x, axis_name="data")

@@ -656,6 +656,50 @@ def test_pipeline_hang_wedge_is_bounded_by_dial_deadline(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Harness 7: the grid-partitioned pipeline (subprocess, 8-device CPU
+# mesh). run_partitioned dispatches through parallel/halo.py, whose
+# shard.exchange point fires once per window right before the boundary-
+# pane ppermute — the abort kind is kill -9 mid-exchange. The resumed
+# child restores the CHECKPOINTED partition plan (checkpoint.py
+# validates the shard count) and must converge byte-identically. The
+# virtual-device count must be in the env BEFORE jax initializes, hence
+# the subprocess harness.
+
+
+def chaos_sharded(tmp_path, point):
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    env_base.pop("SFT_FAULT_PLAN", None)
+
+    def child(workdir, plan=None):
+        env = dict(env_base)
+        if plan:
+            env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        return subprocess.run(
+            [sys.executable, "-m", "spatialflink_tpu.driver",
+             "--chaos-sharded-child", str(workdir)],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=REPO,
+        )
+
+    clean = tmp_path / "clean"
+    chaos = tmp_path / "chaos"
+    clean.mkdir()
+    chaos.mkdir()
+    p = child(clean)
+    assert p.returncode == 0, p.stderr[-2000:]
+    want = (clean / "egress.csv").read_bytes()
+    assert want, "vacuous matrix entry: clean egress is empty"
+    p = child(chaos, plan=[{"point": point, "kind": "abort", "at": 5}])
+    assert p.returncode == ABORT_EXIT_CODE, (p.returncode,
+                                             p.stderr[-2000:])
+    p = child(chaos)  # resume onto the checkpointed placement
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert (chaos / "egress.csv").read_bytes() == want
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 
 
@@ -678,6 +722,9 @@ MATRIX = {
     "pipeline.ship": lambda tp: chaos_pipeline(tp, "pipeline.ship"),
     "pipeline.fetch": lambda tp: chaos_pipeline(tp, "pipeline.fetch"),
     "qserve.register": lambda tp: chaos_qserve(tp, "qserve.register"),
+    # kill -9 mid-halo-exchange on the grid-partitioned path; resume
+    # restores the checkpointed partition plan (8-device subprocess).
+    "shard.exchange": lambda tp: chaos_sharded(tp, "shard.exchange"),
     # The 7-node SNCB DAG under armed overload + pipeline policies:
     # at=9 is the SECOND unit commit's 2nd sub-append — the between-
     # sink-commits cut the atomic unit checkpoint exists to close.

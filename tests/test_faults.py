@@ -73,7 +73,7 @@ class TestPlanParsing:
             "sink.write", "driver.window",
             "overload.admit", "source.stall",
             "pipeline.ship", "pipeline.fetch", "qserve.register",
-            "dag.node", "dag.commit",
+            "dag.node", "dag.commit", "shard.exchange",
         }
 
 

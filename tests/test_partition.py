@@ -1,0 +1,457 @@
+"""Grid-partitioned placement: planner units, halo-kernel mesh parity,
+and the collective-traffic contract.
+
+Parity pins the tentpole invariant (CLAUDE.md "Architecture
+invariants"): every ``parallel/halo.py`` wrapper — sharded_range_halo,
+sharded_join_halo, sharded_tjoin_panes_halo,
+sharded_registry_bucket_halo — is BIT-identical to its single-device
+``ops/halo.py`` counterpart on the 8-device CPU mesh (the single-device
+side runs jitted too: eager-vs-jitted may differ in the last ulp, which
+is compiler slack, not semantics). The traffic tests assert the point
+of the rebuild: accounted halo bytes < 25% of the replicated kernels'
+broadcast/all-gather bytes on the SAME workload, via
+``snapshot()["collectives"]``.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from spatialflink_tpu.grid import UniformGrid
+from spatialflink_tpu.ops.halo import (
+    join_partitioned_kernel,
+    range_partitioned_kernel,
+    registry_bucket_partitioned_kernel,
+)
+from spatialflink_tpu.parallel.halo import (
+    sharded_join_halo,
+    sharded_range_halo,
+    sharded_registry_bucket_halo,
+    sharded_tjoin_panes_halo,
+)
+from spatialflink_tpu.parallel.partition import (
+    PLAN_VERSION,
+    PartitionPlan,
+    gather_rows,
+    halo_width,
+    plan_partition,
+    scatter_rows,
+    shard_layout,
+)
+from spatialflink_tpu.telemetry import telemetry
+
+GRID = UniformGrid(64, 0.0, 1.0, 0.0, 1.0)
+RADIUS = 0.012  # one candidate layer on GRID: halo width 65
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices())
+    assert devs.size == 8, "conftest must provide 8 virtual CPU devices"
+    return Mesh(devs.reshape(8), ("data",))
+
+
+def _cloud(rng, n):
+    xy = rng.uniform(0.0, 1.0, (n, 2))
+    return xy, GRID.assign_cells_np(xy), np.ones(n, bool)
+
+
+# -- planner -----------------------------------------------------------------
+
+
+def test_plan_contiguous_cover_and_balance():
+    plan = plan_partition(GRID, 8, RADIUS)
+    assert plan.bounds[0] == 0 and plan.bounds[-1] == GRID.num_cells
+    widths = plan.shard_widths()
+    assert (widths > 0).all()
+    assert (widths == GRID.num_cells // 8).all()  # uniform occupancy
+    assert plan.halo == halo_width(GRID.n, plan.layers) == 65
+
+
+def test_plan_occupancy_balancing_with_min_width_clamp():
+    occ = np.zeros(GRID.num_cells)
+    occ[:100] = 1.0  # all live mass in the first 100 cells
+    plan = plan_partition(GRID, 8, RADIUS, occupancy=occ)
+    # Cuts chase the mass but every shard keeps >= the halo width — the
+    # single-hop exchange contract survives arbitrary skew.
+    assert (plan.shard_widths() >= plan.halo).all()
+    assert plan.bounds[1] <= 100 + plan.halo
+
+
+def test_plan_infeasible_raises():
+    tiny = UniformGrid(8, 0.0, 1.0, 0.0, 1.0)
+    with pytest.raises(ValueError, match="finer grid or fewer shards"):
+        plan_partition(tiny, 8, 0.5)
+
+
+def test_plan_shard_of_sentinel_goes_last():
+    plan = plan_partition(GRID, 8, RADIUS)
+    cells = np.array([0, plan.bounds[1] - 1, plan.bounds[1],
+                      GRID.num_cells - 1, GRID.num_cells])
+    np.testing.assert_array_equal(
+        plan.shard_of(cells), [0, 0, 1, 7, 7]
+    )
+
+
+def test_plan_dict_roundtrip_and_validation():
+    plan = plan_partition(GRID, 8, RADIUS)
+    d = plan.to_dict()
+    back = PartitionPlan.from_dict(d)
+    assert back.n_shards == plan.n_shards
+    assert back.halo == plan.halo
+    np.testing.assert_array_equal(back.bounds, plan.bounds)
+
+    with pytest.raises(ValueError, match="unknown keys"):
+        PartitionPlan.from_dict({**d, "surprise": 1})
+    with pytest.raises(ValueError, match="missing keys"):
+        PartitionPlan.from_dict({k: v for k, v in d.items()
+                                 if k != "bounds"})
+    with pytest.raises(ValueError, match="version"):
+        PartitionPlan.from_dict({**d, "version": PLAN_VERSION + 1})
+    with pytest.raises(ValueError, match="does not match"):
+        PartitionPlan.from_dict({**d, "n_shards": 4})
+    bad = list(d["bounds"])
+    bad[1], bad[2] = bad[2], bad[1]
+    with pytest.raises(ValueError, match="monotone"):
+        PartitionPlan.from_dict({**d, "bounds": bad})
+
+
+def test_shard_layout_rows_and_scatter_roundtrip(rng):
+    plan = plan_partition(GRID, 8, RADIUS)
+    xy, cell, valid = _cloud(rng, 1024)
+    valid[::5] = False
+    lay = shard_layout(plan, cell, valid)
+    shard = plan.shard_of(cell)
+    for s in range(8):
+        rows = lay.own[s][lay.own[s] >= 0]
+        expect = np.nonzero(valid & (shard == s))[0]
+        np.testing.assert_array_equal(rows, expect)  # stable order
+        lo, hi = plan.bounds[s], plan.bounds[s + 1]
+        lrows = lay.left[s][lay.left[s] >= 0]
+        assert (cell[lrows] < lo + plan.halo).all()
+        rrows = lay.right[s][lay.right[s] >= 0]
+        assert (cell[rrows] >= hi - plan.halo).all()
+    assert lay.live_boundary_rows == int(
+        (lay.left >= 0).sum() + (lay.right >= 0).sum()
+    )
+    vals = gather_rows(lay.own, xy[:, 0], np.nan)
+    back = scatter_rows(lay.own, vals, 1024, np.nan)
+    np.testing.assert_array_equal(back[valid], xy[valid, 0])
+    assert np.isnan(back[~valid]).all()
+
+
+# -- mesh parity (bit-identical single-device counterparts) ------------------
+
+
+def test_sharded_range_halo_bit_parity(mesh):
+    rng = np.random.default_rng(7)
+    xy, cell, valid = _cloud(rng, 4096)
+    valid[::7] = False
+    qxy, qcell, qok = _cloud(rng, 512)
+    plan = plan_partition(GRID, 8, RADIUS)
+    keep, dist = sharded_range_halo(
+        mesh, plan, xy, valid, cell, qxy, qcell, qok, RADIUS,
+    )
+    single = jax.jit(functools.partial(
+        range_partitioned_kernel, grid_n=GRID.n, layers=plan.layers,
+        guaranteed=plan.guaranteed, approximate=False,
+    ))
+    keep1, dist1 = single(xy, valid, cell, qxy, qcell, qok, RADIUS)
+    np.testing.assert_array_equal(keep, np.asarray(keep1))
+    np.testing.assert_array_equal(dist, np.asarray(dist1))  # bitwise
+
+
+def test_sharded_range_halo_approximate_parity(mesh):
+    rng = np.random.default_rng(17)
+    xy, cell, valid = _cloud(rng, 2048)
+    qxy, qcell, qok = _cloud(rng, 256)
+    plan = plan_partition(GRID, 8, RADIUS)
+    keep, _ = sharded_range_halo(
+        mesh, plan, xy, valid, cell, qxy, qcell, qok, RADIUS,
+        approximate=True,
+    )
+    single = jax.jit(functools.partial(
+        range_partitioned_kernel, grid_n=GRID.n, layers=plan.layers,
+        guaranteed=plan.guaranteed, approximate=True,
+    ))
+    keep1, _ = single(xy, valid, cell, qxy, qcell, qok, RADIUS)
+    np.testing.assert_array_equal(keep, np.asarray(keep1))
+
+
+def _expected_pairs(lxy, lok, lcell, rxy, rok, rcell, radius, budget,
+                    plan):
+    single = jax.jit(functools.partial(
+        join_partitioned_kernel, grid_n=GRID.n, layers=plan.layers,
+        budget=budget,
+    ))
+    li, ri, dv, count, over = single(
+        lxy, lok, lcell, rxy, rok, rcell, radius,
+    )
+    li, ri, dv = (np.asarray(a) for a in (li, ri, dv))
+    found = li >= 0
+    li, ri, dv = li[found], ri[found], dv[found]
+    order = np.lexsort((ri, li))
+    return li[order], ri[order], dv[order], int(count), int(over)
+
+
+def test_sharded_join_halo_bit_parity(mesh):
+    rng = np.random.default_rng(11)
+    lxy, lcell, lok = _cloud(rng, 2048)
+    rxy, rcell, rok = _cloud(rng, 2048)
+    lok[::9] = False
+    plan = plan_partition(GRID, 8, RADIUS)
+    li, ri, dv, count, over = sharded_join_halo(
+        mesh, plan, lxy, lok, lcell, rxy, rok, rcell, RADIUS, 4096,
+    )
+    eli, eri, edv, ecount, eover = _expected_pairs(
+        lxy, lok, lcell, rxy, rok, rcell, RADIUS, 4096, plan,
+    )
+    assert count == ecount and over == eover == 0
+    np.testing.assert_array_equal(li, eli)
+    np.testing.assert_array_equal(ri, eri)
+    np.testing.assert_array_equal(dv, edv)  # bitwise
+
+
+def test_sharded_tjoin_panes_halo_bit_parity(mesh):
+    rng = np.random.default_rng(13)
+    n_slides, slide_pts, ppw = 4, 512, 2
+    plan = plan_partition(GRID, 8, RADIUS)
+
+    def panes():
+        out = []
+        for _ in range(n_slides):
+            xy, cell, ok = _cloud(rng, slide_pts)
+            out.append((xy, ok, cell))
+        return out
+
+    lp, rp = panes(), panes()
+    ts = np.arange(n_slides, dtype=np.int64) * 100
+    results = sharded_tjoin_panes_halo(
+        mesh, plan, ts, lp, rp, RADIUS, ppw, 8192,
+    )
+    assert len(results) == n_slides
+    for i, (li, ri, dv, count, over) in enumerate(results):
+        lo = max(0, i - ppw + 1)
+        lxy, lok, lcell = (
+            np.concatenate([p[j] for p in lp[lo: i + 1]])
+            for j in range(3)
+        )
+        rxy, rok, rcell = (
+            np.concatenate([p[j] for p in rp[lo: i + 1]])
+            for j in range(3)
+        )
+        eli, eri, edv, ecount, eover = _expected_pairs(
+            lxy, lok, lcell, rxy, rok, rcell, RADIUS, 8192, plan,
+        )
+        assert count == ecount and over == eover == 0
+        np.testing.assert_array_equal(li, eli)
+        np.testing.assert_array_equal(ri, eri)
+        np.testing.assert_array_equal(dv, edv)
+
+
+def test_sharded_registry_bucket_halo_bit_parity(mesh):
+    rng = np.random.default_rng(11)
+    xy, cell, valid = _cloud(rng, 2048)
+    valid[::11] = False
+    oid = rng.integers(0, 300, 2048).astype(np.int32)
+    qxy, qcell, qok = _cloud(rng, 128)
+    rad = np.full(128, RADIUS)
+    plan = plan_partition(GRID, 8, RADIUS)
+    dist, seg, nv, win = sharded_registry_bucket_halo(
+        mesh, plan, xy, valid, cell, oid, qxy, qcell, rad, qok,
+        k=8, num_segments=300,
+    )
+    single = jax.jit(functools.partial(
+        registry_bucket_partitioned_kernel, grid_n=GRID.n,
+        layers=plan.layers, k=8, num_segments=300,
+    ))
+    d1, s1, n1, w1 = single(xy, valid, cell, oid, qxy, qcell, rad, qok)
+    np.testing.assert_array_equal(dist, np.asarray(d1))  # bitwise
+    np.testing.assert_array_equal(seg, np.asarray(s1))
+    np.testing.assert_array_equal(nv, np.asarray(n1))
+    np.testing.assert_array_equal(win, np.asarray(w1))
+
+
+# -- collective traffic: halo must beat replication >= 4x --------------------
+
+
+def test_range_halo_bytes_beat_broadcast_4x(mesh):
+    from spatialflink_tpu.parallel.sharded import sharded_range_query
+
+    grid = UniformGrid(1024, 115.5, 117.6, 39.6, 41.1)
+    radius = 0.002  # one layer: boundary region ~1.6% of the grid
+    rng = np.random.default_rng(47)
+    n, nq = 8192, 4096
+    xy = np.stack([rng.uniform(115.5, 117.6, n),
+                   rng.uniform(39.6, 41.1, n)], axis=1)
+    qxy = np.stack([rng.uniform(115.6, 117.5, nq),
+                    rng.uniform(39.7, 41.0, nq)], axis=1)
+    cell = grid.assign_cells_np(xy)
+    qcell = grid.assign_cells_np(qxy)
+    ok, qok = np.ones(n, bool), np.ones(nq, bool)
+    plan = plan_partition(grid, 8, radius)
+
+    telemetry.enable()
+    keep_h, _ = sharded_range_halo(
+        mesh, plan, xy, ok, cell, qxy, qcell, qok, radius,
+    )
+    coll = telemetry.snapshot()["collectives"]
+    telemetry.disable()
+    halo_bytes = coll["by_kind"]["ppermute"]["bytes"]
+    assert coll["halo_state_bytes"] > 0
+
+    # The replicated kernel on the SAME window: every shard receives the
+    # whole query set.
+    table = grid.neighbor_flags(radius, [int(c) for c in qcell])
+    telemetry.enable()
+    keep_l, _ = sharded_range_query(mesh, xy, ok, table[cell], qxy,
+                                    radius)
+    legacy = telemetry.snapshot()["collectives"]
+    telemetry.disable()
+    legacy_bytes = legacy["bytes"]
+    assert legacy_bytes == nq * 2 * xy.dtype.itemsize  # query broadcast
+    assert halo_bytes * 4 <= legacy_bytes, (
+        f"halo moved {halo_bytes} B vs replicated {legacy_bytes} B"
+    )
+    # Same answer set on this geometry's common lanes: a traffic win
+    # that changed results would be a miscount, not an optimization.
+    assert int(np.asarray(keep_h).sum()) == int(np.asarray(keep_l).sum())
+
+
+def test_tjoin_halo_bytes_beat_all_gather_4x(mesh):
+    from spatialflink_tpu.ops.tjoin_panes import (
+        pane_cell_ranks,
+        tjoin_pane_init,
+    )
+    from spatialflink_tpu.operators.base import center_coords
+    from spatialflink_tpu.parallel.sharded import sharded_tjoin_pane_scan
+
+    import jax.numpy as jnp
+
+    grid = UniformGrid(256, 115.5, 117.6, 39.6, 41.1)
+    radius = 0.005
+    n_slides, slide_pts, ppw = 3, 512, 2
+    n_obj = 64
+    total = n_slides * slide_pts
+    rng = np.random.default_rng(53)
+
+    def mk_side():
+        sxy = np.stack([rng.uniform(115.5, 117.6, total),
+                        rng.uniform(39.6, 41.1, total)], axis=1)
+        return sxy, grid.assign_cells_np(sxy), \
+            rng.integers(0, n_obj, total).astype(np.int32)
+
+    lxy, lcell, loid = mk_side()
+    rxy, rcell, roid = mk_side()
+    ok = np.ones(slide_pts, bool)
+    plan = plan_partition(grid, 8, radius)
+
+    def panes_of(sxy, scell):
+        return [
+            (sxy[i * slide_pts:(i + 1) * slide_pts], ok,
+             scell[i * slide_pts:(i + 1) * slide_pts])
+            for i in range(n_slides)
+        ]
+
+    ts = np.arange(n_slides, dtype=np.int64) * 1000
+    telemetry.enable()
+    sharded_tjoin_panes_halo(
+        mesh, plan, ts, panes_of(lxy, lcell), panes_of(rxy, rcell),
+        radius, ppw, 16384,
+    )
+    coll = telemetry.snapshot()["collectives"]
+    telemetry.disable()
+    halo_bytes = coll["by_kind"]["ppermute"]["bytes"]
+
+    # The replicated pane scan on the same panes: per slide it
+    # all-gathers both sides' 8 pane field arrays + contribution lanes.
+    def side_fields(sxy, scell, soid):
+        cxy = center_coords(grid, sxy, np.float32)
+        ci = grid.cell_xy_indices_np(sxy)
+        ing = scell < grid.num_cells
+        pane_of = np.repeat(np.arange(n_slides), slide_pts)
+        rank = pane_cell_ranks(pane_of, scell, valid=ing)
+        sh = (n_slides, slide_pts)
+        host = (
+            cxy[:, 0].astype(np.float32), cxy[:, 1].astype(np.float32),
+            ci[:, 0], ci[:, 1],
+            np.where(ing, scell, 0).astype(np.int32),
+            rank.astype(np.int32), soid, ing,
+        )
+        return tuple(jnp.asarray(a.reshape(sh)) for a in host)
+
+    telemetry.enable()
+    carry0 = tjoin_pane_init(grid.num_cells, 8, ppw, n_obj, jnp.float32)
+    _, wmins = sharded_tjoin_pane_scan(
+        mesh, carry0, jnp.arange(n_slides, dtype=jnp.int32),
+        side_fields(lxy, lcell, loid), side_fields(rxy, rcell, roid),
+        np.float32(radius), grid_n=grid.n, cap_w=8,
+        layers=grid.candidate_layers(radius), ppw=ppw, num_ids=n_obj,
+        pair_sel=16,
+    )
+    jax.device_get(wmins)
+    legacy = telemetry.snapshot()["collectives"]
+    telemetry.disable()
+    legacy_bytes = legacy["by_kind"]["all_gather"]["bytes"]
+    assert halo_bytes * 4 <= legacy_bytes, (
+        f"halo moved {halo_bytes} B vs all-gather {legacy_bytes} B"
+    )
+
+
+# -- cross-shard watermarks --------------------------------------------------
+
+
+def test_shard_watermark_gauges(mesh):
+    rng = np.random.default_rng(7)
+    xy, cell, valid = _cloud(rng, 4096)
+    qxy, qcell, qok = _cloud(rng, 512)
+    ts = rng.integers(0, 10_000, 4096).astype(np.int64)
+    plan = plan_partition(GRID, 8, RADIUS)
+    telemetry.enable()
+    sharded_range_halo(
+        mesh, plan, xy, valid, cell, qxy, qcell, qok, RADIUS, ts=ts,
+    )
+    wm = telemetry.snapshot()["shard_watermarks"]
+    telemetry.disable()
+    assert wm["shards"] == 8
+    shard = plan.shard_of(cell)
+    for s in range(8):
+        assert wm["per_shard"][str(s)] == int(ts[shard == s].max())
+    assert wm["merged_min"] == min(wm["per_shard"].values())
+
+
+# -- checkpoint contract -----------------------------------------------------
+
+
+def test_partition_plan_checkpoint_roundtrip():
+    from spatialflink_tpu.checkpoint import (
+        operator_state,
+        restore_operator,
+    )
+    from spatialflink_tpu.operators import (
+        PointPointRangeQuery,
+        QueryConfiguration,
+        QueryType,
+    )
+
+    conf = QueryConfiguration(QueryType.WindowBased, window_size=10,
+                              slide_step=10)
+    op = PointPointRangeQuery(conf, GRID)
+    op.partition_plan = plan_partition(GRID, 8, RADIUS)
+    state = operator_state(op)
+    assert state["partition"]["n_shards"] == 8
+
+    op2 = PointPointRangeQuery(conf, GRID)
+    restore_operator(op2, state)
+    np.testing.assert_array_equal(
+        op2.partition_plan.bounds, op.partition_plan.bounds
+    )
+
+    # Resuming onto a different shard count is a re-plan, not a restore.
+    op3 = PointPointRangeQuery(conf, GRID)
+    op3.partition_plan = plan_partition(GRID, 4, RADIUS)
+    with pytest.raises(ValueError, match="shard-count"):
+        restore_operator(op3, state)

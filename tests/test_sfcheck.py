@@ -859,11 +859,13 @@ def test_replay_determinism_fixture_repo():
 
 def test_collective_accounting_fixture_repo():
     bad = _mini_repo("collective_accounting_bad", "collective-accounting")
-    assert len(bad) == 2, "\n".join(f.format() for f in bad)
+    assert len(bad) == 3, "\n".join(f.format() for f in bad)
     msgs = "\n".join(f.message for f in bad)
     assert "lax.all_gather" in msgs and "lax.psum" in msgs
-    # the wrapper-covered stats_kernel stays clean; only halo.py flags
-    assert all(f.path.endswith("halo.py") for f in bad)
+    assert "lax.ppermute" in msgs  # the unaccounted halo-exchange kind
+    # the wrapper-covered stats_kernel stays clean; only the uncovered
+    # kernels (halo.py's gather/psum pair, ring.py's ppermute) flag
+    assert all(f.path.endswith(("halo.py", "ring.py")) for f in bad)
     ev = "\n".join(e for f in bad for e in f.evidence)
     assert "unreachable from all 1 accounting wrapper(s)" in ev
     assert all(f.evidence for f in bad)
@@ -874,7 +876,7 @@ def test_collective_accounting_fixture_repo():
 @pytest.mark.parametrize("fixture,pass_name,expect", [
     ("checkpoint_schema_bad", "checkpoint-schema", 3),
     ("replay_determinism_bad", "replay-determinism", 3),
-    ("collective_accounting_bad", "collective-accounting", 2),
+    ("collective_accounting_bad", "collective-accounting", 3),
 ])
 def test_v4_cli_json_project_root_evidence(fixture, pass_name, expect):
     """The --project-root CLI leg per new pass: exit 1, per-pass count,

@@ -557,3 +557,45 @@ def test_kernel_table_thread_safe_updates():
         t.join()
     (row,) = telemetry.kernel_table()
     assert row["calls"] == 1 + 4 * 50
+
+
+def test_report_and_health_collective_split(tmp_path, capsys):
+    """The per-kind collective classes (halo vs gather vs reduce) and
+    the replication-ratio line (collective bytes / boundary-state
+    bytes the halo wrappers declared) — text, --json, and health
+    notes all carry the same split."""
+    telemetry.enable()
+    telemetry.account_collective("ppermute", 6_000, axis="data", calls=6)
+    telemetry.account_collective("all_gather", 80_000, axis="data",
+                                 calls=4)
+    telemetry.account_collective("psum", 64, axis="data", calls=2)
+    telemetry.account_halo_state(3_000)
+    path = str(tmp_path / "halo_ledger.json")
+    telemetry.write_ledger(path, bench={
+        "config": "range_8shard_halo", "points_per_sec": 50_000.0,
+        "value": 50_000.0,
+    })
+    telemetry.disable()
+
+    assert sfprof_main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "by class" in out
+    assert "halo=" in out and "gather=" in out and "reduce=" in out
+    assert "replication ratio" in out
+    assert "boundary-pane state" in out  # the ↳ evidence line
+
+    assert sfprof_main(["report", path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    split = rep["collective_split"]
+    assert split["by_class"]["halo"]["bytes"] == 6_000
+    assert split["by_class"]["halo"]["kinds"] == ["ppermute"]
+    assert split["by_class"]["gather"]["bytes"] == 80_000
+    assert split["by_class"]["reduce"]["bytes"] == 64
+    assert split["halo_state_bytes"] == 3_000
+    assert split["replication_ratio"] == pytest.approx(
+        (6_000 + 80_000 + 64) / 3_000)
+
+    assert sfprof_main(["health", path, "--json"]) == 0
+    hea = json.loads(capsys.readouterr().out)
+    assert hea["notes"]["collective_split"]["by_class"]["halo"][
+        "bytes"] == 6_000

@@ -83,6 +83,67 @@ def _ms(us) -> float:
     return float(us) / 1000.0
 
 
+#: Collective kind → transfer class. ``halo`` kinds move only
+#: boundary-cell panes (the grid-partitioned ppermute exchange);
+#: ``gather`` kinds replicate whole operands across the mesh;
+#: ``reduce`` kinds move reduction trees.
+_COLLECTIVE_CLASSES = (
+    ("halo", ("ppermute", "pshuffle")),
+    ("gather", ("all_gather", "broadcast", "all_to_all")),
+    ("reduce", ("psum", "pmin", "pmax", "pmean", "psum_scatter")),
+)
+
+
+def collective_split(coll: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Bucket the snapshot ``collectives`` gauges by transfer class
+    (halo vs gather vs reduce — see ``_COLLECTIVE_CLASSES``), plus the
+    replication ratio: total collective bytes over the boundary-state
+    bytes the halo wrappers declared via
+    ``telemetry.account_halo_state``. A ratio near the halo pad factor
+    means the mesh moved essentially only boundary state; an
+    all-gather path pushes it orders of magnitude above that."""
+    if not coll:
+        return None
+    by_kind = coll.get("by_kind") or {}
+    by_class: Dict[str, Dict[str, Any]] = {}
+    assigned = set()
+    for cls, kinds in _COLLECTIVE_CLASSES:
+        b = c = 0
+        members = []
+        for k in kinds:
+            row = by_kind.get(k) or {}
+            if row.get("calls"):
+                assigned.add(k)
+                b += int(row.get("bytes") or 0)
+                c += int(row.get("calls") or 0)
+                members.append(k)
+        if c:
+            by_class[cls] = {"bytes": b, "calls": c, "kinds": members}
+    other_b = other_c = 0
+    other_members = []
+    for k, row in by_kind.items():
+        if k in assigned:
+            continue
+        row = row or {}
+        if row.get("calls"):
+            other_b += int(row.get("bytes") or 0)
+            other_c += int(row.get("calls") or 0)
+            other_members.append(k)
+    if other_c:
+        by_class["other"] = {"bytes": other_b, "calls": other_c,
+                             "kinds": sorted(other_members)}
+    if not by_class:
+        return None
+    out: Dict[str, Any] = {"by_class": by_class}
+    halo_state = coll.get("halo_state_bytes")
+    total = int(coll.get("bytes") or 0)
+    if isinstance(halo_state, (int, float)) and not isinstance(
+            halo_state, bool) and halo_state > 0:
+        out["halo_state_bytes"] = int(halo_state)
+        out["replication_ratio"] = total / float(halo_state)
+    return out
+
+
 # -- report -------------------------------------------------------------------
 
 
@@ -237,6 +298,23 @@ def cmd_report(args) -> int:
                 print("    by axis: " + ", ".join(
                     f"{ax}={int(b or 0)}B" for ax, b in sorted(axes.items())
                 ))
+            split = collective_split(coll)
+            if split:
+                print("    by class: " + ", ".join(
+                    f"{cls}={int(row['bytes'])}B/{int(row['calls'])} "
+                    f"call(s) [{'+'.join(row['kinds'])}]"
+                    for cls, row in sorted(split["by_class"].items())
+                ))
+                rr = split.get("replication_ratio")
+                if rr is not None:
+                    print(f"    replication ratio "
+                          f"{float(rr):.2f}x (collective bytes / "
+                          "boundary-state bytes)")
+                    print(f"      ↳ {int(coll.get('bytes') or 0)} B "
+                          "moved by collectives over "
+                          f"{int(split['halo_state_bytes'])} B of live "
+                          "boundary-pane state the halo wrappers "
+                          "declared (telemetry.account_halo_state)")
         if snap.get("dropped_events"):
             print(f"\nWARNING: {int(snap['dropped_events'])} trace events "
                   "dropped (buffer cap) — attribution above is partial")
@@ -364,6 +442,9 @@ def _report_json(args, doc, events, bound) -> int:
             out["nodes"] = snap["nodes"]
         if snap.get("collectives"):
             out["collectives"] = snap["collectives"]
+            split = collective_split(snap["collectives"])
+            if split:
+                out["collective_split"] = split
         out["ledger"] = {
             "ledger_version": int(doc.get("ledger_version", 0)),
             "env": doc.get("env") or {},
@@ -692,6 +773,8 @@ def cmd_health(args) -> int:
                 "dag": snap.get("dag") or {},
                 "nodes": snap.get("nodes") or {},
                 "collectives": snap.get("collectives") or {},
+                "collective_split": collective_split(
+                    snap.get("collectives") or {}),
                 "instant_events": events_mod.notable_event_counts(
                     doc.get("events") or []),
             },
@@ -795,6 +878,21 @@ def cmd_health(args) -> int:
         print(f"note mesh collectives: {int(coll.get('calls') or 0)} "
               f"call(s), {int(coll.get('bytes') or 0)} B "
               "(trace-time logical estimate)")
+        split = collective_split(coll)
+        if split:
+            print("note collective classes: " + ", ".join(
+                f"{cls}={int(row['bytes'])}B/{int(row['calls'])} "
+                f"call(s) [{'+'.join(row['kinds'])}]"
+                for cls, row in sorted(split["by_class"].items())))
+            rr = split.get("replication_ratio")
+            if rr is not None:
+                print(f"note replication ratio: {float(rr):.2f}x "
+                      "(collective bytes / boundary-state bytes)")
+                print(f"  ↳ {int(coll.get('bytes') or 0)} B moved by "
+                      "collectives over "
+                      f"{int(split['halo_state_bytes'])} B of live "
+                      "boundary-pane state the halo wrappers declared "
+                      "(telemetry.account_halo_state)")
     # Pipelined-ingest visibility (informational, the overload idiom):
     # a collapse means the circuit breaker forced the executor back to
     # the synchronous cadence mid-run — a stalled pipeline, worth a
