@@ -728,6 +728,14 @@ class DataflowDAG:
                         telemetry.span(f"node.{name}", start=win.start,
                                        events=len(win.events)):
                     res = self._run_node(node, win, results)
+                    if telemetry.enabled:
+                        # Latency lineage, per-node "compute": each
+                        # node's own event-time staleness at result
+                        # time — the unit commit is shared, so this is
+                        # the stage that differentiates the seven nodes
+                        # (and what SloSpec.node_budgets e2e ceilings
+                        # read). The scope above tags the bucket.
+                        telemetry.record_e2e(win.end, "compute")
                     results[name] = res
                     st = self._nstate[name]
                     n = 0
@@ -847,11 +855,20 @@ class DataflowDAG:
         p99 = st["lag"].percentile(0.99) if st["lag"].count else 0.0
         if p99 != p99 or math.isinf(p99):
             p99 = 0.0
+        # Per-node e2e staleness from the node's own "compute" lineage
+        # stage (telemetry buckets, fed by the scoped stamp in
+        # _process_window). None before the first stamped window — the
+        # SLO engine's silence-fails rule turns that into a failed
+        # check, never a silent pass.
+        e2e_p50, e2e_p99 = telemetry.e2e_stage_percentiles(
+            "compute", node=name)
         return {
             "watermark_lag_p99_ms": float(p99),
             "retries": int(st["retries"]),
             "failovers": int(st["failovers"]),
             "degraded_windows": int(st["degraded_windows"]),
+            "e2e_p50_ms": e2e_p50,
+            "e2e_p99_ms": e2e_p99,
         }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -872,6 +889,12 @@ class DataflowDAG:
                 "degraded_windows": int(st["degraded_windows"]),
                 "watermark_lag_p99_ms": stats["watermark_lag_p99_ms"],
             }
+            # Additive: e2e lineage fields appear only once the node has
+            # stamped a window (un-armed / pre-v3 snapshot shape is
+            # byte-compatible without them).
+            if stats.get("e2e_p99_ms") is not None:
+                rec["e2e_p50_ms"] = stats["e2e_p50_ms"]
+                rec["e2e_p99_ms"] = stats["e2e_p99_ms"]
             if st["breaker"] is not None:
                 rec["breaker"] = st["breaker"].snapshot()
             nodes[name] = rec

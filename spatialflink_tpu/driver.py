@@ -265,6 +265,13 @@ class WindowedDataflowDriver:
         self._since_ckpt = 0
         self._consumed = 0
         self._skip = 0
+        # Window ends finished since the last commit — the latency-
+        # lineage "commit" stage stamps them when the sink/checkpoint
+        # actually publishes (the only moment a result is durably OURS).
+        # Only populated while a sink or checkpoint exists: a driverless
+        # yield has no commit concept, and an unbounded list here would
+        # leak on sinkless runs.
+        self._pending_commit: list = []
 
     # -- binding / resume ------------------------------------------------------
 
@@ -548,6 +555,14 @@ class WindowedDataflowDriver:
         through it, so retry/failover/breaker semantics are unchanged."""
         from spatialflink_tpu.pipeline import breaker_collapsed
 
+        if telemetry.enabled:
+            # Latency lineage, stage "assemble": the window just fired
+            # at the source clock — its event-time staleness starts the
+            # per-window lineage every later stage extends.
+            end = getattr(win, "end", None)
+            if end is not None:
+                telemetry.record_e2e(end, "assemble",
+                                     node=self._node_label)
         if pipe is None:
             yield self._process_window(win)
             return
@@ -602,6 +617,12 @@ class WindowedDataflowDriver:
             yield from self._pipe_drain(pipe)
             yield self._process_window(win)
             return
+        if telemetry.enabled:
+            # Stage "ship": the overlapped encode + host→device stage +
+            # async dispatch returned — the pane is on the wire.
+            end = getattr(win, "end", None)
+            if end is not None:
+                telemetry.record_e2e(end, "ship", node=self._node_label)
         pipe["inflight"].append((win, work))
         while len(pipe["inflight"]) > int(pipe["pol"].fetch_lag):
             yield from self._pipe_fetch_one(pipe)
@@ -627,12 +648,18 @@ class WindowedDataflowDriver:
         if breaker is not None:
             breaker.record_success()
         telemetry.record_pipeline(windows=1, overlapped=1)
+        if telemetry.enabled:
+            # Stage "fetch": the lagged true-sync device→host drain —
+            # the result exists host-side from here on.
+            end = getattr(win, "end", None)
+            if end is not None:
+                telemetry.record_e2e(end, "fetch", node=self._node_label)
         # NEVER degraded: this window was computed AND fetched on the
         # device path — a backend that flipped to fallback after its
         # dispatch does not make it a degraded window (charging it
         # would inflate degraded_window_budget for device-answered
         # results).
-        yield self._finish_window(result, degraded=False)
+        yield self._finish_window(result, degraded=False, win=win)
 
     def _pipe_drain(self, pipe) -> Iterator:
         """Fetch every in-flight window now — the consistent frontier
@@ -669,7 +696,7 @@ class WindowedDataflowDriver:
             route = breaker.route()
             if route == "fallback":
                 return self._finish_window(self.fallback(win),
-                                           degraded=True)
+                                           degraded=True, win=win)
             single_attempt = route == "probe"
         policy = self.retry
         attempt = 0
@@ -711,7 +738,7 @@ class WindowedDataflowDriver:
                     # the next probe may win the device path back.
                     breaker.record_failure(start, repr(e))
                     return self._finish_window(self.fallback(win),
-                                               degraded=True)
+                                               degraded=True, win=win)
                 if self.backend == "device" and self.fallback is not None:
                     # Graceful degradation: the device path is gone (a
                     # dead tunnel outlives any retry budget) — switch to
@@ -725,15 +752,29 @@ class WindowedDataflowDriver:
                     continue
                 raise
         return self._finish_window(result,
-                                   degraded=self.backend != "device")
+                                   degraded=self.backend != "device",
+                                   win=win)
 
-    def _finish_window(self, result, degraded: bool = False):
+    def _finish_window(self, result, degraded: bool = False, win=None):
         self.stats["windows"] += 1
         self._since_ckpt += 1
         if degraded and self.overload is not None:
             # A window answered by a non-device path is a DEGRADED
             # window — the SLO ``degraded_window_budget`` counts these.
             self.overload.count_degraded_window()
+        if telemetry.enabled and win is not None:
+            end = getattr(win, "end", None)
+            if end is not None:
+                # Stage "compute": the window's result is materialized
+                # host-side (sync path: processor returned; pipelined
+                # path: observed at its ordered fetch — compute finished
+                # at-or-before that moment, so the stamp is the honest
+                # conservative bound).
+                telemetry.record_e2e(end, "compute",
+                                     node=self._node_label)
+                if self.sink is not None or \
+                        self.checkpoint_path is not None:
+                    self._pending_commit.append(end)
         return result
 
     # -- checkpoint commit -----------------------------------------------------
@@ -769,11 +810,26 @@ class WindowedDataflowDriver:
         save_checkpoint(self.checkpoint_path, **components)
         self.stats["checkpoints"] += 1
         self._since_ckpt = 0
+        self._stamp_committed()
 
     def _commit_sink_only(self) -> None:
         if self.sink is not None and hasattr(self.sink, "commit") \
                 and getattr(self.sink, "pending", 0):
             self.sink.commit()
+        self._stamp_committed()
+
+    def _stamp_committed(self) -> None:
+        """Latency lineage, stage "commit": every window finished since
+        the last commit is now durably published (egress appended and/or
+        checkpoint framed) — the stamp that answers "how stale is a
+        COMMITTED result?". Closes each window's open lineage entry."""
+        if not self._pending_commit:
+            return
+        if telemetry.enabled:
+            for end in self._pending_commit:
+                telemetry.record_e2e(end, "commit",
+                                     node=self._node_label)
+        self._pending_commit = []
 
 
 # ---------------------------------------------------------------------------
@@ -827,6 +883,13 @@ def run_chaos_child(workdir: str) -> int:
     from spatialflink_tpu.operators.range_query import PointPointRangeQuery
     from spatialflink_tpu.streams.sinks import TransactionalFileSink
 
+    # A stream-armed chaos child records its capture (the dag.py chaos
+    # idiom): the abort leg's kill then leaves both a recoverable stream
+    # AND a <stream>.blackbox.json flight-recorder dump — what
+    # chaos_smoke() asserts below.
+    stream = os.environ.get("SFT_LEDGER_STREAM")
+    if stream:
+        telemetry.enable(stream_path=stream)
     grid, conf, source, query = _toy_pipeline()
     sink = TransactionalFileSink(os.path.join(workdir, "egress.csv"))
     driver = WindowedDataflowDriver(
@@ -841,6 +904,8 @@ def run_chaos_child(workdir: str) -> int:
         for line in render_range_result(res):
             sink.stage(line)
             n += 1
+    if stream:
+        telemetry.seal_stream("complete")
     return n
 
 
@@ -927,6 +992,10 @@ def chaos_smoke() -> int:
 
     env_base = dict(os.environ)
     env_base.pop("SFT_FAULT_PLAN", None)
+    # Ambient capture paths would point every leg's stream at ONE file
+    # (the kill leg arms its own below).
+    env_base.pop("SFT_LEDGER_STREAM", None)
+    env_base.pop("SFT_LEDGER_PATH", None)
     # The smoke must not dial the axon tunnel (CLAUDE.md outage rule),
     # and with the plugin unregistered an ambient JAX_PLATFORMS=axon
     # would fail to resolve — force CPU like every CPU-only path does
@@ -934,10 +1003,12 @@ def chaos_smoke() -> int:
     env_base["PALLAS_AXON_POOL_IPS"] = ""
     env_base["JAX_PLATFORMS"] = "cpu"
 
-    def child(workdir, plan=None):
+    def child(workdir, plan=None, stream=None):
         env = dict(env_base)
         if plan is not None:
             env["SFT_FAULT_PLAN"] = json.dumps(plan)
+        if stream is not None:
+            env["SFT_LEDGER_STREAM"] = stream
         return subprocess.run(
             [sys.executable, "-m", "spatialflink_tpu.driver",
              "--chaos-child", workdir],
@@ -955,11 +1026,34 @@ def chaos_smoke() -> int:
             return 1
         # Kill -9 analog mid-run: the abort fault fires on the 2nd sink
         # commit — after durable state exists, before the run completes.
+        # The kill leg streams its capture so the abort leaves a flight-
+        # recorder dump beside it (record_fault dumps BEFORE os._exit).
+        stream = os.path.join(chaos_dir, "stream.jsonl")
         p = child(chaos_dir,
-                  plan=[{"point": "sink.write", "kind": "abort", "at": 2}])
+                  plan=[{"point": "sink.write", "kind": "abort", "at": 2}],
+                  stream=stream)
         if p.returncode != 137:
             print(f"chaos-smoke: expected the armed child to die with "
                   f"exit 137, got {p.returncode}\n" + p.stderr[-2000:])
+            return 1
+        bb_path = stream + ".blackbox.json"
+        if not os.path.exists(bb_path):
+            print("chaos-smoke: the killed child left no flight-recorder "
+                  f"dump at {bb_path}")
+            return 1
+        try:
+            with open(bb_path) as f:
+                bb = json.load(f)
+        except ValueError as e:
+            print(f"chaos-smoke: blackbox dump unparseable: {e!r}")
+            return 1
+        if bb.get("blackbox_version") != 1 \
+                or not str(bb.get("reason", "")).startswith("fault:") \
+                or not bb.get("ring"):
+            print("chaos-smoke: blackbox dump malformed "
+                  f"(version={bb.get('blackbox_version')!r}, "
+                  f"reason={bb.get('reason')!r}, "
+                  f"ring entries={len(bb.get('ring') or [])})")
             return 1
         p = child(chaos_dir)  # resume from the published checkpoint
         if p.returncode != 0:

@@ -101,6 +101,12 @@ ENV_VARS = {
         "owner": "spatialflink_tpu/telemetry.py", "hazard": "capture",
         "doc": "stream flush pacing (seconds)",
     },
+    "SFT_BLACKBOX": {
+        "owner": "spatialflink_tpu/telemetry.py", "hazard": "capture",
+        "doc": "flight-recorder ring size (last-N window summaries + "
+               "instants dumped to <stream>.blackbox.json on fault "
+               "fire / stream seal; '0' disables, default 64)",
+    },
     "SFT_LEDGER_DIR": {
         "owner": "bench_suite.py", "hazard": "capture",
         "doc": "per-config ledger directory for suite runs",

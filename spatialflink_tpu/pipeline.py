@@ -209,13 +209,20 @@ class PipelinedExecutor:
                  fetch: Callable[[List[Any]], Iterable],
                  label: str = "pipeline",
                  spans: bool = False,
-                 node: Optional[str] = None):
+                 node: Optional[str] = None,
+                 e2e_end: Optional[Callable[[Any], Any]] = None):
         self.pol = pol
         self._ship_fn = ship
         self._compute_fn = compute
         self._fetch_fn = fetch
         self.label = label
         self.spans = spans
+        #: Latency-lineage hook: extracts an item's event-time window
+        #: end (ms) — when set, each stage boundary feeds its own
+        #: telemetry ``record_e2e`` bucket (ship/compute/fetch; the
+        #: driver stamps assemble/commit around its executor). None =
+        #: items are not windows (segmented scans) — no stamps.
+        self._e2e_end = e2e_end
         #: Node-attribution tag for the per-item work (None inherits the
         #: caller's ambient scope — the executor runs on its thread, so
         #: a driver/DAG scope already propagates; set it for standalone
@@ -234,6 +241,17 @@ class PipelinedExecutor:
         if faults.armed:  # chaos injection point (faults.py)
             faults.hit("pipeline.fetch")
         return self._fetch_fn(works)
+
+    def _stamp_e2e(self, item, stage):
+        """Latency-lineage stage stamp when an ``e2e_end`` extractor is
+        wired; returns the item's event-time end so the fetch stage can
+        stamp without re-extracting."""
+        if self._e2e_end is None or not telemetry.enabled:
+            return None
+        end = self._e2e_end(item)
+        if end is not None:
+            telemetry.record_e2e(end, stage)
+        return end
 
     def _sync_collapse_state(self):
         want = breaker_collapsed()
@@ -263,6 +281,7 @@ class PipelinedExecutor:
         the circuit is open both clamp to the synchronous cadence."""
         shipped: deque = deque()
         inflight: deque = deque()
+        ends: deque = deque()  # event-time ends aligned with inflight
         it = iter(items)
         exhausted = False
 
@@ -275,6 +294,7 @@ class PipelinedExecutor:
                     exhausted = True
                     break
                 shipped.append((item, self._ship(item)))
+                self._stamp_e2e(item, "ship")
 
         def maybe_span(name: str):
             return (telemetry.span(name) if self.spans
@@ -306,6 +326,7 @@ class PipelinedExecutor:
                 del staged  # the one compute owns (and may donate) it
                 if work is not None:
                     inflight.append(work)
+                    ends.append(self._stamp_e2e(item, "compute"))
                     if telemetry.enabled:
                         telemetry.record_pipeline(
                             windows=1,
@@ -315,13 +336,21 @@ class PipelinedExecutor:
                 while len(inflight) > lag:
                     with maybe_span("fetch"):
                         out.extend(self._fetch([inflight.popleft()]))
+                    end = ends.popleft()
+                    if end is not None and telemetry.enabled:
+                        telemetry.record_e2e(end, "fetch")
             yield from out
             self._sync_collapse_state()
         if inflight:  # final drain: ONE true sync for the whole tail
             with telemetry.scope(self.node):
                 tail = list(self._fetch(list(inflight)))
+                if telemetry.enabled:
+                    for end in ends:
+                        if end is not None:
+                            telemetry.record_e2e(end, "fetch")
             yield from tail
             inflight.clear()
+            ends.clear()
 
 
 # Subprocess arming: a pipelined chaos child only needs SFT_PIPELINE in

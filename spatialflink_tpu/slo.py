@@ -76,11 +76,19 @@ class SloSpec:
     - ``node_budgets``: per-DAG-node freshness/health budgets (the
       composed-dataflow scoping, spatialflink_tpu/dag.py) — ``{node:
       {"watermark_lag_p99_ms": L, "retry_budget": N,
-      "failover_budget": M, "degraded_window_budget": K}}`` checked
-      against the installed DAG's PER-NODE counters, so each query's
-      watermark lag is budgeted separately. A spec naming a node
+      "failover_budget": M, "degraded_window_budget": K,
+      "e2e_p50_ms": P, "e2e_p99_ms": Q}}`` checked against the
+      installed DAG's PER-NODE counters, so each query's watermark lag
+      (and event-time end-to-end staleness, from the node's "compute"
+      lineage stage) is budgeted separately. A spec naming a node
       against a run with NO DAG installed (or an unknown node name)
       violates — silence fails;
+    - ``e2e_p50_ms`` / ``e2e_p99_ms``: event-time end-to-end latency
+      ceilings on the GLOBAL "commit" lineage stage (telemetry
+      ``record_e2e``: window event-time end → sink/checkpoint commit).
+      Checked only after ``warmup_windows`` (the eps_floor grace);
+      past warm-up, a spec naming them against a run that never
+      stamped a commit violates — silence fails;
     - ``eval_interval_s``: pacing of the incremental evaluation (the
       per-window cost between evaluations is counter updates only).
     """
@@ -95,6 +103,8 @@ class SloSpec:
     failover_budget: Optional[int] = None
     shed_budget: Optional[int] = None
     degraded_window_budget: Optional[int] = None
+    e2e_p50_ms: Optional[float] = None
+    e2e_p99_ms: Optional[float] = None
     tenant_budgets: Optional[Dict[str, Dict[str, int]]] = None
     node_budgets: Optional[Dict[str, Dict[str, int]]] = None
     eval_interval_s: float = 1.0
@@ -107,7 +117,8 @@ class SloSpec:
     #: Per-node budget keys ``node_budgets`` accepts (integer ms /
     #: counts — same strict map shape).
     NODE_BUDGET_KEYS = ("watermark_lag_p99_ms", "retry_budget",
-                        "failover_budget", "degraded_window_budget")
+                        "failover_budget", "degraded_window_budget",
+                        "e2e_p50_ms", "e2e_p99_ms")
 
     def __post_init__(self):
         # ONE validation home (overload.validate_budget_map): same
@@ -266,6 +277,21 @@ class SloEngine:
             check("degraded_window_budget", dw,
                   f"<= {int(sp.degraded_window_budget)}",
                   dw is not None and dw <= sp.degraded_window_budget)
+        if (sp.e2e_p50_ms is not None or sp.e2e_p99_ms is not None) \
+                and windows > sp.warmup_windows:
+            # Event-time end-to-end staleness on the global "commit"
+            # lineage stage. Past warm-up, a run that never stamped a
+            # commit leaves the ceiling unanswerable — silence fails
+            # (the eps_floor rule).
+            e2e_p50, e2e_p99 = self.tel.e2e_stage_percentiles("commit")
+            if sp.e2e_p50_ms is not None:
+                check("e2e_p50_ms", e2e_p50,
+                      f"<= {float(sp.e2e_p50_ms):g}",
+                      e2e_p50 is not None and e2e_p50 <= sp.e2e_p50_ms)
+            if sp.e2e_p99_ms is not None:
+                check("e2e_p99_ms", e2e_p99,
+                      f"<= {float(sp.e2e_p99_ms):g}",
+                      e2e_p99 is not None and e2e_p99 <= sp.e2e_p99_ms)
         if sp.tenant_budgets:
             ctrl = overload.controller()
             for cls, b in sorted(sp.tenant_budgets.items()):
@@ -301,9 +327,17 @@ class SloEngine:
                      "failovers"),
                     ("degraded_window_budget",
                      "node_degraded_window_budget", "degraded_windows"),
+                    ("e2e_p50_ms", "node_e2e_p50_ms", "e2e_p50_ms"),
+                    ("e2e_p99_ms", "node_e2e_p99_ms", "e2e_p99_ms"),
                 ):
                     bound = b.get(key)
                     if bound is None:
+                        continue
+                    if key.startswith("e2e_") \
+                            and windows <= sp.warmup_windows:
+                        # e2e lineage needs a committed window — give
+                        # warm-up the same grace eps_floor gets before
+                        # the silence-fails rule bites.
                         continue
                     val = None if stats is None else stats[metric]
                     check(f"{head}:{node}", val, f"<= {int(bound)}",

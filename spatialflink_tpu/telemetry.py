@@ -48,6 +48,7 @@ import sys
 import threading
 import time
 import warnings
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from spatialflink_tpu.ablation import ablation
@@ -60,16 +61,21 @@ from spatialflink_tpu.mn.metrics import FixedBucketLatency, json_safe
 #: validator deliberately doesn't import this package, so bump BOTH
 #: (tests/test_sfprof.py cross-pins them). v2: per-node attribution
 #: (snapshot ``nodes`` block, kernel-row ``node`` column) + collective
-#: accounting (snapshot ``collectives`` block); v1 documents remain
-#: readable (the new blocks are additive and appear only when scoped).
-LEDGER_VERSION = 2
+#: accounting (snapshot ``collectives`` block); v3: event-time
+#: end-to-end latency (snapshot ``e2e`` block — per-stage + per-node
+#: FixedBucketLatency gauges). v1/v2 documents remain readable (the new
+#: blocks are additive and appear only when their producers ran).
+LEDGER_VERSION = 3
 
 #: Ledger-STREAM record-layout version (the JSONL segment format behind
 #: ``SFT_LEDGER_STREAM``). Twin constant: tools/sfprof/stream.py:
 #: STREAM_VERSION — same no-cross-import rule, same cross-pin test.
-#: v2: checkpoints carry the v2 snapshot blocks above; the grammar
-#: itself is unchanged, so v1 streams still recover.
-STREAM_VERSION = 2
+#: v2: checkpoints carry the v2 snapshot blocks above; v3: checkpoints
+#: may carry the ``e2e`` block and a ``<stream>.blackbox.json`` flight-
+#: recorder dump may sit beside the stream (``sfprof recover`` folds a
+#: present dump in). The grammar itself is unchanged, so v1/v2 streams
+#: still recover.
+STREAM_VERSION = 3
 
 
 def _sanitize_nonfinite(value):
@@ -339,6 +345,33 @@ class Telemetry:
         # per-node "shed_events"/"shed_bytes" bucket columns.
         self.shed_events = 0
         self.shed_bytes = 0
+        # Event-time end-to-end latency (record_e2e): how stale a
+        # committed result is relative to the event time that produced
+        # it — the real-time criterion, not processing latency. One
+        # FixedBucketLatency per stage globally plus per (node, stage);
+        # open per-window entries are bounded (E2E_OPEN_MAX, evictions
+        # counted) so the gauge stays fixed-memory like everything else
+        # here. The anchor pins the capture's wall↔event-time mapping:
+        # synthetic event clocks (bench replays) get honest staleness
+        # instead of a wall-minus-epoch-zero absurdity.
+        self._e2e_anchor: Optional[Tuple[float, float]] = None
+        self._e2e_open: Dict[int, Dict[str, float]] = {}
+        self._e2e_evicted = 0
+        self._e2e_stages: Dict[str, FixedBucketLatency] = {}
+        self._e2e_nodes: Dict[str, Dict[str, FixedBucketLatency]] = {}
+        # Flight recorder (the crash black box): bounded ring of the
+        # last-N window-span summaries + instant events, dumped to
+        # <stream>.blackbox.json on fault fire and stream seal (which
+        # covers dial timeout, disable, and normal completion) — the
+        # r3–r5 lesson that the most valuable telemetry is whatever
+        # survived the crash. SFT_BLACKBOX sizes the ring; "0" disables.
+        try:
+            bb_n = int(os.environ.get("SFT_BLACKBOX", "64"))
+        except ValueError:
+            bb_n = 64
+        self._blackbox: Optional[deque] = (
+            deque(maxlen=bb_n) if bb_n > 0 else None
+        )
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -515,6 +548,14 @@ class Telemetry:
         with self._lock:
             if self._stream_file is None or self._stream_sealed:
                 return
+            # Flight-recorder dump rides EVERY seal — dial_timeout,
+            # disable, and normal completion alike (ISSUE: the black box
+            # is cheapest exactly when nobody thinks they need it). The
+            # marker instant lands in the final span batch below.
+            bb = self.dump_blackbox(reason)
+            if bb is not None and self.enabled:
+                self.emit_instant("blackbox_dumped",
+                                  reason=str(reason), path=bb)
             self._flush_stream_locked()
             if slo is None and self.slo_provider is not None:
                 try:
@@ -638,6 +679,13 @@ class Telemetry:
         if name.startswith("window"):
             with self._lock:
                 self.window_latency.observe(dur_ns / 1e6)
+                # Flight recorder: the ring keeps the last-N window
+                # summaries so a crash dump shows what the run was DOING,
+                # not just its counters.
+                self._blackbox_append({
+                    "t": "window", "name": name, "ts": ev["ts"],
+                    "dur_us": ev["dur"], "args": ev.get("args", {}),
+                })
             # Window boundary = the stream's flush point (interval-paced
             # inside, so per-window cost is one clock read + a compare).
             self.maybe_flush_stream()
@@ -652,13 +700,19 @@ class Telemetry:
         if node is not None:
             args = dict(args)
             args.setdefault("node", node)
+        ts = time.perf_counter_ns() // 1000
+        safe_args = json_safe(args)
         with self._lock:
             self._node_bucket(node)["instants"] += 1
+            # Flight recorder: instants ride the ring too — a crash dump
+            # without the fault/failover markers around it is useless.
+            self._blackbox_append({"t": "instant", "name": name,
+                                   "ts": ts, "args": safe_args})
         self._emit({
             "name": name, "cat": "telemetry", "ph": "i",
-            "ts": time.perf_counter_ns() // 1000, "pid": os.getpid(),
+            "ts": ts, "pid": os.getpid(),
             "tid": threading.get_ident(), "s": "t",
-            "args": json_safe(args),
+            "args": safe_args,
         })
 
     def _emit(self, event: dict):
@@ -1235,6 +1289,182 @@ class Telemetry:
         with self._lock:
             self.late_drops += int(n)
 
+    # -- event-time end-to-end latency (latency lineage) -----------------------
+
+    #: Stage vocabulary, pipeline order. ``assemble`` = window fired at
+    #: the source clock; ``ship``/``compute``/``fetch`` = the pipelined
+    #: boundary crossings; ``commit`` = the sink's transactional append
+    #: — the only number that answers "how stale is a committed result
+    #: relative to the event time that produced it?".
+    E2E_STAGES = ("assemble", "ship", "compute", "fetch", "commit")
+
+    #: Open per-window entries are bounded: a window that never commits
+    #: (shed, crashed, replaced) must not leak memory forever. Oldest
+    #: win-end evicts first; evictions are counted in the ``e2e`` block.
+    E2E_OPEN_MAX = 4096
+
+    def record_e2e(self, win_end_ms, stage: str,
+                   node: Optional[str] = None) -> Optional[float]:
+        """One stage boundary of one window's latency lineage.
+
+        The first stamp for a window anchors it: its ``assemble``
+        latency is the anchored event-time staleness — wall-now minus
+        the *virtual* wall time of the window's end event, where the
+        capture-wide anchor (first stamp ever) maps event-time ms onto
+        the wall clock. Synthetic event clocks (bench replays running
+        faster or slower than real time) therefore measure honest
+        pipeline staleness instead of wall-minus-epoch nonsense. Every
+        later stage records ``assemble latency + wall elapsed since the
+        window's first stamp`` — monotone by construction, so per-stage
+        differences are real wall durations and the critical-path
+        conservation receipt (segments sum ≤ commit e2e) holds per
+        window. ``commit`` closes the entry. Returns the observed
+        latency in ms (None while disabled)."""
+        if not self.enabled:
+            return None
+        now_mono = time.monotonic()
+        with self._lock:
+            key = int(win_end_ms)
+            entry = self._e2e_open.get(key)
+            if entry is None:
+                wall = time.time()
+                if self._e2e_anchor is None:
+                    self._e2e_anchor = (float(wall), float(win_end_ms))
+                a_wall, a_ev = self._e2e_anchor
+                virtual_wall = a_wall + (float(win_end_ms) - a_ev) / 1e3
+                entry = {
+                    "assemble_ms": max((wall - virtual_wall) * 1e3, 0.0),
+                    "t0": now_mono,
+                }
+                if len(self._e2e_open) >= self.E2E_OPEN_MAX:
+                    self._e2e_open.pop(min(self._e2e_open))
+                    self._e2e_evicted += 1
+                self._e2e_open[key] = entry
+            if stage == "assemble":
+                lat_ms = entry["assemble_ms"]
+            else:
+                lat_ms = (entry["assemble_ms"]
+                          + (now_mono - entry["t0"]) * 1e3)
+            self._e2e_bucket(None, stage).observe(lat_ms)
+            if node is None:
+                node = self.current_node()
+            if node is not None:
+                self._e2e_bucket(node, stage).observe(lat_ms)
+            if stage == "commit":
+                self._e2e_open.pop(key, None)
+        return float(lat_ms)
+
+    def _e2e_bucket(self, node: Optional[str],
+                    stage: str) -> FixedBucketLatency:
+        """The (node, stage) latency histogram (caller holds the lock);
+        ``node=None`` is the global per-stage gauge."""
+        d = (self._e2e_stages if node is None
+             else self._e2e_nodes.setdefault(str(node), {}))
+        b = d.get(stage)
+        if b is None:
+            b = d[stage] = FixedBucketLatency()
+        return b
+
+    def e2e_stage_percentiles(self, stage: str,
+                              node: Optional[str] = None):
+        """(p50_ms, p99_ms) for one stage's gauge — global when ``node``
+        is None, the node's own otherwise; (None, None) before the first
+        observation (the SLO engine's silence-fails rule handles it)."""
+        with self._lock:
+            d = (self._e2e_stages if node is None
+                 else self._e2e_nodes.get(str(node), {}))
+            lat = d.get(stage)
+            if lat is None or not lat.count:
+                return (None, None)
+            p50 = lat.percentile(0.50)
+            p99 = lat.percentile(0.99)
+        return (None if p50 != p50 else float(p50),
+                None if p99 != p99 else float(p99))
+
+    def e2e_gauges(self) -> Optional[Dict[str, Any]]:
+        """The snapshot ``e2e`` block (None before the first stamp —
+        un-armed runs keep the v2 snapshot shape byte-compatible):
+        per-stage count/sum/p50/p99 globally and per node, the capture
+        anchor, and the open-entry gauge + eviction count."""
+        with self._lock:
+            if not self._e2e_stages and not self._e2e_nodes:
+                return None
+
+            def block(d: Dict[str, FixedBucketLatency]) -> Dict[str, Any]:
+                out = {}
+                for stage, lat in d.items():
+                    p50 = lat.percentile(0.50)
+                    p99 = lat.percentile(0.99)
+                    out[stage] = {
+                        "count": lat.count,
+                        "sum_ms": lat.sum_ms,
+                        "p50_ms": None if p50 != p50 else p50,
+                        "p99_ms": None if p99 != p99 else p99,
+                    }
+                return out
+
+            out: Dict[str, Any] = {"stages": block(self._e2e_stages)}
+            if self._e2e_nodes:
+                out["nodes"] = {n: block(d)
+                                for n, d in self._e2e_nodes.items()}
+            if self._e2e_anchor is not None:
+                out["anchor"] = {"wall_unix": self._e2e_anchor[0],
+                                 "event_ms": self._e2e_anchor[1]}
+            out["open_windows"] = len(self._e2e_open)
+            if self._e2e_evicted:
+                out["evicted"] = self._e2e_evicted
+        return json_safe(out)
+
+    # -- flight recorder (the crash black box) ---------------------------------
+
+    def dump_blackbox(self, reason: str) -> Optional[str]:
+        """Write the flight-recorder ring beside the ledger stream as
+        ``<stream>.blackbox.json`` — the last-N window summaries +
+        instants plus a counter snapshot, strict JSON so a truncation-
+        proof reader (``sfprof blackbox`` / ``recover``) always parses
+        it. No-op without a ring (SFT_BLACKBOX=0) or a stream path (the
+        dump names its stream — a black box with no flight is noise).
+        Best-effort on a dying process: an OSError is swallowed, never
+        raised into the crash path that triggered the dump."""
+        with self._lock:
+            if self._blackbox is None or self.stream_path is None:
+                return None
+            path = self.stream_path + ".blackbox.json"
+            doc = {
+                "blackbox_version": 1,
+                "reason": str(reason),
+                "unix": time.time(),
+                "stream": self.stream_path,
+                "ring": list(self._blackbox),
+                "counters": {
+                    "events": len(self.events),
+                    "dropped_events": self.dropped_events,
+                    "h2d_bytes": self.h2d_bytes,
+                    "d2h_bytes": self.d2h_bytes,
+                    "compiles": len(self.compile_events),
+                    "late_drops": self.late_drops,
+                    "fault_fires": dict(self.fault_fires),
+                    "driver_retries": self.driver_retries,
+                    "driver_failovers": self.driver_failovers,
+                },
+            }
+            e2e = self.e2e_gauges()
+            if e2e is not None:
+                doc["e2e"] = e2e
+            doc, _ = _sanitize_nonfinite(json_safe(doc))
+            try:
+                with open(path, "w") as f:
+                    json.dump(doc, f, allow_nan=False)
+                    f.write("\n")
+            except OSError:
+                return None
+        return path
+
+    def _blackbox_append(self, rec: Dict[str, Any]):
+        """Ring append (caller holds the lock; no-op when disabled)."""
+        if self._blackbox is not None:
+            self._blackbox.append(rec)
+
     # -- fault tolerance (faults.py / driver.py) -------------------------------
 
     def record_fault(self, point: str, kind: str = "raise", hit: int = 0):
@@ -1251,6 +1481,15 @@ class Telemetry:
             self._node_bucket(self.current_node())["fault_fires"] += 1
         self.emit_instant(f"fault_fired:{point}", kind=kind, hit=int(hit))
         self.maybe_flush_stream(force=True)
+        # Flight-recorder dump AFTER the force flush (the stream already
+        # has the fault record) and BEFORE faults._fire's os._exit on
+        # the abort kind — this call is the last code an aborting
+        # process runs with its telemetry intact.
+        bb = self.dump_blackbox(f"fault:{point}")
+        if bb is not None:
+            self.emit_instant("blackbox_dumped",
+                              reason=f"fault:{point}", path=bb)
+            self.maybe_flush_stream(force=True)
 
     def record_driver_retry(self, window_start: int, attempt: int,
                             error: str):
@@ -1367,6 +1606,12 @@ class Telemetry:
         link = self.link_gauges()
         if link is not None:
             out["link_probe"] = link
+        # v3 block: event-time end-to-end latency — additive, absent
+        # until the first record_e2e stamp, so un-armed runs keep the
+        # v2 snapshot shape byte-compatible.
+        e2e = self.e2e_gauges()
+        if e2e is not None:
+            out["e2e"] = e2e
         # v2 blocks, both strictly additive and absent until their
         # producers run — an un-scoped, collective-free run snapshots
         # the exact v1 shape (the byte-compat contract for old readers).

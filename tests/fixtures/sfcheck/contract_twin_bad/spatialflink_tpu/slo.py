@@ -1,5 +1,6 @@
 """Deliberate violation corpus (contract-twin): the live SLO spec —
-one field its mirror lacks, and a drifted version pin."""
+two fields its mirror lacks (one of them an e2e latency ceiling), and
+a drifted version pin."""
 
 SLO_VERSION = 2
 
@@ -8,3 +9,4 @@ class SloSpec:
     name: str = "default"
     lag_ms: float = 0.0
     extra_live_only: int = 0
+    e2e_p99_ms: float = 0.0  # lineage ceiling the mirror never learned

@@ -6,3 +6,5 @@ SLO_VERSION = 1
 class SloSpec:
     name: str = "default"
     lag_ms: float = 0.0
+    e2e_p50_ms: float = 0.0
+    e2e_p99_ms: float = 0.0

@@ -9,4 +9,5 @@ class Tel:
 
 def produce(tel, point):
     tel.emit_instant("good_event")
+    tel.emit_instant("blackbox_dumped")
     tel.emit_instant(f"used_prefix:{point}")

@@ -1,5 +1,5 @@
 """Clean twin (contract-twin): registry matches the producers exactly."""
 
-INSTANT_EVENTS = frozenset({"good_event"})
+INSTANT_EVENTS = frozenset({"good_event", "blackbox_dumped"})
 
 INSTANT_EVENT_PREFIXES = ("used_prefix:",)

@@ -2,4 +2,4 @@
 
 SLO_VERSION = 1
 
-SPEC_KEYS = ("name", "lag_ms")
+SPEC_KEYS = ("name", "lag_ms", "e2e_p50_ms", "e2e_p99_ms")

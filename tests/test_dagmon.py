@@ -118,6 +118,50 @@ class TestConservation:
         finally:
             telemetry.disable()
 
+    def test_nested_scope_reentrancy_conserves_exactly(self):
+        """scope() is a re-entrant stack: the innermost node wins while
+        it is active, the outer tag is restored on exit (not cleared),
+        and every conserved counter still sums EXACTLY to its untagged
+        global — re-labeling across nesting never double-counts."""
+        telemetry.enable()
+        try:
+            with telemetry.scope("outer"):
+                telemetry.account_h2d(100)
+                with telemetry.scope("inner"):
+                    assert telemetry.current_node() == "inner"
+                    telemetry.account_h2d(30)
+                    telemetry.account_collective("psum", 2048,
+                                                 axis="data")
+                    telemetry.record_e2e(1_000, "compute")
+                # the outer tag must come back — a scope exit that
+                # cleared instead of popped would orphan this byte
+                assert telemetry.current_node() == "outer"
+                telemetry.account_d2h(7)
+            assert telemetry.current_node() is None
+            telemetry.account_h2d(5)  # unscoped remainder
+
+            rollup = telemetry.node_rollup()
+            assert rollup["outer"]["h2d_bytes"] == 100
+            assert rollup["outer"]["d2h_bytes"] == 7
+            assert rollup["inner"]["h2d_bytes"] == 30
+            assert rollup["inner"]["collective_bytes"] == 2048
+            assert rollup["(unscoped)"]["h2d_bytes"] == 5
+
+            sums = _bucket_sums(rollup)
+            assert sums["h2d_bytes"] == telemetry.h2d_bytes == 135
+            assert sums["d2h_bytes"] == telemetry.d2h_bytes == 7
+            assert sums["collective_bytes"] == \
+                telemetry.collective_gauges()["bytes"]
+
+            # e2e lineage honors the same innermost-wins rule: the
+            # stamp inside the inner scope lands in inner's bucket only.
+            e2e = telemetry.e2e_gauges()
+            assert set(e2e["nodes"]) == {"inner"}
+            assert e2e["nodes"]["inner"]["compute"]["count"] == 1
+            assert e2e["stages"]["compute"]["count"] == 1
+        finally:
+            telemetry.disable()
+
 
 class TestByteCompat:
     def test_unscoped_capture_snapshots_the_v1_shape(self, tmp_path):
@@ -137,9 +181,12 @@ class TestByteCompat:
             telemetry.write_ledger(path, capture_costs=False)
             with open(path) as f:
                 doc = json.load(f)
-            assert doc["ledger_version"] == 2
+            assert doc["ledger_version"] == 3
             assert "nodes" not in doc["snapshot"]
             assert "collectives" not in doc["snapshot"]
+            # Latency lineage is opt-in the same way: no e2e stamp ever
+            # → no e2e block (the v2 byte-compat rule).
+            assert "e2e" not in doc["snapshot"]
             for row in doc["kernels"]:
                 assert "node" not in row
         finally:

@@ -1,0 +1,390 @@
+"""Latency lineage (ISSUE 19): the event-time e2e engine in
+telemetry.py (anchored staleness, cumulative stage buckets, bounded
+open-window set), the flight-recorder black box (ring → dump →
+``sfprof blackbox`` / ``recover`` fold), ``sfprof critical``'s
+straggler + conservation receipt, and the live follower's e2e lines.
+The SLO ceilings over these gauges live in tests/test_slo.py; the
+un-armed byte-compat pin lives with the other shape pins in
+tests/test_dagmon.py."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from spatialflink_tpu import dag as dag_mod  # noqa: E402
+from spatialflink_tpu import overload, qserve  # noqa: E402
+from spatialflink_tpu.dag import build_sncb_dag, _toy_sncb_stream  # noqa: E402
+from spatialflink_tpu.driver import (  # noqa: E402
+    RetryPolicy,
+    WindowedDataflowDriver,
+)
+from spatialflink_tpu.telemetry import telemetry  # noqa: E402
+from tools.sfprof import critical as critical_mod  # noqa: E402
+from tools.sfprof import live as live_mod  # noqa: E402
+from tools.sfprof import stream as stream_mod  # noqa: E402
+from tools.sfprof.cli import main as sfprof_main  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    telemetry.disable()
+    dag_mod.uninstall()
+    qserve.uninstall()
+    overload.uninstall()
+
+
+# -- the e2e engine -----------------------------------------------------------
+
+
+class TestE2EEngine:
+    def test_stages_are_cumulative_and_commit_closes(self):
+        telemetry.enable()
+        seen = []
+        for stage in telemetry.E2E_STAGES:
+            seen.append(telemetry.record_e2e(1_000, stage))
+        # Cumulative: each later stage records assemble + elapsed —
+        # monotone nondecreasing per window by construction.
+        assert all(b >= a for a, b in zip(seen, seen[1:])), seen
+        e2e = telemetry.e2e_gauges()
+        for stage in telemetry.E2E_STAGES:
+            assert e2e["stages"][stage]["count"] == 1
+            assert e2e["stages"][stage]["p99_ms"] is not None
+        # commit closed the entry; a second window stays open.
+        assert e2e["open_windows"] == 0
+        telemetry.record_e2e(2_000, "assemble")
+        assert telemetry.e2e_gauges()["open_windows"] == 1
+        p50, p99 = telemetry.e2e_stage_percentiles("commit")
+        assert p50 is not None and p99 is not None and p99 >= p50
+        assert telemetry.e2e_stage_percentiles("commit",
+                                               node="ghost") == (None,
+                                                                 None)
+
+    def test_anchor_maps_event_time_onto_wall_clock(self):
+        """The first stamp anchors event-time onto the wall clock, so a
+        synthetic event clock measures honest pipeline staleness: the
+        anchor window reads ≈0, a window 10 s in the event-time PAST
+        reads ≈10 s, and an event-time FUTURE clamps to ≥0 — never
+        wall-minus-epoch nonsense."""
+        telemetry.enable()
+        a = telemetry.record_e2e(10_000, "assemble")
+        assert 0.0 <= a < 5_000.0  # anchor window: no staleness yet
+        past = telemetry.record_e2e(0, "assemble")
+        assert past >= 9_000.0  # 10 s stale relative to the anchor
+        future = telemetry.record_e2e(60_000, "assemble")
+        assert 0.0 <= future < 5_000.0  # clamped, not negative
+        anchor = telemetry.e2e_gauges()["anchor"]
+        assert anchor["event_ms"] == 10_000.0
+
+    def test_open_set_is_bounded_and_evictions_are_counted(self):
+        telemetry.enable()
+        telemetry.E2E_OPEN_MAX = 8  # instance override, class untouched
+        try:
+            for i in range(12):
+                telemetry.record_e2e(i * 1_000, "assemble")
+            e2e = telemetry.e2e_gauges()
+            assert e2e["open_windows"] == 8
+            assert e2e["evicted"] == 4
+        finally:
+            del telemetry.E2E_OPEN_MAX
+
+    def test_disabled_is_free_and_unarmed_gauges_are_none(self):
+        assert telemetry.record_e2e(1_000, "commit") is None
+        telemetry.enable()
+        assert telemetry.e2e_gauges() is None  # v2 byte-compat shape
+
+    def test_e2e_block_rides_ledger_and_stream(self, tmp_path):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.scope("q1"):
+            telemetry.record_e2e(1_000, "compute")
+        telemetry.record_e2e(1_000, "commit")
+        telemetry.maybe_flush_stream(force=True)
+        ledger = str(tmp_path / "ledger.json")
+        telemetry.write_ledger(ledger, capture_costs=False)
+        telemetry.disable()
+        with open(ledger) as f:
+            doc = json.load(f)
+        assert doc["ledger_version"] == 3
+        block = doc["snapshot"]["e2e"]
+        assert block["stages"]["commit"]["count"] == 1
+        assert block["nodes"]["q1"]["compute"]["count"] == 1
+        recs, _tail = stream_mod.read_records(stream)
+        cks = [r for r in recs if r.get("t") == "checkpoint"]
+        assert cks and "e2e" in cks[-1]["snapshot"]
+
+
+# -- the flight recorder ------------------------------------------------------
+
+
+class TestBlackbox:
+    def test_seal_dumps_a_parseable_blackbox(self, tmp_path):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval", events=3):
+            pass
+        telemetry.record_e2e(1_000, "commit")
+        telemetry.seal_stream("test_seal")
+        telemetry.disable()
+        dump = stream + ".blackbox.json"
+        with open(dump) as f:
+            doc = json.load(f)
+        assert doc["blackbox_version"] == 1
+        assert doc["reason"] == "test_seal"
+        assert doc["stream"] == stream
+        kinds = {r["t"] for r in doc["ring"]}
+        assert "window" in kinds  # the ring kept the window summary
+        assert doc["counters"]["events"] >= 1
+        assert doc["e2e"]["stages"]["commit"]["count"] == 1
+        # The marker instant landed in the stream's final span batch.
+        recs, _tail = stream_mod.read_records(stream)
+        names = [e.get("name") for r in recs if r.get("t") == "spans"
+                 for e in r.get("events") or []]
+        assert "blackbox_dumped" in names
+
+    def test_env_zero_disables_the_ring(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SFT_BLACKBOX", "0")
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval"):
+            pass
+        telemetry.seal_stream("test_seal")
+        telemetry.disable()
+        assert not os.path.exists(stream + ".blackbox.json")
+
+    def test_blackbox_cli_renders_and_rejects(self, tmp_path, capsys):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval", events=3):
+            pass
+        telemetry.seal_stream("test_seal")
+        telemetry.disable()
+        dump = stream + ".blackbox.json"
+        assert sfprof_main(["blackbox", dump]) == 0
+        out = capsys.readouterr().out
+        assert "reason=test_seal" in out or "test_seal" in out
+        assert sfprof_main(["blackbox", dump, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["blackbox_version"] == 1
+        bogus = str(tmp_path / "bogus.json")
+        with open(bogus, "w") as f:
+            f.write("[1, 2]\n")
+        assert sfprof_main(["blackbox", bogus]) == 2
+        capsys.readouterr()
+
+    def test_recover_folds_ring_instants_newer_than_the_stream(
+            self, tmp_path, capsys):
+        """Kill -9 between flushes: the ring holds instants the stream
+        never got — ``recover`` folds exactly those (ts newer than the
+        last flushed batch), marked ``blackbox: true`` for provenance,
+        and the CLI prints the fold as evidence."""
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval", events=2):
+            pass
+        telemetry.maybe_flush_stream(force=True)
+        # After the last flush: buffered + ringed, never streamed.
+        telemetry.emit_instant("fault_fired:test.point", kind="raise")
+        data = open(stream, "rb").read()
+        assert telemetry.dump_blackbox("test_crash") is not None
+        bb = open(stream + ".blackbox.json", "rb").read()
+        telemetry.disable()
+
+        crash = str(tmp_path / "crash.jsonl")
+        with open(crash, "wb") as f:
+            f.write(data)  # the unsealed prefix, the kill -9 shape
+        with open(crash + ".blackbox.json", "wb") as f:
+            f.write(bb)
+        doc, info = stream_mod.recover(crash)
+        assert info["blackbox_folded"] is True
+        assert info["blackbox_reason"] == "test_crash"
+        assert info["blackbox_events_folded"] >= 1
+        folded = [e for e in doc["events"] if e.get("blackbox")]
+        assert any(e["name"] == "fault_fired:test.point" for e in folded)
+        # Already-flushed ring records are NOT duplicated.
+        names = [e.get("name") for e in doc["events"]]
+        assert names.count("fault_fired:test.point") == 1
+        assert sfprof_main(["recover", crash]) == 0
+        out = capsys.readouterr().out
+        assert "blackbox dump folded" in out
+        assert "test_crash" in out
+
+    def test_recover_without_dump_is_unchanged(self, tmp_path):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval"):
+            pass
+        telemetry.maybe_flush_stream(force=True)
+        # No .blackbox.json beside a COPY of the stream.
+        data = open(stream, "rb").read()
+        telemetry.disable()
+        bare = str(tmp_path / "bare.jsonl")
+        with open(bare, "wb") as f:
+            f.write(data)
+        doc, info = stream_mod.recover(bare)
+        assert info["blackbox_folded"] is False
+        assert not any(e.get("blackbox") for e in doc["events"])
+
+
+# -- sfprof critical ----------------------------------------------------------
+
+
+def _span(name, ts, dur, args=None):
+    return {"name": name, "cat": "telemetry", "ph": "X", "ts": ts,
+            "dur": dur, "pid": 1, "tid": 1, "args": args or {}}
+
+
+def _synthetic_ledger(tmp_path, e2e_commit_p99_ms):
+    """Three windows; node.b (300 us) always dominates node.a (100 us).
+    Path p99 = 400 us = 0.4 ms."""
+    events = []
+    t = 0
+    for _ in range(3):
+        events.append(_span("window.dag", t, 450))
+        events.append(_span("node.a", t + 10, 100, {"node": "a"}))
+        events.append(_span("node.b", t + 120, 300, {"node": "b"}))
+        t += 1_000
+    doc = {
+        "ledger_version": 3, "created_unix": 0.0,
+        "snapshot": {"e2e": {"stages": {"commit": {
+            "count": 3, "sum_ms": 1.0,
+            "p50_ms": e2e_commit_p99_ms, "p99_ms": e2e_commit_p99_ms,
+        }}}},
+        "events": events,
+    }
+    path = str(tmp_path / "ledger.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path, doc, events
+
+
+class TestCritical:
+    def test_straggler_and_conservation_ok(self, tmp_path, capsys):
+        path, doc, events = _synthetic_ledger(tmp_path, 0.5)
+        res = critical_mod.analyze(doc, events)
+        assert res["windows"] == 3
+        assert res["stragglers"]["p99"]["node"] == "b"
+        assert res["stragglers"]["p50"]["node"] == "b"
+        assert res["nodes"]["b"]["share"] > res["nodes"]["a"]["share"]
+        cons = res["conservation"]
+        assert cons["ok"] is True
+        assert cons["path_p99_ms"] == pytest.approx(0.4)
+        assert cons["e2e_commit_p99_ms"] == 0.5
+        assert sfprof_main(["critical", path]) == 0
+        out = capsys.readouterr().out
+        assert "straggler @p99: b" in out
+        assert "conservation receipt [ok]" in out
+        assert "↳" in out  # evidence chain, not a bare verdict
+
+    def test_conservation_fail_exits_one(self, tmp_path, capsys):
+        # e2e commit p99 SMALLER than the path sum: the span graph and
+        # the lineage clocks disagree — exit 1, loud evidence.
+        path, _doc, _events = _synthetic_ledger(tmp_path, 0.1)
+        assert sfprof_main(["critical", path]) == 1
+        out = capsys.readouterr().out
+        assert "conservation receipt [FAIL]" in out
+        assert "DISAGREE" in out
+
+    def test_missing_signals_are_notes_not_failures(self, tmp_path,
+                                                    capsys):
+        path = str(tmp_path / "empty.json")
+        with open(path, "w") as f:
+            json.dump({"ledger_version": 3, "snapshot": {},
+                       "events": []}, f)
+        assert sfprof_main(["critical", path]) == 0
+        assert "note:" in capsys.readouterr().out
+        assert sfprof_main(["critical",
+                            str(tmp_path / "nope.json")]) == 2
+        capsys.readouterr()
+
+    def test_json_mode_round_trips(self, tmp_path, capsys):
+        path, _doc, _events = _synthetic_ledger(tmp_path, 0.5)
+        assert sfprof_main(["critical", path, "--json"]) == 0
+        res = json.loads(capsys.readouterr().out)
+        assert res["stragglers"]["p99"]["node"] == "b"
+        assert res["conservation"]["ok"] is True
+
+    def test_straggler_line_falls_back_to_e2e_nodes(self):
+        doc = {"snapshot": {"e2e": {"nodes": {
+            "q1": {"compute": {"p99_ms": 2.0}},
+            "q2": {"compute": {"p99_ms": 9.0}},
+        }}}}
+        line = critical_mod.straggler_line(doc, [])
+        assert line is not None and "q2" in line
+
+    def test_critical_on_a_real_sncb_dag_capture(self, tmp_path,
+                                                 capsys):
+        """The acceptance criterion: a real 7-node SNCB DAG capture
+        names a straggler and its conservation receipt holds — path
+        segments sum ≤ the measured e2e commit p99."""
+        telemetry.enable()
+        dag = build_sncb_dag(
+            str(tmp_path / "egress"), qserve_queries=None,
+            retry=RetryPolicy(max_retries=1, backoff_s=0.0),
+        )
+        driver = WindowedDataflowDriver(
+            checkpoint_path=str(tmp_path / "ckpt.bin"),
+            checkpoint_every=2, sink=None,
+            retry=RetryPolicy(max_retries=1, backoff_s=0.0),
+            failover=False,
+        )
+        for _ in dag.run(_toy_sncb_stream(150)(), driver=driver):
+            pass
+        ledger = str(tmp_path / "ledger.json")
+        telemetry.write_ledger(ledger, capture_costs=False)
+        telemetry.disable()
+        assert sfprof_main(["critical", ledger]) == 0
+        out = capsys.readouterr().out
+        assert "straggler @p99:" in out
+        assert "conservation receipt [ok]" in out
+        with open(ledger) as f:
+            doc = json.load(f)
+        res = critical_mod.analyze(doc, doc["events"])
+        assert res["windows"] > 0
+        assert set(res["nodes"]) >= {"q1", "staytime"}
+        cons = res["conservation"]
+        assert cons is not None and cons["ok"] is True
+        assert cons["path_p99_ms"] <= cons["e2e_commit_p99_ms"]
+
+
+# -- the live follower --------------------------------------------------------
+
+
+class TestLiveE2E:
+    def test_live_json_carries_e2e_and_straggler(self, tmp_path,
+                                                 capsys):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.scope("q1"):
+            telemetry.record_e2e(1_000, "compute")
+        telemetry.record_e2e(1_000, "commit")
+        telemetry.maybe_flush_stream(force=True)
+        telemetry.disable()  # seals
+        assert live_mod.follow(stream, 0.05, None, json_mode=True) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["e2e"]["stages"]["commit"]["count"] == 1
+        assert doc["straggler"]["node"] == "q1"
+        assert isinstance(doc["straggler"]["e2e_compute_p99_ms"], float)
+        # Human mode prints the e2e head + straggler line per checkpoint.
+        assert live_mod.follow(stream, 0.05, 5.0, json_mode=False) == 0
+        out = capsys.readouterr().out
+        assert "e2e p99" in out
+        assert "straggler: q1" in out
+
+    def test_live_without_e2e_has_null_straggler(self, tmp_path,
+                                                 capsys):
+        stream = str(tmp_path / "s.jsonl")
+        telemetry.enable(stream_path=stream)
+        with telemetry.span("window.eval"):
+            pass
+        telemetry.maybe_flush_stream(force=True)
+        telemetry.disable()
+        assert live_mod.follow(stream, 0.05, None, json_mode=True) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["e2e"] is None and doc["straggler"] is None

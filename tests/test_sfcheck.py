@@ -567,10 +567,11 @@ def test_env_registry_fixture_repo():
 
 def test_contract_twin_fixture_repo():
     bad = _mini_repo("contract_twin_bad", "contract-twin")
-    assert len(bad) == 8, "\n".join(f.format() for f in bad)
+    assert len(bad) == 9, "\n".join(f.format() for f in bad)
     msgs = "\n".join(f.message for f in bad)
-    # spec-field drift, both directions
+    # spec-field drift, both directions (incl. the e2e lineage ceiling)
     assert "declares field `extra_live_only`" in msgs
+    assert "declares field `e2e_p99_ms`" in msgs
     assert "lists `mirror_only`" in msgs
     # version pin drift
     assert "version twin drift" in msgs

@@ -288,3 +288,87 @@ def test_driver_budgets_live_and_posthoc_twin():
         assert srows[0][3] is False
     finally:
         telemetry.disable()
+
+
+# -- latency lineage (e2e ceilings) -------------------------------------------
+
+
+def test_e2e_ceiling_live_warmup_grace_then_silence_fails():
+    """ISSUE 19: e2e_p50/p99_ms read the telemetry commit-stage lineage
+    percentiles. During warm-up the check is skipped (the eps_floor
+    grace — no window has had a chance to commit); past warm-up a run
+    that never stamped a commit leaves the ceiling unanswerable and
+    silence FAILS."""
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(e2e_p99_ms=1e9, warmup_windows=2))
+    eng.observe_window(10)
+    assert all(r["check"] != "e2e_p99_ms" for r in eng.evaluate())
+    for _ in range(3):  # past warm-up now, still no commit stamp
+        eng.observe_window(10)
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert rows["e2e_p99_ms"]["ok"] is False
+    assert rows["e2e_p99_ms"]["value"] is None
+
+
+def test_e2e_ceiling_live_pass_and_deterministic_violation():
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(e2e_p99_ms=1e9))
+    eng.observe_window(10)
+    # Anchor the lineage clock at event-time 10_000 ms, then commit a
+    # window whose event time is 10 s in the PAST: its anchored
+    # staleness is ≈10 s regardless of wall speed — deterministic.
+    telemetry.record_e2e(10_000, "commit")
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert rows["e2e_p99_ms"]["ok"] is True  # huge ceiling clears
+    telemetry.record_e2e(0, "commit")
+    eng2 = slo.SloEngine(_spec(e2e_p99_ms=1_000))
+    eng2.observe_window(10)
+    rows = {r["check"]: r for r in eng2.evaluate()}
+    assert rows["e2e_p99_ms"]["ok"] is False
+    assert rows["e2e_p99_ms"]["value"] >= 9_000.0
+
+
+def test_node_e2e_budget_silence_fails_after_warmup():
+    """node_budgets e2e keys: no DAG installed → unanswerable → FAIL
+    past warm-up; skipped (not failed) during warm-up."""
+    telemetry.enable()
+    eng = slo.SloEngine(_spec(
+        node_budgets={"q1": {"e2e_p99_ms": 5}}, warmup_windows=1))
+    eng.observe_window(10)
+    assert all(not r["check"].startswith("node_e2e")
+               for r in eng.evaluate())
+    eng.observe_window(10)  # past warm-up
+    rows = {r["check"]: r for r in eng.evaluate()}
+    assert rows["node_e2e_p99_ms:q1"]["ok"] is False
+    assert rows["node_e2e_p99_ms:q1"]["value"] is None
+
+
+def test_e2e_spec_parses_and_posthoc_twin_matches():
+    """The same spec keys round-trip from_dict (NODE_BUDGET_KEYS knows
+    the e2e ceilings) and the post-hoc twin reads the ledger's
+    snapshot.e2e.stages.commit / dag.nodes.<n>.e2e_p99_ms — silence
+    fails on both surfaces."""
+    sp = slo.SloSpec.from_dict({
+        "e2e_p50_ms": 50.0, "e2e_p99_ms": 200.0,
+        "node_budgets": {"q1": {"e2e_p99_ms": 5}},
+    })
+    assert sp.e2e_p50_ms == 50.0 and sp.e2e_p99_ms == 200.0
+    with pytest.raises(ValueError):
+        slo.SloSpec.from_dict({"node_budgets": {"q1": {"e2e_p99_mss": 5}}})
+
+    doc = {"snapshot": {
+        "e2e": {"stages": {"commit": {"p50_ms": 10.0, "p99_ms": 100.0,
+                                      "count": 4, "sum_ms": 40.0}}},
+        "dag": {"nodes": {"q1": {"e2e_p99_ms": 3.0}}},
+    }, "bench": {}}
+    spec = {"e2e_p50_ms": 50.0, "e2e_p99_ms": 50.0,
+            "node_budgets": {"q1": {"e2e_p99_ms": 5}}}
+    rows = {r[0]: r for r in sfprof_slo.evaluate(spec, doc)}
+    assert rows["slo:e2e_p50_ms"][3] is True      # 10 <= 50
+    assert rows["slo:e2e_p99_ms"][3] is False     # 100 > 50
+    assert rows["slo:node_e2e_p99_ms:q1"][3] is True
+    # Silence fails: no e2e block, no dag block.
+    srows = {r[0]: r for r in sfprof_slo.evaluate(
+        spec, {"snapshot": {}, "bench": {}})}
+    assert srows["slo:e2e_p99_ms"][3] is False
+    assert srows["slo:node_e2e_p99_ms:q1"][3] is False

@@ -38,6 +38,7 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from tools.sfprof import attribution
+from tools.sfprof import critical as critical_mod
 from tools.sfprof import events as events_mod
 from tools.sfprof import ledger as ledger_mod
 from tools.sfprof import live as live_mod
@@ -327,6 +328,11 @@ def cmd_report(args) -> int:
         print(f"{float(_ms(g['gap_us'])):10.3f} ms  after {g['after']} "
               f"→ before {g['before']}")
 
+    # One-line straggler verdict (critical.py has the full path walk).
+    sline = critical_mod.straggler_line(doc, events)
+    if sline is not None:
+        print(f"\n{sline}")
+
     _print_roofline(bound)
     return 0
 
@@ -455,6 +461,9 @@ def _report_json(args, doc, events, bound) -> int:
         taint = trend_mod.taint_of(doc)
         if taint is not None:
             out["tainted"] = taint
+        if snap.get("e2e"):
+            out["e2e"] = snap["e2e"]
+    out["straggler"] = critical_mod.straggler_line(doc, events)
     print(json.dumps(out, allow_nan=False))
     return 0
 
@@ -748,6 +757,7 @@ def cmd_health(args) -> int:
     failed = sum(0 if ok else 1 for _n, _v, _b, ok in checks)
     bound = roofline_mod.classify(doc, doc.get("events") or [])
     taint = trend_mod.taint_of(doc)
+    sline = critical_mod.straggler_line(doc, doc.get("events") or [])
     if args.json:
         print(json.dumps({
             "ledger": args.ledger,
@@ -777,6 +787,8 @@ def cmd_health(args) -> int:
                     snap.get("collectives") or {}),
                 "instant_events": events_mod.notable_event_counts(
                     doc.get("events") or []),
+                "e2e": snap.get("e2e") or {},
+                "straggler": sline,
             },
         }, allow_nan=False))
         return 1 if failed else 0
@@ -790,6 +802,14 @@ def cmd_health(args) -> int:
     print(f"bound: {bound['verdict']}{dom}")
     for line in bound.get("evidence") or []:
         print(f"  ↳ {line}")
+    if sline is not None:
+        print(f"note {sline}")
+    commit = ((snap.get("e2e") or {}).get("stages") or {}).get("commit")
+    if commit:
+        print(f"note e2e commit latency: "
+              f"p50 {float(commit.get('p50_ms') or 0):.1f} ms  "
+              f"p99 {float(commit.get('p99_ms') or 0):.1f} ms over "
+              f"{int(commit.get('count') or 0)} committed window(s)")
     if taint is not None:
         print(f"note TAINTED capture: {taint.get('kind', '?')} "
               f"(kernels={','.join(taint.get('kernels') or []) or '-'})"
@@ -962,6 +982,12 @@ def cmd_recover(args) -> int:
               + ", ".join(info["nodes_recovered"])
               + f" (collective bytes "
               f"{int(info.get('collective_bytes_recovered') or 0)})")
+    if info.get("blackbox_folded"):
+        print(f"blackbox dump folded: {info['blackbox_path']}")
+        print(f"  ↳ dump reason: {info['blackbox_reason']}; "
+              f"{int(info.get('blackbox_events_folded') or 0)} ring "
+              "instant(s) newer than the last flushed span batch "
+              "folded into the event list")
     # The crash story, by registered event name (events.py): what the
     # recovered run was doing when it died — sheds, circuit flips,
     # fault firings — without grepping the stream by hand.
@@ -976,6 +1002,65 @@ def cmd_recover(args) -> int:
     print(f"recovered ledger {'INVALID' if problems else 'valid'} "
           f"({len(problems)} schema problems)")
     return 1 if problems else 0
+
+
+# -- blackbox -----------------------------------------------------------------
+
+
+def cmd_blackbox(args) -> int:
+    """Render a ``<stream>.blackbox.json`` flight-recorder dump: the
+    dump reason, the counter gauges at death, the e2e block when
+    present, and the last-N ring of window summaries + instants —
+    newest last, timestamped relative to the dump's final entry."""
+    try:
+        with open(args.dump) as f:
+            bb = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"sfprof: cannot read {args.dump}: {e}")
+        return 2
+    if not isinstance(bb, dict) or "blackbox_version" not in bb:
+        print(f"sfprof: {args.dump}: not a blackbox dump "
+              "(no blackbox_version)")
+        return 2
+    if args.json:
+        print(json.dumps(bb, allow_nan=False))
+        return 0
+    print(f"== sfprof blackbox: {args.dump}")
+    print(f"blackbox v{int(bb.get('blackbox_version') or 0)}  "
+          f"reason: {bb.get('reason')}  "
+          f"unix {float(bb.get('unix') or 0):.3f}")
+    if bb.get("stream"):
+        print(f"stream: {bb['stream']}")
+    counters = bb.get("counters") or {}
+    if counters:
+        # fault_fires is a per-point dict, not a scalar — sum it for
+        # the one-line view (the full map survives in --json).
+        print("counters at dump: " + "  ".join(
+            f"{k}={_fmt_num(sum(v.values()) if isinstance(v, dict) else v)}"
+            for k, v in sorted(counters.items())))
+    commit = ((bb.get("e2e") or {}).get("stages") or {}).get("commit")
+    if commit:
+        print(f"e2e commit latency: "
+              f"p50 {float(commit.get('p50_ms') or 0):.1f} ms  "
+              f"p99 {float(commit.get('p99_ms') or 0):.1f} ms over "
+              f"{int(commit.get('count') or 0)} committed window(s)")
+    ring = [r for r in (bb.get("ring") or []) if isinstance(r, dict)]
+    print(f"ring: last {len(ring)} record(s), newest last")
+    last_ts = max((float(r.get("ts") or 0) for r in ring), default=0.0)
+    for rec in ring:
+        rel_s = (last_ts - float(rec.get("ts") or 0)) / 1e6
+        args_s = json.dumps(rec.get("args") or {}, sort_keys=True)
+        if len(args_s) > 100:
+            args_s = args_s[:97] + "..."
+        if rec.get("t") == "window":
+            print(f"  -{float(rel_s):9.3f}s  window  "
+                  f"{rec.get('name')}  "
+                  f"{float(float(rec.get('dur_us') or 0) / 1e3):.3f} ms"
+                  f"  {args_s}")
+        else:
+            print(f"  -{float(rel_s):9.3f}s  instant "
+                  f"{rec.get('name')}  {args_s}")
+    return 0
 
 
 # -- trend --------------------------------------------------------------------
@@ -1031,8 +1116,13 @@ def cmd_trend(args) -> int:
               f"MAD={float(row['mad']):.1f} "
               f"floor={float(row['floor']):.1f} "
               f"latest={float(row['latest']):.1f}")
-    for s in skipped:
-        print(f"skipped {s['source']}: {s['reason']}")
+    if skipped:
+        # Each skipped history record is evidence, not just a count —
+        # a trend built over silently-dropped captures reads as "the
+        # whole trajectory" when it is not.
+        print(f"skipped {len(skipped)} record(s):")
+        for s in skipped:
+            print(f"  ↳ {s['source']}: {s['reason']}")
     g = out["gate"]
     if g:
         print(f"== trend gate: {g['candidate']}")
@@ -1188,6 +1278,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output ledger path (default: "
                           "<stream>.recovered.json)")
     rec.set_defaults(fn=cmd_recover)
+
+    critical_mod.add_parser(sub)
+
+    bbx = sub.add_parser(
+        "blackbox", help="render a <stream>.blackbox.json flight-"
+                         "recorder dump: reason, counters at death, "
+                         "last-N window summaries + instants")
+    bbx.add_argument("dump")
+    bbx.add_argument("--json", action="store_true",
+                     help="print the dump document as one JSON line "
+                          "(validated; same exit code)")
+    bbx.set_defaults(fn=cmd_blackbox)
 
     live_mod.add_parser(sub)
 

@@ -1,0 +1,281 @@
+"""``sfprof critical`` — DAG critical-path attribution from a capture.
+
+The composed dataflow (spatialflink_tpu/dag.py) walks its seven nodes
+sequentially inside each ``window.dag`` span, wrapping every node's work
+in a ``node.<name>`` child span. That makes the per-window critical path
+reconstructable post hoc: the ordered node segments ARE the path, each
+node's duration is its segment, and whatever the segments do not cover
+is shared source/sink/commit residue. This module walks that span graph
+and answers the question the latency-lineage tentpole exists for: WHICH
+node is dragging end-to-end latency, with how much slack, and does the
+path arithmetic agree with the measured event-time e2e?
+
+Three verdict surfaces:
+
+- per-node segment stats (p50/p95/p99 duration, share of window time,
+  slack = window time spent OUTSIDE the node);
+- the straggler per percentile band — the node whose segment is largest
+  at p50/p95/p99 (tail stragglers and median stragglers are often
+  different nodes: a breaker-probing node owns the tail, the heaviest
+  kernel owns the median);
+- the conservation receipt: per-window path sums (Σ node segments) must
+  stay ≤ the measured e2e "commit" percentile from the snapshot ``e2e``
+  block — segments are a LOWER bound on lifecycle latency (e2e adds
+  event-time staleness at assembly plus the commit hop), so p99(path)
+  > p99(e2e) means the span graph and the lineage clocks disagree and
+  neither can be trusted. The receipt prints both sides with ``↳``
+  evidence instead of asserting silently.
+
+Everything derives from signals the ledger already carries (the sfprof
+no-cross-import rule: no jax, no spatialflink_tpu import). Exit codes:
+0 — analysis printed (including "no node spans" notes); 1 — the
+conservation receipt FAILED; 2 — unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from tools.sfprof import attribution
+from tools.sfprof import ledger as ledger_mod
+
+#: Percentile bands the straggler verdict names (fixed — tests pin it).
+BANDS: Tuple[Tuple[float, str], ...] = (
+    (0.50, "p50"), (0.95, "p95"), (0.99, "p99"),
+)
+
+
+def _percentile(sorted_vals: List[float], p: float) -> Optional[float]:
+    """Nearest-rank percentile rounding UP — the same safe direction as
+    telemetry's FixedBucketLatency, so receipts never flatter the tail."""
+    n = len(sorted_vals)
+    if not n:
+        return None
+    k = min(max(int(math.ceil(p * n)) - 1, 0), n - 1)
+    return float(sorted_vals[k])
+
+
+def window_paths(events: List[dict]) -> List[dict]:
+    """Per-window path rows from the span graph: for each ``window.dag``
+    container (falling back to ALL ``window.*`` containers when no DAG
+    span exists — single-operator captures still get a one-segment
+    path), the ordered ``node.*`` segments inside it.
+
+    Row shape: ``{"ts", "dur_us", "segments": [(node, us), ...],
+    "path_us", "slack_us"}`` — ``path_us`` = Σ segments, ``slack_us`` =
+    container dur − path (shared source/sink/commit residue)."""
+    spans = attribution.complete_spans(events)
+    have_dag = any(str(e.get("name", "")) == "window.dag" for e in spans)
+    rows: List[dict] = []
+    for _tid, evs in attribution._by_thread(spans).items():
+        if have_dag:
+            conts = [e for e in evs
+                     if str(e.get("name", "")) == "window.dag"]
+        else:
+            conts = [e for e in evs
+                     if str(e.get("name", "")).startswith("window.")]
+        nodes = [e for e in evs
+                 if str(e.get("name", "")).startswith("node.")]
+        for c in conts:
+            c_end = c["ts"] + c["dur"]
+            inside = sorted(
+                (e for e in nodes
+                 if e["ts"] >= c["ts"] - attribution._FLOOR_SLACK_US
+                 and e["ts"] + e["dur"]
+                 <= c_end + attribution._FLOOR_SLACK_US),
+                key=lambda e: e["ts"],
+            )
+            segments: List[Tuple[str, int]] = []
+            for e in inside:
+                args = e.get("args") or {}
+                name = str(args.get("node")
+                           or str(e.get("name", ""))[len("node."):])
+                segments.append((name, int(e["dur"])))
+            path_us = sum(us for _n, us in segments)
+            rows.append({
+                "ts": c["ts"],
+                "dur_us": int(c["dur"]),
+                "segments": segments,
+                "path_us": int(path_us),
+                "slack_us": max(int(c["dur"]) - int(path_us), 0),
+            })
+    rows.sort(key=lambda r: r["ts"])
+    return rows
+
+
+def analyze(doc: Optional[Dict[str, Any]],
+            events: List[dict]) -> Dict[str, Any]:
+    """The full critical-path block (JSON-safe): per-node stats,
+    straggler per band, conservation receipt against the snapshot
+    ``e2e`` block. Never raises on missing data — absent signals become
+    ``notes`` entries (the roofline "no gauge" idiom)."""
+    snap = (doc or {}).get("snapshot") or {}
+    rows = window_paths(events)
+    notes: List[str] = []
+    out: Dict[str, Any] = {
+        "windows": len(rows), "nodes": {}, "stragglers": {},
+        "conservation": None, "notes": notes,
+    }
+    if not rows:
+        notes.append(
+            "no window.* container spans in the event stream — run with "
+            "telemetry enabled (a DAG capture emits window.dag spans)")
+        return out
+    durs: Dict[str, List[float]] = {}
+    totals: Dict[str, float] = {}
+    for r in rows:
+        for name, us in r["segments"]:
+            durs.setdefault(name, []).append(float(us))
+            totals[name] = totals.get(name, 0.0) + float(us)
+    if not durs:
+        notes.append(
+            "window spans carry no node.* child spans — not a composed-"
+            "DAG capture; per-node critical path needs dag.py's "
+            "node.<name> span convention")
+    window_total = float(sum(r["dur_us"] for r in rows))
+    node_stats: Dict[str, dict] = {}
+    for name, vals in durs.items():
+        vals_sorted = sorted(vals)
+        st = {
+            "windows": len(vals),
+            "total_us": float(totals[name]),
+            "share": (totals[name] / window_total
+                      if window_total else 0.0),
+            # Slack: window time spent OUTSIDE this node — how much the
+            # node could grow before it alone owned the window.
+            "slack_us": float(window_total - totals[name]),
+        }
+        for p, label in BANDS:
+            st[f"{label}_us"] = _percentile(vals_sorted, p)
+        node_stats[name] = st
+    out["nodes"] = node_stats
+
+    for p, label in BANDS:
+        best: Optional[Tuple[str, float]] = None
+        for name, st in node_stats.items():
+            v = st.get(f"{label}_us")
+            if v is not None and (best is None or v > best[1]):
+                best = (name, v)
+        if best is not None:
+            out["stragglers"][label] = {
+                "node": best[0], "segment_us": float(best[1]),
+            }
+
+    # -- conservation receipt: Σ segments vs measured e2e -------------------
+    path_sums = sorted(float(r["path_us"]) for r in rows)
+    p99_path_us = _percentile(path_sums, 0.99)
+    commit = ((snap.get("e2e") or {}).get("stages") or {}).get("commit")
+    e2e_p99 = (commit or {}).get("p99_ms")
+    if p99_path_us is None:
+        notes.append("no path sums — conservation receipt unavailable")
+    elif not isinstance(e2e_p99, (int, float)):
+        notes.append(
+            "ledger snapshot carries no e2e block (pre-v3 capture or "
+            "telemetry never stamped a commit) — conservation receipt "
+            "unavailable; path stats above are span-graph-only")
+    else:
+        commit_n = int((commit or {}).get("count") or 0)
+        ok = (p99_path_us / 1e3) <= float(e2e_p99)
+        out["conservation"] = {
+            "ok": bool(ok),
+            "path_p99_ms": float(p99_path_us / 1e3),
+            "e2e_commit_p99_ms": float(e2e_p99),
+            "traced_windows": len(rows),
+            "committed_windows": commit_n,
+        }
+    return out
+
+
+def straggler_line(doc: Optional[Dict[str, Any]],
+                   events: List[dict]) -> Optional[str]:
+    """The one-line straggler verdict ``report``/``health`` print (None
+    when the capture has neither node spans nor a per-node e2e block)."""
+    res = analyze(doc, events)
+    tail = res["stragglers"].get("p99")
+    if tail is not None:
+        med = res["stragglers"].get("p50")
+        med_s = (f", median straggler {med['node']}"
+                 if med and med["node"] != tail["node"] else "")
+        return (f"straggler: {tail['node']} owns the p99 window tail "
+                f"({float(tail['segment_us'] / 1e3):.3f} ms segment "
+                f"across {len(res['nodes'])} node(s){med_s})")
+    # Span-free fallback: the snapshot e2e per-node "compute" stage.
+    e2e_nodes = (((doc or {}).get("snapshot") or {})
+                 .get("e2e") or {}).get("nodes") or {}
+    best: Optional[Tuple[str, float]] = None
+    for name, stages in e2e_nodes.items():
+        p99 = ((stages or {}).get("compute") or {}).get("p99_ms")
+        if isinstance(p99, (int, float)) \
+                and (best is None or p99 > best[1]):
+            best = (name, float(p99))
+    if best is not None:
+        return (f"straggler: {best[0]} has the worst per-node e2e "
+                f"(compute p99 {float(best[1]):.1f} ms, "
+                f"{len(e2e_nodes)} node(s))")
+    return None
+
+
+def render(path: str, res: Dict[str, Any]) -> None:
+    print(f"== sfprof critical: {path}")
+    print(f"{int(res['windows'])} traced window(s), "
+          f"{len(res['nodes'])} node(s) on the path")
+    for name, st in sorted(res["nodes"].items(),
+                           key=lambda kv: -kv[1]["total_us"]):
+        print(f"{name:<16} share {float(100.0 * st['share']):5.1f}%  "
+              f"p50 {float((st['p50_us'] or 0) / 1e3):8.3f} ms  "
+              f"p95 {float((st['p95_us'] or 0) / 1e3):8.3f} ms  "
+              f"p99 {float((st['p99_us'] or 0) / 1e3):8.3f} ms  "
+              f"slack {float(st['slack_us'] / 1e3):8.3f} ms")
+    for _p, label in BANDS:
+        s = res["stragglers"].get(label)
+        if s is not None:
+            print(f"straggler @{label}: {s['node']}")
+            print(f"  ↳ largest {label} segment "
+                  f"{float(s['segment_us'] / 1e3):.3f} ms over "
+                  f"{int(res['windows'])} traced window(s)")
+    cons = res.get("conservation")
+    if cons is not None:
+        mark = "ok" if cons["ok"] else "FAIL"
+        print(f"conservation receipt [{mark}]: p99(Σ path segments) "
+              f"{float(cons['path_p99_ms']):.3f} ms <= measured e2e "
+              f"commit p99 {float(cons['e2e_commit_p99_ms']):.3f} ms")
+        print(f"  ↳ path segments are a lower bound on lifecycle "
+              f"latency (e2e adds event-time staleness at assembly + "
+              f"the commit hop); {int(cons['traced_windows'])} traced "
+              f"vs {int(cons['committed_windows'])} committed window(s)")
+        if not cons["ok"]:
+            print("  ↳ span graph and lineage clocks DISAGREE — "
+                  "neither side of this capture can be trusted")
+    for note in res.get("notes") or []:
+        print(f"note: {note}")
+
+
+def cmd_critical(args) -> int:
+    try:
+        doc, events = ledger_mod.load_any(args.path)
+    except (OSError, ValueError) as e:
+        print(f"sfprof: cannot read {args.path}: {e}")
+        return 2
+    res = analyze(doc, events)
+    if args.json:
+        print(json.dumps(res, allow_nan=False))
+    else:
+        render(args.path, res)
+    cons = res.get("conservation")
+    return 1 if (cons is not None and not cons["ok"]) else 0
+
+
+def add_parser(sub) -> None:
+    """Register the ``critical`` subcommand on the sfprof CLI."""
+    cri = sub.add_parser(
+        "critical", help="per-window critical path across the DAG's "
+                         "node.* spans: per-node slack, straggler per "
+                         "percentile band, conservation receipt vs the "
+                         "measured e2e block")
+    cri.add_argument("path", help="ledger, recovered ledger, or trace")
+    cri.add_argument("--json", action="store_true",
+                     help="one machine-readable JSON document "
+                          "(same exit code)")
+    cri.set_defaults(fn=cmd_critical)

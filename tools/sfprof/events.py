@@ -53,6 +53,10 @@ INSTANT_EVENTS = frozenset({
     "qserve_registered",
     "qserve_unregistered",
     "qserve_evicted",
+    # flight recorder (telemetry.py): a <stream>.blackbox.json dump was
+    # written — on fault fire / stream seal; `sfprof blackbox` renders
+    # it and `recover` folds it into the rebuilt ledger
+    "blackbox_dumped",
 })
 
 #: Literal name prefixes for parameterized events (the suffix names the
@@ -87,6 +91,7 @@ _GROUPS = (
     ("pipeline", ("pipeline_collapsed", "pipeline_resumed")),
     ("slo", ("slo_violation:", "slo_recovered:")),
     ("ablation", ("ablation_armed",)),
+    ("blackbox", ("blackbox_dumped",)),
 )
 
 

@@ -29,12 +29,13 @@ from typing import Any, Dict, List, Optional, Tuple
 # configures jax). Bump BOTH constants together; the cross-pin lives in
 # tests/test_sfprof.py (ledger schema test writes with the telemetry
 # constant and validates with this one).
-LEDGER_VERSION = 2
+LEDGER_VERSION = 3
 
 # Versions this reader still accepts: v1 documents predate the per-node
-# attribution / collective blocks (both additive), and the trend gate's
-# history is full of them — rejecting v1 would orphan every trajectory.
-SUPPORTED_LEDGER_VERSIONS = (1, 2)
+# attribution / collective blocks, v2 predates the e2e latency-lineage
+# block (all additive), and the trend gate's history is full of them —
+# rejecting old versions would orphan every trajectory.
+SUPPORTED_LEDGER_VERSIONS = (1, 2, 3)
 
 REQUIRED_BLOCKS: Tuple[Tuple[str, type], ...] = (
     ("ledger_version", int),

@@ -39,6 +39,19 @@ def _i(v, default=0) -> int:
         and not isinstance(v, bool) else int(default)
 
 
+def _e2e_straggler(e2e: Dict[str, Any]):
+    """(node, p99_ms) with the worst per-node e2e "compute" stage from
+    a checkpoint's ``snapshot.e2e`` block, or None — the live twin of
+    ``critical.straggler_line``'s span-free fallback."""
+    best = None
+    for name, stages in (e2e.get("nodes") or {}).items():
+        p99 = ((stages or {}).get("compute") or {}).get("p99_ms")
+        if isinstance(p99, (int, float)) and not isinstance(p99, bool) \
+                and (best is None or p99 > best[1]):
+            best = (str(name), float(p99))
+    return best
+
+
 def _node_eps(rec: Dict[str, Any]) -> Optional[float]:
     """Events/s of one telemetry per-node bucket (span-time based)."""
     span_us = _f((rec or {}).get("span_us"))
@@ -70,7 +83,16 @@ def _checkpoint_lines(rec: Dict[str, Any]) -> List[str]:
     coll = snap.get("collectives") or {}
     if coll:
         head += f"  collective {_i(coll.get('bytes'))} B"
+    e2e = snap.get("e2e") or {}
+    commit = (e2e.get("stages") or {}).get("commit") or {}
+    if commit:
+        head += (f"  e2e p99 "
+                 f"{float(_f(commit.get('p99_ms'))):.1f} ms")
     out.append(head)
+    strag = _e2e_straggler(e2e)
+    if strag is not None:
+        out.append(f"  straggler: {strag[0]} "
+                   f"(e2e compute p99 {float(strag[1]):.1f} ms)")
 
     dag_nodes = (snap.get("dag") or {}).get("nodes") or {}
     acct_nodes = snap.get("nodes") or {}
@@ -135,6 +157,7 @@ def _summary(records: List[dict],
         elif rec.get("t") == "epilogue":
             epilogue = rec
     snap = (checkpoint or {}).get("snapshot") or {}
+    strag = _e2e_straggler(snap.get("e2e") or {})
     nodes = {}
     for name, a in (snap.get("nodes") or {}).items():
         d = ((snap.get("dag") or {}).get("nodes") or {}).get(name) or {}
@@ -168,6 +191,11 @@ def _summary(records: List[dict],
         },
         "pipeline_collapses": _i((snap.get("pipeline") or {})
                                  .get("collapses")),
+        "e2e": snap.get("e2e"),
+        "straggler": (
+            {"node": strag[0], "e2e_compute_p99_ms": float(strag[1])}
+            if strag is not None else None
+        ),
         "instant_counts": dict(sorted(counts.items())),
     }
 

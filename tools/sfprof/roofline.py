@@ -241,6 +241,52 @@ def classify(doc: Optional[Dict[str, Any]], events: List[dict],
     winner = max(candidates, key=lambda k: candidates[k])
     dominant = candidates[winner] >= DOMINANCE_FRAC
 
+    # -- e2e lineage: per-stage deltas sharpen link vs dispatch -------------
+    # The v3 snapshot's e2e stage buckets are CUMULATIVE lifecycle
+    # latencies (assemble ⊆ ship ⊆ compute ⊆ fetch), so count-weighted
+    # mean DELTAS split a window's life into transfer (ship + fetch
+    # hops) vs device work (compute) — an independent clock on the same
+    # question the span fractions answer, used as evidence always and
+    # as the tiebreak when link and dispatch are within 10% of wall.
+    def _stage_mean(stage_name: str) -> Optional[float]:
+        st = ((snap.get("e2e") or {}).get("stages") or {}) \
+            .get(stage_name) or {}
+        s, n = st.get("sum_ms"), st.get("count")
+        if isinstance(s, (int, float)) and isinstance(n, (int, float)) \
+                and n:
+            return float(s) / float(n)
+        return None
+
+    mean_asm = _stage_mean("assemble")
+    mean_ship = _stage_mean("ship")
+    mean_comp = _stage_mean("compute")
+    mean_fetch = _stage_mean("fetch")
+    if mean_ship is not None and mean_comp is not None:
+        transfer_ms = max(mean_ship - (mean_asm or 0.0), 0.0)
+        if mean_fetch is not None:
+            transfer_ms += max(mean_fetch - mean_comp, 0.0)
+        device_ms = max(mean_comp - mean_ship, 0.0)
+        evidence.append(
+            f"e2e lineage: mean per-window stage deltas — transfer "
+            f"(ship+fetch hops) ≈ {float(transfer_ms):.2f} ms vs "
+            f"device (compute) ≈ {float(device_ms):.2f} ms "
+            "(cumulative stage buckets, count-weighted means)"
+        )
+        if ("link" in candidates and "dispatch" in candidates
+                and winner in ("link", "dispatch")
+                and abs(candidates["link"]
+                        - candidates["dispatch"]) < 0.1
+                and transfer_ms != device_ms):
+            lean = "link" if transfer_ms > device_ms else "dispatch"
+            if lean != winner:
+                evidence.append(
+                    f"e2e lineage: link and dispatch within 10% of "
+                    f"wall — the lineage split breaks the tie toward "
+                    f"{lean}"
+                )
+                winner = lean
+                dominant = candidates[winner] >= DOMINANCE_FRAC
+
     if winner == "link":
         verdict = "link-bound"
     elif winner == "host":

@@ -28,9 +28,14 @@ Metric sources in the ledger document:
   silence too;
 - ``node_budgets`` → snapshot ``dag.nodes.<name>`` block (per-node
   ``watermark_lag_p99_ms``/``retries``/``failovers``/
-  ``degraded_windows`` — the composed dataflow's per-node counters,
-  spatialflink_tpu/dag.py); a spec naming a node against a ledger with
-  no dag block (or without that node) fails on silence too;
+  ``degraded_windows``/``e2e_p50_ms``/``e2e_p99_ms`` — the composed
+  dataflow's per-node counters, spatialflink_tpu/dag.py); a spec
+  naming a node against a ledger with no dag block (or without that
+  node) fails on silence too;
+- ``e2e_p50_ms`` / ``e2e_p99_ms`` → snapshot ``e2e`` block's global
+  ``stages.commit`` percentiles (event-time end → sink commit, the
+  latency-lineage tentpole); a spec naming a ceiling against a ledger
+  whose run never stamped a commit fails on silence too;
 - ``overflow_budget`` → every ``*overflow*`` counter in the bench block
   and snapshot, summed.
 
@@ -53,6 +58,7 @@ SPEC_KEYS = (
     "name", "watermark_lag_p99_ms", "eps_floor", "late_drop_budget",
     "overflow_budget", "recompile_ceiling", "retry_budget",
     "failover_budget", "shed_budget", "degraded_window_budget",
+    "e2e_p50_ms", "e2e_p99_ms",
     "tenant_budgets", "node_budgets", "eval_interval_s",
     "warmup_windows",
 )
@@ -178,6 +184,24 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
             dw is not None and dw <= budget,
         ))
 
+    commit = ((snap.get("e2e") or {}).get("stages") or {}).get("commit")
+    ceiling = _num(spec.get("e2e_p50_ms"))
+    if ceiling is not None:
+        p50 = None if commit is None else _num(commit.get("p50_ms"))
+        rows.append((
+            "slo:e2e_p50_ms", p50, f"<= {float(ceiling):g}",
+            # A spec naming an e2e ceiling against a ledger whose run
+            # never stamped a commit fails on silence (eps_floor rule).
+            p50 is not None and p50 <= ceiling,
+        ))
+    ceiling = _num(spec.get("e2e_p99_ms"))
+    if ceiling is not None:
+        p99 = None if commit is None else _num(commit.get("p99_ms"))
+        rows.append((
+            "slo:e2e_p99_ms", p99, f"<= {float(ceiling):g}",
+            p99 is not None and p99 <= ceiling,
+        ))
+
     tb = spec.get("tenant_budgets") or {}
     if isinstance(tb, dict) and tb:
         # Live-side mirror (slo.SloSpec.tenant_budgets): per-class shed
@@ -234,6 +258,8 @@ def evaluate(spec: Dict[str, Any], doc: Dict[str, Any]) -> List[tuple]:
                 ("failover_budget", "node_failover_budget", "failovers"),
                 ("degraded_window_budget", "node_degraded_window_budget",
                  "degraded_windows"),
+                ("e2e_p50_ms", "node_e2e_p50_ms", "e2e_p50_ms"),
+                ("e2e_p99_ms", "node_e2e_p99_ms", "e2e_p99_ms"),
             ):
                 bound = _num(b.get(key))
                 if bound is None:

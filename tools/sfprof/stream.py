@@ -33,6 +33,7 @@ the truncation point only ``t == "epilogue"`` records are honored.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from tools.sfprof.ledger import LEDGER_VERSION
@@ -41,12 +42,15 @@ from tools.sfprof.ledger import LEDGER_VERSION
 #: literal so the CLI never imports spatialflink_tpu (whose import
 #: configures jax). Bump BOTH; tests/test_ledger_stream.py cross-pins.
 #: v2: checkpoints carry the per-node/collective snapshot blocks.
-STREAM_VERSION = 2
+#: v3: checkpoints may carry the ``e2e`` latency-lineage block, and a
+#: ``<stream>.blackbox.json`` flight-recorder dump may sit beside the
+#: stream (``recover`` folds it in).
+STREAM_VERSION = 3
 
-#: Versions recover still accepts: the v1→v2 change is additive
+#: Versions recover still accepts: the v1→v2→v3 changes are additive
 #: (checkpoint snapshots grew blocks; the grammar is identical), and a
 #: chip capture stranded by the r3–r5 loss mode must stay recoverable.
-SUPPORTED_STREAM_VERSIONS = (1, 2)
+SUPPORTED_STREAM_VERSIONS = (1, 2, 3)
 
 #: Snapshot skeleton for a stream killed before its first checkpoint:
 #: every key ``ledger.validate`` requires, zeroed — plus an explicit
@@ -144,6 +148,41 @@ def recover(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         # Unknown record kinds are forward-compatible: skipped, counted
         # nowhere — the prologue version gate is the breaking-change lever.
 
+    # Flight-recorder fold: a crash dump beside the stream
+    # (telemetry.dump_blackbox writes <stream>.blackbox.json on fault
+    # fire / seal) carries the LAST ring of instants — including any
+    # emitted after the final flushed span batch, exactly the tail a
+    # kill truncates. Fold ring instants NEWER than the last recovered
+    # event (same perf_counter-µs timebase) into the event list; older
+    # ones already ride a spans batch.
+    bb_path = path + ".blackbox.json"
+    bb_doc: Optional[dict] = None
+    bb_folded = 0
+    if os.path.exists(bb_path):
+        try:
+            with open(bb_path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                bb_doc = loaded
+        except (OSError, ValueError):
+            bb_doc = None  # unreadable dump: counted below, never fatal
+    if bb_doc is not None:
+        last_ts = max((ev.get("ts") or 0 for ev in events
+                       if isinstance(ev, dict)), default=0)
+        for rec in bb_doc.get("ring") or []:
+            if not isinstance(rec, dict) or rec.get("t") != "instant":
+                continue
+            ts = rec.get("ts") or 0
+            if ts <= last_ts:
+                continue
+            events.append({
+                "name": rec.get("name"), "cat": "telemetry",
+                "ph": "i", "ts": ts, "s": "t",
+                "args": rec.get("args") or {},
+                "blackbox": True,  # provenance: folded, not streamed
+            })
+            bb_folded += 1
+
     sealed = epilogue is not None
     # A SUPERVISOR seal (bench.py's failure paths) marks an attributable
     # crash, not a complete capture: the child died without its final
@@ -175,6 +214,10 @@ def recover(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         "skipped_lines": tail["skipped_lines"],
         "skipped_bytes": tail["skipped_bytes"],
         "snapshot_synthesized": checkpoint is None,
+        "blackbox_folded": bb_doc is not None,
+        "blackbox_path": bb_path if bb_doc is not None else None,
+        "blackbox_reason": (bb_doc or {}).get("reason"),
+        "blackbox_events_folded": bb_folded,
         # Per-node attribution survives reconstruction via the last
         # checkpoint's snapshot (tests pin this over a killed DAG
         # capture) — name the recovered nodes so a truncated 7-node
